@@ -1,0 +1,15 @@
+"""TPU-native Rainbow-IQN Ape-X framework.
+
+A from-scratch JAX/XLA/Pallas rebuild of the capabilities of
+`valeoai/rainbow-iqn-apex` (see SURVEY.md): a dueling, noisy-net IQN Q-network
+trained with the quantile-Huber loss under the full Rainbow recipe, scaled out
+Ape-X style — with the TPU pod acting as both the learner and the actor fleet,
+and the Redis-backed distributed replay replaced by pod-sharded host-DRAM
+replay plus XLA collectives for weight sync.
+"""
+
+from rainbow_iqn_apex_tpu.config import Config, parse_config
+
+__version__ = "0.1.0"
+
+__all__ = ["Config", "parse_config", "__version__"]

@@ -1,0 +1,145 @@
+"""Flat configuration for the TPU-native Rainbow-IQN Ape-X framework.
+
+Parity note: the reference (`valeoai/rainbow-iqn-apex`, reconstructed in
+SURVEY.md §2 row 1 — `rainbowiqn/args.py`) threads a single argparse namespace
+through every constructor.  We keep the same spirit — one flat config object,
+CLI-overridable — but as a typed frozen dataclass that is hashable, so it can
+be closed over by ``jax.jit``-compiled functions as a static argument.
+
+Hyperparameter defaults follow the Rainbow / IQN / Ape-X papers
+(arXiv:1710.02298, arXiv:1806.06923, arXiv:1803.00933) and the SABER protocol
+(arXiv:1908.04683), which are the reference's own sources (SURVEY.md §2).
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import json
+from typing import Any, Optional, Tuple
+
+
+@dataclasses.dataclass(frozen=True)
+class Config:
+    # ---- experiment / bookkeeping -------------------------------------------------
+    run_id: str = "run0"
+    seed: int = 123
+    results_dir: str = "results"
+    checkpoint_dir: str = "checkpoints"
+    checkpoint_interval: int = 100_000  # learner steps between Orbax saves
+    metrics_interval: int = 1_000  # learner steps between JSONL metric rows
+    resume: bool = False
+
+    # ---- environment (SURVEY §2 row 2) -------------------------------------------
+    env_id: str = "toy:catch"  # "toy:catch", "toy:chain", or "atari:<Game>"
+    history_length: int = 4  # frame-stack depth
+    frame_height: int = 84
+    frame_width: int = 84
+    action_repeat: int = 4  # with max over the last 2 raw frames
+    sticky_actions: float = 0.25  # SABER: repeat-previous-action probability
+    max_episode_frames: int = 108_000  # SABER 30-minute cap (raw frames)
+    full_action_set: bool = True  # SABER: all 18 ALE actions
+    terminal_on_life_loss: bool = False  # SABER: episode ends on game over only
+    reward_clip: float = 1.0  # clip rewards to [-c, c]; 0 disables
+
+    # ---- model (SURVEY §2 row 3) --------------------------------------------------
+    architecture: str = "iqn"  # "iqn" | "r2d2" (recurrent stretch goal)
+    hidden_size: int = 512
+    num_cosines: int = 64  # cosine tau-embedding features
+    noisy_sigma0: float = 0.5  # NoisyLinear initial sigma
+    dueling: bool = True
+    compute_dtype: str = "bfloat16"  # MXU-friendly compute; params stay fp32
+    # R2D2 (stretch) ----------------------------------------------------------------
+    lstm_size: int = 512
+    r2d2_burn_in: int = 40
+    r2d2_seq_len: int = 80
+
+    # ---- IQN tau sampling (SURVEY §3.4) -------------------------------------------
+    num_tau_samples: int = 64  # N  : online-net tau draws in the loss
+    num_tau_prime_samples: int = 64  # N' : target-net tau draws in the loss
+    num_quantile_samples: int = 32  # K  : tau draws used for acting
+    kappa: float = 1.0  # Huber threshold
+
+    # ---- agent / optimisation (SURVEY §2 row 4) -----------------------------------
+    gamma: float = 0.99
+    multi_step: int = 3  # n-step return length
+    batch_size: int = 32
+    learning_rate: float = 6.25e-5
+    adam_eps: float = 1.5e-4
+    max_grad_norm: float = 10.0  # 0 disables clipping
+    target_update_period: int = 8_000  # learner steps between hard target copies
+    learn_start: int = 20_000  # transitions stored before learning begins
+    replay_ratio: int = 4  # env frames per learner step (single-process mode)
+    t_max: int = 200_000_000  # total env frames of training budget
+
+    # ---- prioritized replay (SURVEY §2 rows 5-6) ----------------------------------
+    memory_capacity: int = 1_000_000
+    priority_exponent: float = 0.5  # omega
+    priority_weight: float = 0.4  # beta_0, annealed to 1 over training
+    priority_eps: float = 1e-6
+    replay_shards: int = 1  # host-DRAM shards (Redis-shard equivalent)
+    use_native_sumtree: bool = True  # C++ core; falls back to NumPy if unbuilt
+
+    # ---- Ape-X topology (SURVEY §2 rows 7-8) --------------------------------------
+    role: str = "single"  # "single" | "learner" | "actor" | "apex"
+    num_actors: int = 1  # actor loops (vector-env lanes per loop below)
+    actor_id: int = 0
+    num_envs_per_actor: int = 16  # batched vector-env width per actor loop
+    weight_publish_interval: int = 400  # learner steps between weight publishes
+    weight_poll_interval: int = 400  # actor frames between weight pulls
+    initial_priority_from_actor: bool = True  # Ape-X: actors compute initial TD
+
+    # ---- device mesh / sharding (TPU-native; replaces Redis TCP, SURVEY §5) -------
+    mesh_shape: str = ""  # e.g. "dp=8" or "dp=4,actor=4"; "" = all devices dp
+    learner_devices: int = 0  # 0 = all devices are learner devices
+    bf16_weight_sync: bool = True  # cast params to bf16 for the actor broadcast
+
+    # ---- evaluation (SURVEY §2 row 9) ---------------------------------------------
+    eval_episodes: int = 10
+    eval_noisy: bool = False  # noise off at eval time (§8 open question: default off)
+
+    # -------------------------------------------------------------------------------
+    @property
+    def state_shape(self) -> Tuple[int, int, int]:
+        """Observation shape fed to the network: HWC with stacked history as C.
+
+        NHWC is the TPU-native conv layout (XLA tiles the trailing C dim onto
+        the 128-lane axis), unlike the reference's NCHW torch layout.
+        """
+        return (self.frame_height, self.frame_width, self.history_length)
+
+    def replace(self, **kwargs: Any) -> "Config":
+        return dataclasses.replace(self, **kwargs)
+
+    def to_json(self) -> str:
+        return json.dumps(dataclasses.asdict(self), indent=2, sort_keys=True)
+
+    @staticmethod
+    def from_json(text: str) -> "Config":
+        return Config(**json.loads(text))
+
+
+def _add_args(parser: argparse.ArgumentParser) -> None:
+    """Expose every Config field as a ``--flag`` (underscores become dashes)."""
+    for field in dataclasses.fields(Config):
+        name = "--" + field.name.replace("_", "-")
+        if field.type == "bool" or isinstance(field.default, bool):
+            parser.add_argument(
+                name,
+                type=lambda s: s.lower() in ("1", "true", "yes", "on"),
+                default=field.default,
+                metavar="BOOL",
+            )
+        else:
+            parser.add_argument(name, type=type(field.default), default=field.default)
+
+
+def parse_config(argv: Optional[list] = None, **overrides: Any) -> Config:
+    """Build a Config from CLI args (mirrors the reference's single argparse)."""
+    parser = argparse.ArgumentParser(description="TPU-native Rainbow-IQN Ape-X")
+    _add_args(parser)
+    ns = parser.parse_args(argv)
+    cfg = Config(**vars(ns))
+    if overrides:
+        cfg = cfg.replace(**overrides)
+    return cfg

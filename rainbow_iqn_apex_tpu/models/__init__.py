@@ -1,0 +1,15 @@
+from rainbow_iqn_apex_tpu.models.iqn import RainbowIQN, greedy_action, q_values
+from rainbow_iqn_apex_tpu.models.layers import (
+    ConvTrunk,
+    CosineTauEmbedding,
+    NoisyLinear,
+)
+
+__all__ = [
+    "RainbowIQN",
+    "greedy_action",
+    "q_values",
+    "ConvTrunk",
+    "CosineTauEmbedding",
+    "NoisyLinear",
+]

@@ -1,0 +1,140 @@
+"""Building-block layers for the TPU-native Rainbow-IQN network.
+
+Parity: reference `rainbowiqn/model.py` (SURVEY.md §2 row 3) — NoisyLinear with
+factorised Gaussian noise (sigma0=0.5, Fortunato et al. arXiv:1706.10295) and
+the IQN cosine tau embedding (Dabney et al. arXiv:1806.06923).
+
+TPU-first design notes:
+- Noise is never hidden module state (the torch pattern of `.reset_noise()`
+  mutating buffers).  It is drawn from an explicit PRNG key per call via the
+  flax "noise" RNG collection, so noisy forward passes are pure functions that
+  jit/vmap/shard_map cleanly and noise-resampling semantics are decided by
+  whoever supplies the key (SURVEY.md §7 "NoisyNet semantics under jit/pmap").
+- Matmuls run in a configurable compute dtype (bfloat16 by default) with fp32
+  parameters, so the MXU sees bf16 operands while optimizer state stays fp32.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Optional
+
+import jax
+import jax.numpy as jnp
+from flax import linen as nn
+
+Dtype = Any
+
+
+def _f(x: jnp.ndarray) -> jnp.ndarray:
+    """Factorised-noise squashing f(x) = sign(x) * sqrt(|x|)."""
+    return jnp.sign(x) * jnp.sqrt(jnp.abs(x))
+
+
+class NoisyLinear(nn.Module):
+    """Factorised-Gaussian noisy linear layer.
+
+    y = (w_mu + w_sigma * (f(eps_out) f(eps_in)^T)) x + (b_mu + b_sigma * f(eps_out))
+
+    When ``use_noise`` is False (evaluation), only the mu parameters are used —
+    matching the reference's eval-time behaviour of acting without noise.
+    """
+
+    features: int
+    sigma0: float = 0.5
+    use_noise: bool = True
+    compute_dtype: Dtype = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray) -> jnp.ndarray:
+        in_features = x.shape[-1]
+        bound = 1.0 / float(in_features) ** 0.5
+
+        def _mu_init(key, shape, dtype=jnp.float32):
+            return jax.random.uniform(key, shape, dtype, -bound, bound)
+
+        w_mu = self.param("w_mu", _mu_init, (in_features, self.features), jnp.float32)
+        b_mu = self.param("b_mu", _mu_init, (self.features,), jnp.float32)
+        sigma_init = self.sigma0 / float(in_features) ** 0.5
+        w_sigma = self.param(
+            "w_sigma",
+            nn.initializers.constant(sigma_init),
+            (in_features, self.features),
+            jnp.float32,
+        )
+        b_sigma = self.param(
+            "b_sigma",
+            nn.initializers.constant(sigma_init),
+            (self.features,),
+            jnp.float32,
+        )
+
+        xc = x.astype(self.compute_dtype)
+        y = jnp.dot(xc, w_mu.astype(self.compute_dtype), preferred_element_type=jnp.float32)
+        if self.use_noise:
+            key = self.make_rng("noise")
+            k_in, k_out = jax.random.split(key)
+            eps_in = _f(jax.random.normal(k_in, (in_features,), jnp.float32))
+            eps_out = _f(jax.random.normal(k_out, (self.features,), jnp.float32))
+            # The noise is rank-1, so the noisy term factorises exactly:
+            #   x @ (w_sigma * eps_in eps_out^T) == ((x * eps_in) @ w_sigma) * eps_out
+            # — two GEMMs and two row/col scalings, never materialising the
+            # [in, out] noise matrix in HBM.
+            noisy = jnp.dot(
+                xc * eps_in.astype(self.compute_dtype),
+                w_sigma.astype(self.compute_dtype),
+                preferred_element_type=jnp.float32,
+            )
+            y = y + noisy * eps_out
+            b = b_mu + b_sigma * eps_out
+        else:
+            b = b_mu
+        return y + b  # fp32 accumulate + fp32 bias
+
+
+class CosineTauEmbedding(nn.Module):
+    """IQN tau embedding: psi(tau)_j = ReLU(Linear(cos(pi * i * tau), i=1..n)).
+
+    Input taus [..., N] -> output [..., N, features].
+    """
+
+    features: int
+    num_cosines: int = 64
+    compute_dtype: Dtype = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, taus: jnp.ndarray) -> jnp.ndarray:
+        i = jnp.arange(1, self.num_cosines + 1, dtype=jnp.float32)
+        cos = jnp.cos(jnp.pi * taus[..., None] * i)  # [..., N, num_cosines]
+        dense = nn.Dense(
+            self.features,
+            dtype=self.compute_dtype,
+            param_dtype=jnp.float32,
+            name="embed",
+        )
+        return nn.relu(dense(cos.astype(self.compute_dtype)))
+
+
+class ConvTrunk(nn.Module):
+    """Canonical DQN conv trunk (32x8x8/4, 64x4x4/2, 64x3x3/1) in NHWC.
+
+    NHWC keeps channels on the TPU lane dimension; XLA maps these convs onto
+    the MXU without layout transposes (unlike a literal NCHW translation).
+    """
+
+    compute_dtype: Dtype = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray) -> jnp.ndarray:
+        # x: [B, H, W, C] float in [0, 1]
+        x = x.astype(self.compute_dtype)
+        for features, kernel, stride in ((32, 8, 4), (64, 4, 2), (64, 3, 1)):
+            x = nn.Conv(
+                features,
+                (kernel, kernel),
+                strides=(stride, stride),
+                padding="VALID",
+                dtype=self.compute_dtype,
+                param_dtype=jnp.float32,
+            )(x)
+            x = nn.relu(x)
+        return x.reshape(x.shape[0], -1)  # [B, 3136] for 84x84x4

@@ -1,0 +1,22 @@
+from rainbow_iqn_apex_tpu.ops.learn import (
+    Batch,
+    TrainState,
+    build_act_step,
+    build_learn_step,
+    init_train_state,
+    make_network,
+    make_optimizer,
+)
+from rainbow_iqn_apex_tpu.ops.losses import huber, quantile_huber_loss
+
+__all__ = [
+    "Batch",
+    "TrainState",
+    "build_act_step",
+    "build_learn_step",
+    "init_train_state",
+    "make_network",
+    "make_optimizer",
+    "huber",
+    "quantile_huber_loss",
+]

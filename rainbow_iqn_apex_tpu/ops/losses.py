@@ -1,0 +1,50 @@
+"""Quantile-regression losses.
+
+Parity: reference learn-step loss core (SURVEY.md §3.4) — the pairwise
+quantile-Huber loss of IQN (Dabney et al. arXiv:1806.06923, eq. 3):
+
+    u_ij   = td_target_j - online_quantile_i
+    rho^k  = |tau_i - 1{u_ij < 0}| * Huber_k(u_ij) / k
+    loss   = sum_i mean_j rho^k_ij        (per sample)
+
+Everything here is pure jnp on [B, N, N'] tensors; XLA fuses the whole thing
+into the learn-step graph (no per-pair Python loops, no dynamic shapes).
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax.numpy as jnp
+
+
+def huber(u: jnp.ndarray, kappa: float) -> jnp.ndarray:
+    """Elementwise Huber_k(u): quadratic within |u|<=k, linear outside."""
+    abs_u = jnp.abs(u)
+    return jnp.where(
+        abs_u <= kappa,
+        0.5 * u**2,
+        kappa * (abs_u - 0.5 * kappa),
+    )
+
+
+def quantile_huber_loss(
+    online_quantiles: jnp.ndarray,  # [B, N]   Z_tau_i(s, a)
+    taus: jnp.ndarray,  # [B, N]   online tau_i
+    td_targets: jnp.ndarray,  # [B, N']  r + gamma^n Z_tau'_j(s', a*)
+    kappa: float = 1.0,
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Pairwise quantile-Huber loss.
+
+    Returns:
+        per_sample_loss: [B] — sum over online taus of the mean over target taus.
+        td_abs:          [B] — mean |u_ij|, the priority signal
+                         (reference uses mean |TD|, SURVEY.md §2 row 4).
+    """
+    u = td_targets[:, None, :] - online_quantiles[:, :, None]  # [B, N, N']
+    indicator = (u < 0.0).astype(jnp.float32)
+    weight = jnp.abs(taus[:, :, None] - indicator)  # |tau_i - 1{u<0}|
+    rho = weight * huber(u, kappa) / kappa
+    per_sample_loss = rho.mean(axis=2).sum(axis=1)  # mean_j, sum_i
+    td_abs = jnp.abs(u).mean(axis=(1, 2))
+    return per_sample_loss, td_abs

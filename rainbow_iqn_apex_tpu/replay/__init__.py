@@ -1,0 +1,3 @@
+from rainbow_iqn_apex_tpu.replay.sumtree import SumTree
+
+__all__ = ["SumTree"]

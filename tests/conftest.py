@@ -1,0 +1,18 @@
+"""Test harness: force an 8-device virtual CPU platform before JAX loads.
+
+Multi-chip sharding paths (parallel/) are validated on a virtual CPU mesh per
+the build contract; the real TPU chip is exercised by bench.py, not the suite.
+"""
+
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"  # override the shell's axon/TPU default
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import jax  # noqa: E402  (import after env setup)
+
+jax.config.update("jax_threefry_partitionable", True)

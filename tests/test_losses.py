@@ -1,0 +1,75 @@
+"""Unit tests for the quantile-Huber loss vs hand-computed tiny cases.
+
+SURVEY.md §4: "IQN loss vs hand-computed small cases" is a required unit test
+the reference never had.
+"""
+
+import jax.numpy as jnp
+import numpy as np
+
+from rainbow_iqn_apex_tpu.ops.losses import huber, quantile_huber_loss
+
+
+def test_huber_quadratic_region():
+    u = jnp.array([-0.5, 0.0, 0.5, 1.0])
+    np.testing.assert_allclose(huber(u, 1.0), [0.125, 0.0, 0.125, 0.5], atol=1e-7)
+
+
+def test_huber_linear_region():
+    u = jnp.array([2.0, -3.0])
+    # kappa*(|u| - kappa/2) with kappa=1 -> 1.5, 2.5
+    np.testing.assert_allclose(huber(u, 1.0), [1.5, 2.5], atol=1e-7)
+
+
+def test_single_pair_hand_case():
+    # online=0, tau=0.5, target=1: u=1, Huber=0.5, weight=|0.5-0|=0.5 -> 0.25
+    online = jnp.array([[0.0]])
+    taus = jnp.array([[0.5]])
+    target = jnp.array([[1.0]])
+    loss, td_abs = quantile_huber_loss(online, taus, target, kappa=1.0)
+    np.testing.assert_allclose(loss, [0.25], atol=1e-7)
+    np.testing.assert_allclose(td_abs, [1.0], atol=1e-7)
+
+
+def test_asymmetric_tau_weighting():
+    # tau=0.9 penalises under-estimation (u>0) 9x more than over-estimation.
+    online = jnp.array([[0.0]])
+    taus = jnp.array([[0.9]])
+    loss_under, _ = quantile_huber_loss(online, taus, jnp.array([[1.0]]), kappa=1.0)
+    loss_over, _ = quantile_huber_loss(online, taus, jnp.array([[-1.0]]), kappa=1.0)
+    np.testing.assert_allclose(loss_under, [0.9 * 0.5], atol=1e-7)
+    np.testing.assert_allclose(loss_over, [0.1 * 0.5], atol=1e-7)
+    np.testing.assert_allclose(loss_under / loss_over, [9.0], rtol=1e-5)
+
+
+def test_pairwise_reduction_shape_and_value():
+    # B=1, N=2 online quantiles, N'=2 targets; verify sum_i mean_j by hand.
+    online = jnp.array([[0.0, 1.0]])
+    taus = jnp.array([[0.25, 0.75]])
+    target = jnp.array([[0.5, 2.0]])
+    # i=0 (z=0, tau=.25): u=(0.5, 2.0) -> huber=(0.125, 1.5), w=(.25,.25)
+    #   mean_j = (0.03125 + 0.375)/2 = 0.203125
+    # i=1 (z=1, tau=.75): u=(-0.5, 1.0) -> huber=(0.125, 0.5), w=(|.75-1|,.75)=(.25,.75)
+    #   mean_j = (0.03125 + 0.375)/2 = 0.203125
+    loss, td_abs = quantile_huber_loss(online, taus, target, kappa=1.0)
+    np.testing.assert_allclose(loss, [0.40625], atol=1e-6)
+    np.testing.assert_allclose(td_abs, [(0.5 + 2.0 + 0.5 + 1.0) / 4], atol=1e-6)
+
+
+def test_perfect_fit_zero_loss():
+    # online quantile exactly equals the unique target -> u=0 -> zero loss.
+    online = jnp.array([[3.0, 3.0]])
+    taus = jnp.array([[0.3, 0.7]])
+    target = jnp.array([[3.0, 3.0]])
+    loss, td_abs = quantile_huber_loss(online, taus, target, kappa=1.0)
+    np.testing.assert_allclose(loss, [0.0], atol=1e-7)
+    np.testing.assert_allclose(td_abs, [0.0], atol=1e-7)
+
+
+def test_batch_independence():
+    online = jnp.array([[0.0], [0.0]])
+    taus = jnp.array([[0.5], [0.5]])
+    target = jnp.array([[1.0], [-1.0]])
+    loss, _ = quantile_huber_loss(online, taus, target, kappa=1.0)
+    assert loss.shape == (2,)
+    np.testing.assert_allclose(loss, [0.25, 0.25], atol=1e-7)

@@ -1,0 +1,119 @@
+"""Property tests for the vectorised sum-tree (SURVEY §4: 'sum-tree invariants')."""
+
+import numpy as np
+import pytest
+
+from rainbow_iqn_apex_tpu.replay import SumTree
+
+
+def _check_invariant(t: SumTree):
+    """Every internal node equals the sum of its children."""
+    for node in range(1, t.span):
+        np.testing.assert_allclose(
+            t.tree[node], t.tree[2 * node] + t.tree[2 * node + 1], rtol=1e-12
+        )
+
+
+def test_set_and_total():
+    t = SumTree(10)
+    t.set(np.arange(10), np.arange(10, dtype=np.float64))
+    assert t.total == pytest.approx(45.0)
+    _check_invariant(t)
+    np.testing.assert_allclose(t.get(np.array([3, 7])), [3.0, 7.0])
+
+
+def test_overwrite_updates_ancestors():
+    t = SumTree(8)
+    t.set(np.arange(8), np.ones(8))
+    t.set(np.array([2]), np.array([5.0]))
+    assert t.total == pytest.approx(7 + 5)
+    _check_invariant(t)
+
+
+def test_duplicate_indices_last_write_wins():
+    t = SumTree(4)
+    t.set(np.array([1, 1, 1]), np.array([1.0, 2.0, 9.0]))
+    assert t.get(np.array([1]))[0] == pytest.approx(9.0)
+    assert t.total == pytest.approx(9.0)
+    _check_invariant(t)
+
+
+def test_sibling_batch_update_exact():
+    """Leaves 0 and 1 share a parent: batched update must not double-count."""
+    t = SumTree(4)
+    t.set(np.array([0, 1, 2, 3]), np.array([1.0, 2.0, 3.0, 4.0]))
+    _check_invariant(t)
+    t.set(np.array([0, 1]), np.array([10.0, 20.0]))
+    assert t.total == pytest.approx(10 + 20 + 3 + 4)
+    _check_invariant(t)
+
+
+def test_non_power_of_two_capacity():
+    t = SumTree(5)
+    t.set(np.arange(5), np.full(5, 2.0))
+    assert t.total == pytest.approx(10.0)
+    assert t.max_leaf() == pytest.approx(2.0)
+    assert t.min_leaf_nonzero() == pytest.approx(2.0)
+    _check_invariant(t)
+
+
+def test_find_prefix_exact_boundaries():
+    t = SumTree(4)
+    t.set(np.arange(4), np.array([1.0, 2.0, 3.0, 4.0]))
+    # cumulative: [0,1) -> 0, [1,3) -> 1, [3,6) -> 2, [6,10) -> 3
+    masses = np.array([0.0, 0.999, 1.0, 2.999, 3.0, 5.999, 6.0, 9.999])
+    expect = np.array([0, 0, 1, 1, 2, 2, 3, 3])
+    np.testing.assert_array_equal(t.find_prefix(masses), expect)
+
+
+def test_find_prefix_skips_zero_priority():
+    t = SumTree(6)
+    t.set(np.arange(6), np.array([0.0, 5.0, 0.0, 0.0, 7.0, 0.0]))
+    idx = t.find_prefix(np.linspace(0, t.total - 1e-9, 50))
+    assert set(np.unique(idx)) <= {1, 4}
+
+
+def test_stratified_sampling_proportional():
+    rng = np.random.default_rng(0)
+    t = SumTree(4)
+    t.set(np.arange(4), np.array([1.0, 1.0, 1.0, 97.0]))
+    counts = np.zeros(4)
+    for _ in range(200):
+        idx, prob = t.sample_stratified(16, rng)
+        np.testing.assert_allclose(prob, t.get(idx) / t.total)
+        np.bincount(idx, minlength=4, weights=None)
+        counts += np.bincount(idx, minlength=4)
+    freq = counts / counts.sum()
+    assert freq[3] > 0.9  # 97% of mass
+    assert np.all(freq[:3] > 0)  # stratification still reaches small leaves
+
+
+def test_rejects_bad_priorities():
+    t = SumTree(4)
+    with pytest.raises(ValueError):
+        t.set(np.array([0]), np.array([-1.0]))
+    with pytest.raises(ValueError):
+        t.set(np.array([0]), np.array([np.nan]))
+    with pytest.raises(ValueError):
+        t.sample_stratified(4, np.random.default_rng(0))  # empty tree
+
+
+def test_random_fuzz_against_naive():
+    rng = np.random.default_rng(42)
+    t = SumTree(33)
+    ref = np.zeros(33)
+    for _ in range(200):
+        k = rng.integers(1, 10)
+        idx = rng.integers(0, 33, size=k)
+        pri = rng.random(k) * 10
+        t.set(idx, pri)
+        for i, p in zip(idx, pri):  # sequential semantics
+            ref[i] = p
+        assert t.total == pytest.approx(ref.sum())
+    _check_invariant(t)
+    np.testing.assert_allclose(t.get(np.arange(33)), ref)
+    # prefix-find agrees with naive cumulative search
+    masses = rng.random(64) * ref.sum()
+    cum = np.cumsum(ref)
+    naive = np.searchsorted(cum, masses, side="right")
+    np.testing.assert_array_equal(t.find_prefix(masses), naive)
