@@ -1,0 +1,92 @@
+#!/usr/bin/env python
+"""Benchmark: learner throughput at the reference's Atari workload shape.
+
+Prints ONE JSON line:
+  {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
+
+What is measured: sustained full learn steps/sec on the real device at the
+reference hyperparameters (batch 32, 84x84x4 uint8 frames, IQN N=N'=64, K=32
+double-Q selection, dueling noisy nets, Adam) — the §3.4 kernel end-to-end,
+including host->device batch transfer each step, i.e. what the learner role
+sustains in the Ape-X loop.
+
+Baseline: the reference learner is a PyTorch 1-GPU process at the same shape.
+BASELINE.json records no published number ("published": {}); the documented
+reference class (SURVEY.md §6, RECON) is ~75 learn-steps/s for a Rainbow-IQN
+GPU learner of that era, so vs_baseline = steps_per_sec / 75.  Re-verify when
+reference numbers become available (SURVEY.md §8 item 6).
+"""
+
+import json
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def main() -> None:
+    from rainbow_iqn_apex_tpu.config import Config
+    from rainbow_iqn_apex_tpu.ops.learn import (
+        Batch,
+        build_learn_step,
+        init_train_state,
+    )
+
+    cfg = Config()  # reference defaults: 84x84x4, N=N'=64, K=32, batch 32
+    num_actions = 18  # SABER full action set
+    batch_size = cfg.batch_size
+
+    state = init_train_state(cfg, num_actions, jax.random.PRNGKey(0))
+    learn = jax.jit(build_learn_step(cfg, num_actions), donate_argnums=0)
+
+    rng = np.random.default_rng(0)
+
+    def host_batch():
+        return Batch(
+            obs=rng.integers(0, 255, (batch_size, *cfg.state_shape), dtype=np.uint8),
+            action=rng.integers(0, num_actions, batch_size).astype(np.int32),
+            reward=rng.normal(size=batch_size).astype(np.float32),
+            next_obs=rng.integers(0, 255, (batch_size, *cfg.state_shape), dtype=np.uint8),
+            discount=np.full(batch_size, 0.99**3, np.float32),
+            weight=np.ones(batch_size, np.float32),
+        )
+
+    key = jax.random.PRNGKey(1)
+
+    def step(state, hb, key):
+        batch = Batch(*(jnp.asarray(getattr(hb, f)) for f in
+                        ("obs", "action", "reward", "next_obs", "discount", "weight")))
+        key, k = jax.random.split(key)
+        state, info = learn(state, batch, k)
+        return state, info, key
+
+    # warmup / compile
+    for _ in range(3):
+        state, info, key = step(state, host_batch(), key)
+    jax.block_until_ready(info["loss"])
+
+    # timed run: fresh host batch every step (runtime-realistic transfer)
+    iters = 300
+    batches = [host_batch() for _ in range(8)]
+    t0 = time.perf_counter()
+    for i in range(iters):
+        state, info, key = step(state, batches[i % 8], key)
+    jax.block_until_ready(info["loss"])
+    dt = time.perf_counter() - t0
+
+    steps_per_sec = iters / dt
+    print(
+        json.dumps(
+            {
+                "metric": "iqn_learner_steps_per_sec_atari_shape",
+                "value": round(steps_per_sec, 2),
+                "unit": "learn_steps/s (batch=32, 84x84x4, N=N'=64)",
+                "vs_baseline": round(steps_per_sec / 75.0, 3),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

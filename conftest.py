@@ -35,4 +35,19 @@ def pytest_configure(config):
     flags = env.get("XLA_FLAGS", "")
     if "xla_force_host_platform_device_count" not in flags:
         env["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
+
+    # The pre-exec interpreter may have opened a connection to the TPU relay
+    # (sitecustomize registration). Sockets survive execve unless CLOEXEC —
+    # a leaked fd would keep the chip's grant claimed and block every other
+    # process. Mark everything above stdio close-on-exec.
+    try:
+        for fd_name in os.listdir("/proc/self/fd"):
+            fd = int(fd_name)
+            if fd > 2:
+                try:
+                    os.set_inheritable(fd, False)
+                except OSError:
+                    pass
+    except OSError:
+        pass
     os.execve(sys.executable, [sys.executable, "-m", "pytest", *sys.argv[1:]], env)

@@ -1,0 +1,3 @@
+from rainbow_iqn_apex_tpu.agents.agent import Agent, FrameStacker
+
+__all__ = ["Agent", "FrameStacker"]

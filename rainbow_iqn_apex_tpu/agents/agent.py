@@ -1,0 +1,124 @@
+"""The Agent: acting, learning, target sync, checkpoint save/load.
+
+Parity: reference `rainbowiqn/agent.py` `Agent` (SURVEY.md §2 row 4, §3.3) —
+`act(state)` (greedy over the mean of K tau samples, noisy-net exploration),
+`learn(memory)` (quantile-Huber + Adam + priority write-back), scheduled
+target-net update, save/load.
+
+TPU-first notes: the Agent is a thin host-side facade over two pure jitted
+functions (act_step, learn_step).  All mutable state lives in one TrainState
+pytree in device memory (donated through the learn step) and an explicit PRNG
+key; nothing else to get wrong under jit.  The per-lane frame-stack rolling
+state is host NumPy — it belongs to the env/actor side of the host-device
+seam, so frames cross to HBM exactly once per tick as one [L, H, W, hist]
+uint8 tensor.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional, Tuple
+
+import chex
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from rainbow_iqn_apex_tpu.config import Config
+from rainbow_iqn_apex_tpu.ops.learn import (
+    Batch,
+    TrainState,
+    build_act_step,
+    build_learn_step,
+    init_train_state,
+)
+from rainbow_iqn_apex_tpu.replay.buffer import SampledBatch
+
+
+class FrameStacker:
+    """Rolling [L, H, W, hist] uint8 stack with per-lane terminal reset."""
+
+    def __init__(self, lanes: int, frame_shape: Tuple[int, int], history: int):
+        self.buf = np.zeros((lanes, *frame_shape, history), np.uint8)
+
+    def push(self, frames: np.ndarray) -> np.ndarray:
+        """Shift in the newest frame; returns the stacked state (a view copy)."""
+        self.buf[..., :-1] = self.buf[..., 1:]
+        self.buf[..., -1] = frames
+        return self.buf.copy()
+
+    def reset_lanes(self, mask: np.ndarray) -> None:
+        """Zero the history of lanes whose episode just ended (reference
+        zero-stack reset semantics)."""
+        self.buf[mask] = 0
+
+
+class Agent:
+    def __init__(
+        self,
+        cfg: Config,
+        num_actions: int,
+        key: chex.PRNGKey,
+        train: bool = True,
+        state_shape: Optional[Tuple[int, ...]] = None,
+    ):
+        self.cfg = cfg
+        self.num_actions = num_actions
+        key, init_key = jax.random.split(key)
+        self.key = key
+        self.state: TrainState = init_train_state(
+            cfg, num_actions, init_key, state_shape=state_shape
+        )
+        self._act = jax.jit(build_act_step(cfg, num_actions, use_noise=True))
+        self._act_eval = jax.jit(
+            build_act_step(cfg, num_actions, use_noise=cfg.eval_noisy)
+        )
+        self._learn = (
+            jax.jit(build_learn_step(cfg, num_actions), donate_argnums=0)
+            if train
+            else None
+        )
+
+    # ------------------------------------------------------------------ acting
+    def _next_key(self) -> chex.PRNGKey:
+        self.key, k = jax.random.split(self.key)
+        return k
+
+    def act(self, stacked_obs: np.ndarray, eval_mode: bool = False) -> np.ndarray:
+        """Greedy actions for a [L, H, W, hist] uint8 batch.  Noisy-net noise
+        is resampled every call (reference per-step resample, SURVEY §3.2)."""
+        fn = self._act_eval if eval_mode else self._act
+        actions, _ = fn(self.state.params, jnp.asarray(stacked_obs), self._next_key())
+        return np.asarray(actions)
+
+    # ---------------------------------------------------------------- learning
+    def learn(self, sample: SampledBatch) -> Dict[str, Any]:
+        """One learner step on a host SampledBatch; returns info with host
+        priorities for the replay write-back."""
+        batch = Batch(
+            obs=jnp.asarray(sample.obs),
+            action=jnp.asarray(sample.action),
+            reward=jnp.asarray(sample.reward),
+            next_obs=jnp.asarray(sample.next_obs),
+            discount=jnp.asarray(sample.discount),
+            weight=jnp.asarray(sample.weight),
+        )
+        self.state, info = self._learn(self.state, batch, self._next_key())
+        return info
+
+    @property
+    def step(self) -> int:
+        return int(self.state.step)
+
+    # ------------------------------------------------------------- weight sync
+    def params_for_publish(self):
+        """Online params as the learner publishes them to actors (the Redis
+        weight-mailbox equivalent; bf16-cast when configured to halve sync
+        bytes — SURVEY §5 'weight mailbox')."""
+        if self.cfg.bf16_weight_sync:
+            return jax.tree.map(lambda p: p.astype(jnp.bfloat16), self.state.params)
+        return self.state.params
+
+    def load_published(self, params) -> None:
+        self.state = self.state.replace(
+            params=jax.tree.map(lambda p: p.astype(jnp.float32), params)
+        )

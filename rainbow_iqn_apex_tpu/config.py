@@ -96,6 +96,7 @@ class Config:
 
     # ---- evaluation (SURVEY §2 row 9) ---------------------------------------------
     eval_episodes: int = 10
+    eval_interval: int = 50_000  # learner steps between in-training evals; 0 = off
     eval_noisy: bool = False  # noise off at eval time (§8 open question: default off)
 
     # -------------------------------------------------------------------------------

@@ -1,0 +1,32 @@
+from rainbow_iqn_apex_tpu.envs.base import Env, TimeStep, VectorEnv
+from rainbow_iqn_apex_tpu.envs.toy import CatchEnv, ChainEnv, make_toy_env
+from rainbow_iqn_apex_tpu.envs.atari import ALEAdapter, AtariEnv, make_atari_env
+
+
+def make_env(env_id: str, seed: int = 0, **kwargs) -> Env:
+    """Env factory keyed by the config's env_id: "toy:catch", "atari:Pong"."""
+    kind, _, name = env_id.partition(":")
+    if kind == "toy":
+        return make_toy_env(name, seed=seed)
+    if kind == "atari":
+        return make_atari_env(name, seed=seed, **kwargs)
+    raise ValueError(f"unknown env id '{env_id}' (want 'toy:...' or 'atari:...')")
+
+
+def make_vector_env(env_id: str, num_envs: int, seed: int = 0, **kwargs) -> VectorEnv:
+    return VectorEnv([make_env(env_id, seed=seed + i, **kwargs) for i in range(num_envs)])
+
+
+__all__ = [
+    "Env",
+    "TimeStep",
+    "VectorEnv",
+    "CatchEnv",
+    "ChainEnv",
+    "AtariEnv",
+    "ALEAdapter",
+    "make_env",
+    "make_toy_env",
+    "make_atari_env",
+    "make_vector_env",
+]

@@ -20,7 +20,7 @@ graph on the learner cores"):
 from __future__ import annotations
 
 import functools
-from typing import Any, Callable, Dict, Tuple
+from typing import Any, Callable, Dict, Optional, Tuple
 
 import chex
 import jax
@@ -74,10 +74,17 @@ def make_network(cfg: Config, num_actions: int, use_noise: bool = True) -> Rainb
     )
 
 
-def init_train_state(cfg: Config, num_actions: int, key: chex.PRNGKey) -> TrainState:
+def init_train_state(
+    cfg: Config,
+    num_actions: int,
+    key: chex.PRNGKey,
+    state_shape: Optional[Tuple[int, ...]] = None,
+) -> TrainState:
+    """state_shape defaults to cfg.state_shape; pass the env's actual
+    (H, W, history) when the env defines its own frame size (toy envs)."""
     net = make_network(cfg, num_actions)
     k_init, k_taus, k_noise = jax.random.split(key, 3)
-    dummy = jnp.zeros((1, *cfg.state_shape), jnp.uint8)
+    dummy = jnp.zeros((1, *(state_shape or cfg.state_shape)), jnp.uint8)
     params = net.init(
         {"params": k_init, "taus": k_taus, "noise": k_noise},
         dummy,

@@ -1,0 +1,27 @@
+from rainbow_iqn_apex_tpu.parallel.apex import (
+    ActorPriorityEstimator,
+    ApexDriver,
+    train_apex,
+)
+from rainbow_iqn_apex_tpu.parallel.mesh import (
+    actor_mesh,
+    batch_sharding,
+    learner_mesh,
+    parse_mesh_shape,
+    replicated,
+    split_devices,
+)
+from rainbow_iqn_apex_tpu.parallel.sharded_replay import ShardedReplay
+
+__all__ = [
+    "ActorPriorityEstimator",
+    "ApexDriver",
+    "train_apex",
+    "ShardedReplay",
+    "actor_mesh",
+    "batch_sharding",
+    "learner_mesh",
+    "parse_mesh_shape",
+    "replicated",
+    "split_devices",
+]

@@ -1,0 +1,299 @@
+"""TPU-native Ape-X: the device slice is simultaneously the learner and the
+actor fleet.
+
+Parity map (SURVEY.md §2 rows 6-8, §3.1-3.2, §5 "Distributed communication
+backend"; north star BASELINE.json:5):
+
+  reference (PyTorch + Redis)            this module (JAX/XLA)
+  -----------------------------------    -----------------------------------
+  1 learner process on GPU               learn step jit-sharded over the
+                                         learner mesh axis "dp" (batch split,
+                                         params replicated, gradient
+                                         all-reduce inserted by XLA over ICI)
+  N actor processes on CPUs              batched vector-env lanes, inference
+                                         jit-sharded lane-wise over the actor
+                                         mesh axis "actor"
+  Redis experience append (TCP)          host-DRAM sharded replay append
+  Redis batch fetch + priority write     local shard sample + write-back
+  Redis weight mailbox (~10MB fp32)      device_put of bf16 params from the
+                                         learner mesh to the actor mesh
+                                         (one ICI broadcast per publish)
+  actor-side initial priorities          n-step TD estimate from the actor's
+  (Ape-X paper §3)                       own Q outputs, no extra forward pass
+
+Single-host multi-device SPMD; multi-host (jax.distributed over DCN) reuses
+the same code with per-host replay shards — the shard topology is already
+host-aligned.
+"""
+
+from __future__ import annotations
+
+import collections
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from rainbow_iqn_apex_tpu.agents.agent import FrameStacker
+from rainbow_iqn_apex_tpu.config import Config
+from rainbow_iqn_apex_tpu.envs import make_vector_env
+from rainbow_iqn_apex_tpu.ops.learn import (
+    Batch,
+    TrainState,
+    build_act_step,
+    build_learn_step,
+    init_train_state,
+)
+from rainbow_iqn_apex_tpu.parallel.mesh import (
+    actor_mesh,
+    batch_sharding,
+    learner_mesh,
+    replicated,
+    split_devices,
+)
+from rainbow_iqn_apex_tpu.parallel.sharded_replay import ShardedReplay
+from rainbow_iqn_apex_tpu.utils.checkpoint import Checkpointer
+from rainbow_iqn_apex_tpu.utils.logging import MetricsLogger
+
+
+class ActorPriorityEstimator:
+    """Ape-X actor-side initial priorities from the actor's own Q outputs.
+
+    Buffers n+1 ticks of (Q(s, a_sel), reward, terminal) per lane; when the
+    replay completes the transition started n ticks ago, emits
+        |R_n + gamma^n * maxQ(s_now) * alive - Q(s_then, a_then)|
+    with the same truncate-at-terminal rules the replay applies.
+    """
+
+    def __init__(self, lanes: int, n_step: int, gamma: float):
+        self.n = n_step
+        self.gamma = gamma
+        self.q_sel = collections.deque(maxlen=n_step + 1)  # each [L]
+        self.rew = collections.deque(maxlen=n_step + 1)
+        self.term = collections.deque(maxlen=n_step + 1)
+
+    def push(
+        self,
+        q_values: np.ndarray,  # [L, A] actor Q estimates at s_t
+        actions: np.ndarray,  # [L]
+        rewards: np.ndarray,  # [L] r_t
+        terminals: np.ndarray,  # [L] d_t
+    ) -> Optional[np.ndarray]:
+        L = actions.shape[0]
+        self.q_sel.append(q_values[np.arange(L), actions])
+        self.rew.append(rewards.astype(np.float32))
+        self.term.append(terminals.astype(bool))
+        if len(self.rew) <= self.n:
+            return None
+        # window ticks: t-n .. t-1 rewards, bootstrap at t
+        r = np.stack(list(self.rew))[:-1]  # [n, L] == r_{t-n..t-1}
+        d = np.stack(list(self.term))[:-1]  # [n, L]
+        alive = np.cumprod(1.0 - d[:-1].astype(np.float32), axis=0)
+        alive = np.concatenate([np.ones((1, L), np.float32), alive], axis=0)
+        gammas = self.gamma ** np.arange(self.n, dtype=np.float32)
+        rn = (r * alive * gammas[:, None]).sum(axis=0)
+        no_done = 1.0 - d.any(axis=0).astype(np.float32)
+        boot = (self.gamma**self.n) * q_values.max(axis=1) * no_done
+        return np.abs(rn + boot - self.q_sel[0]).astype(np.float64)
+
+
+class ApexDriver:
+    """Owns meshes, sharded compute fns, and the stale actor-param copy."""
+
+    def __init__(
+        self,
+        cfg: Config,
+        num_actions: int,
+        devices: Optional[Sequence[jax.Device]] = None,
+        state_shape: Optional[Tuple[int, ...]] = None,
+    ):
+        self.cfg = cfg
+        self.num_actions = num_actions
+        ldevs, adevs = split_devices(devices, cfg.learner_devices)
+        self.lmesh = learner_mesh(ldevs)
+        self.amesh = actor_mesh(adevs)
+        self.n_actor_devices = len(adevs)
+
+        rep_l, rep_a = replicated(self.lmesh), replicated(self.amesh)
+        self.key = jax.random.PRNGKey(cfg.seed)
+        self.key, k_init = jax.random.split(self.key)
+        state = init_train_state(cfg, num_actions, k_init, state_shape=state_shape)
+        self.state: TrainState = jax.device_put(state, rep_l)
+
+        # learner step: batch split over dp, state replicated; XLA inserts the
+        # gradient all-reduce (psum over "dp") from the sharding alone.
+        self._learn = jax.jit(
+            build_learn_step(cfg, num_actions),
+            in_shardings=(rep_l, batch_sharding(self.lmesh, "dp"), rep_l),
+            donate_argnums=0,
+        )
+        # actor step: lanes split over the actor mesh, params replicated.
+        lane_sh = batch_sharding(self.amesh, "actor")
+        self._act = jax.jit(
+            build_act_step(cfg, num_actions, use_noise=True),
+            in_shardings=(rep_a, lane_sh, rep_a),
+            out_shardings=(lane_sh, lane_sh),
+        )
+        if cfg.bf16_weight_sync:
+            self._cast = jax.jit(
+                lambda p: jax.tree.map(lambda x: x.astype(jnp.bfloat16), p)
+            )
+            self._uncast = jax.jit(
+                lambda p: jax.tree.map(lambda x: x.astype(jnp.float32), p),
+                out_shardings=rep_a,
+            )
+        self.actor_params = None
+        self.publish_weights()  # initial broadcast
+
+    # ------------------------------------------------------------- weight sync
+    def publish_weights(self) -> None:
+        """Learner -> actor-mesh broadcast (the Redis SET + actor GET pair)."""
+        p = self.state.params
+        if self.cfg.bf16_weight_sync:
+            p = self._uncast(jax.device_put(self._cast(p), replicated(self.amesh)))
+        else:
+            p = jax.device_put(p, replicated(self.amesh))
+        self.actor_params = p
+
+    # ----------------------------------------------------------------- compute
+    def _next_key(self):
+        self.key, k = jax.random.split(self.key)
+        return k
+
+    def act(self, stacked_obs: np.ndarray) -> Tuple[np.ndarray, np.ndarray]:
+        a, q = self._act(self.actor_params, jnp.asarray(stacked_obs), self._next_key())
+        return np.asarray(a), np.asarray(q)
+
+    def learn(self, sample) -> Dict[str, Any]:
+        batch = Batch(
+            obs=jnp.asarray(sample.obs),
+            action=jnp.asarray(sample.action),
+            reward=jnp.asarray(sample.reward),
+            next_obs=jnp.asarray(sample.next_obs),
+            discount=jnp.asarray(sample.discount),
+            weight=jnp.asarray(sample.weight),
+        )
+        self.state, info = self._learn(self.state, batch, self._next_key())
+        return info
+
+    @property
+    def step(self) -> int:
+        return int(self.state.step)
+
+
+def _eval_learner(cfg: Config, env, driver: "ApexDriver") -> Dict[str, Any]:
+    """Evaluate the LEARNER's current params (reference evaluates the learner
+    checkpoint, SURVEY §3.5) on a single-device eval agent."""
+    from rainbow_iqn_apex_tpu.agents.agent import Agent
+    from rainbow_iqn_apex_tpu.eval import evaluate
+
+    eval_agent = Agent(
+        cfg,
+        env.num_actions,
+        jax.random.PRNGKey(cfg.seed + 1),
+        train=False,
+        state_shape=(*env.frame_shape, cfg.history_length),
+    )
+    eval_agent.state = jax.device_put(driver.state, jax.devices()[0])
+    return evaluate(cfg, eval_agent, seed=cfg.seed + 977)
+
+
+def train_apex(cfg: Config, max_frames: Optional[int] = None) -> Dict[str, Any]:
+    """The full Ape-X loop on one host's slice (SURVEY §3.1 + §3.2 fused)."""
+    total_frames = max_frames or cfg.t_max
+    lanes = cfg.num_actors * cfg.num_envs_per_actor
+    env = make_vector_env(cfg.env_id, lanes, seed=cfg.seed)
+    driver = ApexDriver(
+        cfg, env.num_actions, state_shape=(*env.frame_shape, cfg.history_length)
+    )
+    if lanes % driver.n_actor_devices:
+        raise ValueError(
+            f"total lanes {lanes} must divide across {driver.n_actor_devices} "
+            "actor devices"
+        )
+
+    memory = ShardedReplay.build(
+        cfg.replay_shards,
+        cfg.memory_capacity,
+        lanes,
+        frame_shape=env.frame_shape,
+        history=cfg.history_length,
+        n_step=cfg.multi_step,
+        gamma=cfg.gamma,
+        priority_exponent=cfg.priority_exponent,
+        priority_eps=cfg.priority_eps,
+        seed=cfg.seed,
+        use_native=cfg.use_native_sumtree,
+    )
+    import os
+
+    from rainbow_iqn_apex_tpu.train import priority_beta
+
+    run_dir = os.path.join(cfg.results_dir, cfg.run_id)
+    metrics = MetricsLogger(os.path.join(run_dir, "metrics.jsonl"), cfg.run_id)
+    ckpt = Checkpointer(os.path.join(cfg.checkpoint_dir, cfg.run_id))
+
+    estimator = (
+        ActorPriorityEstimator(lanes, cfg.multi_step, cfg.gamma)
+        if cfg.initial_priority_from_actor
+        else None
+    )
+    stacker = FrameStacker(lanes, env.frame_shape, cfg.history_length)
+    obs = env.reset()
+    returns: collections.deque = collections.deque(maxlen=100)
+    frames = 0
+    last_pub = 0
+
+    while frames < total_frames:
+        stacked = stacker.push(obs)
+        actions, q = driver.act(stacked)
+        new_obs, rewards, terminals, ep_returns = env.step(actions)
+        pri = estimator.push(q, actions, rewards, terminals) if estimator else None
+        memory.append_batch(obs, actions, rewards, terminals, pri)
+        stacker.reset_lanes(terminals)
+        obs = new_obs
+        frames += lanes
+        for r in ep_returns[~np.isnan(ep_returns)]:
+            returns.append(float(r))
+
+        if len(memory) >= cfg.learn_start and memory.sampleable:
+            steps_due = frames // cfg.replay_ratio - driver.step
+            for _ in range(max(steps_due, 0)):
+                sample = memory.sample(cfg.batch_size, priority_beta(cfg, frames))
+                info = driver.learn(sample)
+                memory.update_priorities(sample.idx, np.asarray(info["priorities"]))
+                step = driver.step
+                if step - last_pub >= cfg.weight_publish_interval:
+                    driver.publish_weights()
+                    last_pub = step
+                if step % cfg.metrics_interval == 0:
+                    metrics.log(
+                        "train",
+                        step=step,
+                        frames=frames,
+                        fps=metrics.fps(frames),
+                        loss=float(info["loss"]),
+                        q_mean=float(info["q_mean"]),
+                        mean_return=float(np.mean(returns)) if returns else float("nan"),
+                        staleness=step - last_pub,
+                    )
+                if cfg.eval_interval and step % cfg.eval_interval == 0:
+                    metrics.log(
+                        "eval", step=step, **_eval_learner(cfg, env, driver)
+                    )
+                if cfg.checkpoint_interval and step % cfg.checkpoint_interval == 0:
+                    ckpt.save(step, driver.state, {"frames": frames})
+
+    final_eval = _eval_learner(cfg, env, driver)
+    metrics.log("eval", step=driver.step, **final_eval)
+    ckpt.save(driver.step, driver.state, {"frames": frames})
+    ckpt.wait()
+    metrics.close()
+    return {
+        "frames": frames,
+        "learn_steps": driver.step,
+        "lanes": lanes,
+        "train_return_mean": float(np.mean(returns)) if returns else float("nan"),
+        **{f"eval_{k}": v for k, v in final_eval.items()},
+    }

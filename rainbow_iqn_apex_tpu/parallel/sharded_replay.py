@@ -1,0 +1,144 @@
+"""Sharded prioritized replay — the Redis-shard topology in host DRAM.
+
+Parity: reference component row 6 (SURVEY.md §2): replay contents sharded
+across multiple redis-server instances so many actors append and one learner
+samples, with remote priority write-back.  Here each shard is a
+PrioritizedReplay owned by the host (one per pod host in the multi-host
+picture; several in-process shards model the same topology single-host), and
+"remote" traffic becomes NumPy writes — the learner's sample mixes
+sub-batches drawn from every shard in proportion to total shard priority
+mass, which is exactly proportional global sampling (the same distribution a
+single giant tree would give).
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from rainbow_iqn_apex_tpu.replay.buffer import PrioritizedReplay, SampledBatch
+
+
+class ShardedReplay:
+    """K independent PER shards behind the single-buffer interface.
+
+    Lane -> shard assignment is static (contiguous blocks), mirroring the
+    reference's actor->redis-shard pinning; global slot ids are
+    (shard_id * shard_capacity + local_slot).
+    """
+
+    def __init__(self, shards: Sequence[PrioritizedReplay]):
+        if not shards:
+            raise ValueError("need at least one shard")
+        caps = {s.capacity for s in shards}
+        if len(caps) != 1:
+            raise ValueError("all shards must share a capacity")
+        self.shards: List[PrioritizedReplay] = list(shards)
+        self.shard_capacity = shards[0].capacity
+        self.lanes_per_shard = shards[0].lanes
+        self.rng = np.random.default_rng(shards[0].rng.integers(2**31))
+
+    @classmethod
+    def build(
+        cls, num_shards: int, capacity_total: int, lanes_total: int, **kwargs
+    ) -> "ShardedReplay":
+        if capacity_total % num_shards or lanes_total % num_shards:
+            raise ValueError("capacity and lanes must divide evenly into shards")
+        seed = kwargs.pop("seed", 0)
+        shards = [
+            PrioritizedReplay(
+                capacity_total // num_shards,
+                lanes=lanes_total // num_shards,
+                seed=seed + 1000 * k,
+                **kwargs,
+            )
+            for k in range(num_shards)
+        ]
+        return cls(shards)
+
+    # ------------------------------------------------------------------ append
+    def append_batch(
+        self,
+        frames: np.ndarray,
+        actions: np.ndarray,
+        rewards: np.ndarray,
+        terminals: np.ndarray,
+        priorities: Optional[np.ndarray] = None,
+    ) -> None:
+        """Lockstep append of all lanes, block-partitioned across shards."""
+        lps = self.lanes_per_shard
+        for k, shard in enumerate(self.shards):
+            sl = slice(k * lps, (k + 1) * lps)
+            shard.append_batch(
+                frames[sl],
+                actions[sl],
+                rewards[sl],
+                terminals[sl],
+                None if priorities is None else priorities[sl],
+            )
+
+    def __len__(self) -> int:
+        return sum(len(s) for s in self.shards)
+
+    @property
+    def sampleable(self) -> bool:
+        return all(s.sampleable for s in self.shards)
+
+    # ------------------------------------------------------------------ sample
+    def sample(self, batch_size: int, beta: float) -> SampledBatch:
+        """Proportional global sample: shard k contributes ~ its share of the
+        total priority mass (multinomial split), then samples locally."""
+        totals = np.asarray([s.tree.total for s in self.shards], np.float64)
+        if totals.sum() <= 0:
+            raise ValueError("cannot sample: all shards empty")
+        counts = self.rng.multinomial(batch_size, totals / totals.sum())
+        # a zero-count shard simply doesn't contribute this batch (matches
+        # multi-redis sampling); the multinomial split makes the overall draw
+        # exactly proportional to global priority mass.
+        parts: List[SampledBatch] = []
+        probs: List[np.ndarray] = []
+        n_global = len(self)
+        for k, (shard, c) in enumerate(zip(self.shards, counts)):
+            if c == 0:
+                continue
+            b = shard.sample(int(c), beta)
+            parts.append(
+                SampledBatch(
+                    idx=b.idx + k * self.shard_capacity,
+                    obs=b.obs,
+                    action=b.action,
+                    reward=b.reward,
+                    next_obs=b.next_obs,
+                    discount=b.discount,
+                    weight=b.weight,  # replaced below with the global version
+                    prob=b.prob,
+                )
+            )
+            # global sample probability: local prob scaled by the shard's
+            # share of total priority mass
+            probs.append(b.prob * (totals[k] / totals.sum()))
+
+        cat = lambda f: np.concatenate([getattr(p, f) for p in parts])  # noqa: E731
+        prob = np.concatenate(probs)
+        weight = (n_global * np.maximum(prob, 1e-12)) ** (-beta)
+        weight = (weight / weight.max()).astype(np.float32)
+        return SampledBatch(
+            idx=cat("idx"),
+            obs=cat("obs"),
+            action=cat("action"),
+            reward=cat("reward"),
+            next_obs=cat("next_obs"),
+            discount=cat("discount"),
+            weight=weight,
+            prob=prob,
+        )
+
+    # -------------------------------------------------------------- priorities
+    def update_priorities(self, idx: np.ndarray, td_abs: np.ndarray) -> None:
+        shard_of = idx // self.shard_capacity
+        local = idx % self.shard_capacity
+        for k, shard in enumerate(self.shards):
+            m = shard_of == k
+            if m.any():
+                shard.update_priorities(local[m], td_abs[m])

@@ -1,3 +1,11 @@
+from rainbow_iqn_apex_tpu.replay.buffer import PrioritizedReplay, SampledBatch
+from rainbow_iqn_apex_tpu.replay.native import NativeSumTree, native_available
 from rainbow_iqn_apex_tpu.replay.sumtree import SumTree
 
-__all__ = ["SumTree"]
+__all__ = [
+    "PrioritizedReplay",
+    "SampledBatch",
+    "SumTree",
+    "NativeSumTree",
+    "native_available",
+]

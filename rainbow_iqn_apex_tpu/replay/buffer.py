@@ -1,0 +1,249 @@
+"""Prioritized replay memory with n-step assembly and frame-dedup storage.
+
+Parity: reference `rainbowiqn/memory.py` `ReplayMemory` (SURVEY.md §2 row 5):
+proportional prioritization over p^omega, stratified batch sampling,
+importance-sampling weights (N * P(i))^-beta normalised by the batch max,
+n-step transition assembly from a ring buffer, and frame de-duplication —
+each 84x84 frame is stored once and stacks are reconstructed at sample time.
+
+TPU-first design notes:
+- Everything is dense NumPy on the host; the device only ever sees the
+  assembled [B, H, W, C] uint8 batch (SURVEY §7: "host replay, device
+  batches").  Sampling cost is dominated by two fancy-indexed gathers.
+- Multi-lane layout: a batched vector env steps L environments in lockstep
+  (the TPU-native actor shape). Each lane owns a contiguous ring segment of
+  the buffer so episode adjacency — which both frame-stack reconstruction
+  and n-step assembly rely on — is preserved per lane, with one global
+  sum-tree over all slots.  This replaces the reference's one-process-one-
+  buffer adjacency assumption without giving up dedup.
+- The sum-tree hot path can be served by the C++ core (replay/native.py)
+  with identical layout; `SumTree` is the NumPy fallback.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional, Tuple
+
+import numpy as np
+
+from rainbow_iqn_apex_tpu.replay.sumtree import SumTree
+
+
+@dataclasses.dataclass
+class SampledBatch:
+    """Host-side sample, ready to ship to the device as one transfer."""
+
+    idx: np.ndarray  # [B] int64 global slot ids (for update_priorities)
+    obs: np.ndarray  # [B, H, W, hist] uint8
+    action: np.ndarray  # [B] int32
+    reward: np.ndarray  # [B] float32 — n-step discounted return
+    next_obs: np.ndarray  # [B, H, W, hist] uint8
+    discount: np.ndarray  # [B] float32 — gamma^n * (1 - done-within-n)
+    weight: np.ndarray  # [B] float32 — IS weights, max-normalised
+    prob: np.ndarray = None  # [B] float64 — buffer-local sample probability
+    # (kept alongside weight so sharded replay can re-derive globally
+    # consistent IS weights; see parallel/sharded_replay.py)
+
+
+class PrioritizedReplay:
+    """Proportional PER over a multi-lane ring of de-duplicated frames.
+
+    Per-timestep record (lane-local index t): the newest preprocessed frame
+    f_t (the last slice of the state the action was chosen from), the action
+    a_t, the resulting reward r_t and terminal flag d_t.
+    """
+
+    def __init__(
+        self,
+        capacity: int,
+        frame_shape: Tuple[int, int],
+        history: int = 4,
+        n_step: int = 3,
+        gamma: float = 0.99,
+        lanes: int = 1,
+        priority_exponent: float = 0.5,
+        priority_eps: float = 1e-6,
+        seed: int = 0,
+        use_native: bool = True,
+    ):
+        if capacity % lanes != 0:
+            raise ValueError(f"capacity {capacity} not divisible by lanes {lanes}")
+        self.capacity = capacity
+        self.lanes = lanes
+        self.seg = capacity // lanes  # slots per lane ring
+        if self.seg <= history + n_step:
+            raise ValueError("per-lane segment too small for history + n_step")
+        self.history = history
+        self.n_step = n_step
+        self.gamma = gamma
+        self.omega = priority_exponent
+        self.eps = priority_eps
+        self.rng = np.random.default_rng(seed)
+
+        h, w = frame_shape
+        self.frames = np.zeros((capacity, h, w), dtype=np.uint8)
+        self.actions = np.zeros(capacity, dtype=np.int32)
+        self.rewards = np.zeros(capacity, dtype=np.float32)
+        self.terminals = np.zeros(capacity, dtype=bool)
+
+        self.tree: SumTree
+        if use_native:
+            from rainbow_iqn_apex_tpu.replay.native import NativeSumTree, native_available
+
+            self.tree = NativeSumTree(capacity) if native_available() else SumTree(capacity)
+        else:
+            self.tree = SumTree(capacity)
+
+        self.pos = 0  # lane-local write cursor (lockstep across lanes)
+        self.filled = 0  # lane-local count of written slots (<= seg)
+        self.max_priority = 1.0  # tree-space (already ^omega) value for new items
+
+        # discount ladder gamma^0..gamma^n, reused every sample
+        self._gammas = self.gamma ** np.arange(self.n_step + 1, dtype=np.float32)
+        self._lane_base = np.arange(self.lanes, dtype=np.int64) * self.seg
+
+    # ------------------------------------------------------------------ append
+    def append_batch(
+        self,
+        frames: np.ndarray,  # [L, H, W] uint8
+        actions: np.ndarray,  # [L]
+        rewards: np.ndarray,  # [L]
+        terminals: np.ndarray,  # [L] bool
+        priorities: Optional[np.ndarray] = None,  # [L] raw |TD| (Ape-X actors)
+    ) -> np.ndarray:
+        """Append one lockstep step of all lanes. Returns global slot ids."""
+        L = frames.shape[0]
+        if L != self.lanes:
+            raise ValueError(f"expected {self.lanes} lanes, got {L}")
+        slots = self._lane_base + self.pos
+        self.frames[slots] = frames
+        self.actions[slots] = actions
+        self.rewards[slots] = rewards
+        self.terminals[slots] = terminals
+
+        # One fused priority write per step covers three DISJOINT slot groups
+        # (disjointness holds because seg > history + n_step):
+        #  - the fresh slot: not yet sampleable, its n-step future is missing;
+        #  - the slot written n_step appends ago: its future is now complete
+        #    -> eligible. When actors supply an initial priority (Ape-X), it
+        #    is the priority of THAT completed transition, not of this frame;
+        #  - the cursor dead zone [new_pos, new_pos+history-1]: slots whose
+        #    lookback window would cross the write cursor and mix frames from
+        #    two different ring laps. (While the buffer is young these are
+        #    unwritten and already zero — harmless.)
+        new_pos = (self.pos + 1) % self.seg
+        dead = (new_pos + np.arange(self.history)) % self.seg
+        dead_slots = (self._lane_base[:, None] + dead[None, :]).ravel()
+        upd_idx = [slots, dead_slots]
+        upd_pri = [np.zeros(self.lanes), np.zeros(dead_slots.size)]
+        if self.filled >= self.n_step:
+            ready = (self.pos - self.n_step) % self.seg
+            if priorities is None:
+                pri = np.full(self.lanes, self.max_priority)
+            else:
+                pri = (np.asarray(priorities, np.float64) + self.eps) ** self.omega
+                self.max_priority = max(self.max_priority, float(pri.max()))
+            upd_idx.append(self._lane_base + ready)
+            upd_pri.append(pri)
+        self.tree.set(np.concatenate(upd_idx), np.concatenate(upd_pri))
+
+        self.pos = new_pos
+        self.filled = min(self.filled + 1, self.seg)
+        return slots
+
+    def append(self, frame, action, reward, terminal, priority=None) -> int:
+        """Single-lane convenience (reference's per-process API shape)."""
+        pri = None if priority is None else np.asarray([priority])
+        return int(
+            self.append_batch(
+                np.asarray(frame)[None],
+                np.asarray([action]),
+                np.asarray([reward], np.float32),
+                np.asarray([terminal]),
+                pri,
+            )[0]
+        )
+
+    def __len__(self) -> int:
+        return self.filled * self.lanes
+
+    @property
+    def sampleable(self) -> bool:
+        return self.tree.total > 0
+
+    # ------------------------------------------------------------------ sample
+    def _gather_stacks(self, lane: np.ndarray, off: np.ndarray) -> np.ndarray:
+        """Frame stacks ending at lane-local offset `off`: [B, H, W, history].
+
+        Frames from before the episode start (a terminal strictly inside the
+        lookback window) are zeroed — the reference's reset-time zero-stack
+        semantics without storing the zero frames.
+        """
+        B = off.shape[0]
+        steps = np.arange(-(self.history - 1), 1)  # [-h+1 .. 0]
+        offs = (off[:, None] + steps[None, :]) % self.seg  # [B, h]
+        slots = lane[:, None] * self.seg + offs
+        stacks = self.frames[slots]  # [B, h, H, W]
+
+        # terminal at window position j (j < h-1) kills frames [.. j]
+        term = self.terminals[slots[:, :-1]]  # [B, h-1]
+        dead_tail = np.cumsum(term[:, ::-1], axis=1)[:, ::-1] > 0  # any terminal at/after j
+        valid = np.concatenate([~dead_tail, np.ones((B, 1), bool)], axis=1)
+        # frames older than what's been written in a young buffer are invalid too
+        if self.filled < self.seg:
+            age_ok = (off[:, None] + steps[None, :]) >= 0
+            valid &= age_ok
+        stacks = stacks * valid[:, :, None, None].astype(np.uint8)
+        return np.moveaxis(stacks, 1, -1)  # [B, H, W, h]
+
+    def sample(self, batch_size: int, beta: float) -> SampledBatch:
+        """Stratified proportional sample + n-step assembly + IS weights."""
+        idx, prob = self.tree.sample_stratified(batch_size, self.rng)
+        prob = np.maximum(prob, 1e-12)  # fp edge-fall can land on a zero leaf
+        lane = idx // self.seg
+        off = idx % self.seg
+
+        # --- n-step scan (vectorised over the batch) ---------------------
+        steps = np.arange(self.n_step)
+        f_offs = (off[:, None] + steps[None, :]) % self.seg  # [B, n]
+        f_slots = lane[:, None] * self.seg + f_offs
+        r = self.rewards[f_slots]  # [B, n]
+        d = self.terminals[f_slots]  # [B, n]
+        # alive[k] = no terminal strictly before step k
+        alive = np.cumprod(1.0 - d[:, :-1].astype(np.float32), axis=1)
+        alive = np.concatenate([np.ones((batch_size, 1), np.float32), alive], axis=1)
+        reward = (r * alive * self._gammas[None, : self.n_step]).sum(axis=1)
+        done_within = d.any(axis=1)
+        discount = np.where(done_within, 0.0, self._gammas[self.n_step]).astype(
+            np.float32
+        )
+
+        obs = self._gather_stacks(lane, off)
+        next_obs = self._gather_stacks(lane, (off + self.n_step) % self.seg)
+
+        # --- IS weights ---------------------------------------------------
+        n = len(self)
+        weights = (n * prob) ** (-beta)
+        weights = (weights / weights.max()).astype(np.float32)
+
+        return SampledBatch(
+            idx=idx,
+            obs=obs,
+            action=self.actions[lane * self.seg + off],
+            reward=reward.astype(np.float32),
+            next_obs=next_obs,
+            discount=discount,
+            weight=weights,
+            prob=prob,
+        )
+
+    # -------------------------------------------------------------- priorities
+    def update_priorities(self, idx: np.ndarray, td_abs: np.ndarray) -> None:
+        """Learner write-back: p = (|TD| + eps)^omega (reference semantics)."""
+        pri = (np.asarray(td_abs, np.float64) + self.eps) ** self.omega
+        self.max_priority = max(self.max_priority, float(pri.max()))
+        # Never resurrect slots the cursor has since invalidated.
+        current = self.tree.get(np.asarray(idx))
+        pri = np.where(current > 0, pri, 0.0)
+        self.tree.set(idx, pri)

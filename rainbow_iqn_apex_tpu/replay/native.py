@@ -1,0 +1,126 @@
+"""ctypes binding for the C++ sum-tree core (replay/native/sumtree.cc).
+
+Builds the shared library on first use with g++ (toolchain is baked into the
+image; no pip/pybind11 needed) and caches it next to the source.  Falls back
+silently to the NumPy implementation when no compiler is available —
+``native_available()`` is the gate.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from typing import Optional, Tuple
+
+import numpy as np
+
+from rainbow_iqn_apex_tpu.replay.sumtree import SumTree
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_SRC = os.path.join(_HERE, "native", "sumtree.cc")
+
+
+def _so_path() -> str:
+    """Cache path keyed by source hash: a stale or foreign-host binary (built
+    with -march=native elsewhere) is never loaded — any source change or
+    fresh checkout gets its own artifact name and triggers a rebuild."""
+    import hashlib
+
+    with open(_SRC, "rb") as f:
+        h = hashlib.sha256(f.read()).hexdigest()[:16]
+    return os.path.join(_HERE, "native", f"_sumtree_{h}.so")
+
+
+_SO = _so_path()
+
+_lock = threading.Lock()
+_lib: Optional[ctypes.CDLL] = None
+_tried = False
+
+_i64p = np.ctypeslib.ndpointer(np.int64, flags="C_CONTIGUOUS")
+_f64p = np.ctypeslib.ndpointer(np.float64, flags="C_CONTIGUOUS")
+
+
+def _build_and_load() -> Optional[ctypes.CDLL]:
+    global _lib, _tried
+    with _lock:
+        if _lib is not None or _tried:
+            return _lib
+        _tried = True
+        try:
+            if not os.path.exists(_SO):  # name is content-hashed: exists == fresh
+                subprocess.run(
+                    ["g++", "-O3", "-march=native", "-shared", "-fPIC", _SRC, "-o", _SO],
+                    check=True,
+                    capture_output=True,
+                    timeout=120,
+                )
+            lib = ctypes.CDLL(_SO)
+            lib.st_set.argtypes = [_f64p, ctypes.c_int64, _i64p, _f64p, ctypes.c_int64]
+            lib.st_set.restype = None
+            lib.st_find_prefix.argtypes = [
+                _f64p, ctypes.c_int64, ctypes.c_int64, _f64p, _i64p, ctypes.c_int64,
+            ]
+            lib.st_find_prefix.restype = None
+            lib.st_sample.argtypes = [
+                _f64p, ctypes.c_int64, ctypes.c_int64, _f64p, _i64p, _f64p,
+                ctypes.c_int64,
+            ]
+            lib.st_sample.restype = None
+            _lib = lib
+        except Exception:
+            _lib = None
+        return _lib
+
+
+def native_available() -> bool:
+    return _build_and_load() is not None
+
+
+class NativeSumTree(SumTree):
+    """Drop-in SumTree with the set/find hot loops in C++.
+
+    Same flat-array layout and numerics as the NumPy SumTree (the fuzz test
+    runs both against each other); storage stays a NumPy array so snapshots
+    and the rest of the Python API are unchanged.
+    """
+
+    def __init__(self, capacity: int):
+        super().__init__(capacity)
+        self._lib = _build_and_load()
+        if self._lib is None:
+            raise RuntimeError("native sum-tree unavailable (no compiler?)")
+
+    def set(self, idx: np.ndarray, priority: np.ndarray) -> None:
+        idx = np.ascontiguousarray(np.asarray(idx, np.int64).ravel())
+        pri = np.ascontiguousarray(
+            np.broadcast_to(np.asarray(priority, np.float64).ravel(), idx.shape)
+        )
+        if idx.size == 0:
+            return
+        if np.any(pri < 0) or not np.all(np.isfinite(pri)):
+            raise ValueError("priorities must be finite and non-negative")
+        self._lib.st_set(self.tree, self.span, idx, pri, idx.size)
+
+    def find_prefix(self, mass: np.ndarray) -> np.ndarray:
+        mass = np.ascontiguousarray(np.asarray(mass, np.float64).ravel())
+        out = np.empty(mass.size, np.int64)
+        self._lib.st_find_prefix(self.tree, self.span, self.capacity, mass, out, mass.size)
+        return out
+
+    def sample_stratified(
+        self, batch_size: int, rng: np.random.Generator
+    ) -> Tuple[np.ndarray, np.ndarray]:
+        total = self.total
+        if total <= 0:
+            raise ValueError("cannot sample from an empty tree")
+        seg = total / batch_size
+        mass = np.ascontiguousarray(
+            (np.arange(batch_size) + rng.random(batch_size)) * seg
+        )
+        idx = np.empty(batch_size, np.int64)
+        pri = np.empty(batch_size, np.float64)
+        self._lib.st_sample(self.tree, self.span, self.capacity, mass, idx, pri, batch_size)
+        return idx, pri / total

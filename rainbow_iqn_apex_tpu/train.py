@@ -1,0 +1,123 @@
+"""Single-process Rainbow-IQN training loop (reference parity: the 1-actor,
+no-Ape-X mode of `train_agent_apex.py`, SURVEY.md §3.1+§3.2 merged into one
+process — act/learn interleaved at `replay_ratio` env frames per learner step,
+scheduled target update, Orbax checkpoints, JSONL metrics, periodic eval).
+
+The Ape-X multi-role path lives in parallel/apex.py; this file is the
+minimum end-to-end slice (SURVEY.md §7 step 4).
+"""
+
+from __future__ import annotations
+
+import collections
+import os
+from typing import Any, Dict, Optional
+
+import jax
+import numpy as np
+
+from rainbow_iqn_apex_tpu.agents.agent import Agent, FrameStacker
+from rainbow_iqn_apex_tpu.config import Config
+from rainbow_iqn_apex_tpu.envs import make_vector_env
+from rainbow_iqn_apex_tpu.eval import evaluate
+from rainbow_iqn_apex_tpu.replay.buffer import PrioritizedReplay
+from rainbow_iqn_apex_tpu.utils.checkpoint import Checkpointer
+from rainbow_iqn_apex_tpu.utils.logging import MetricsLogger
+
+
+def priority_beta(cfg: Config, frames: int) -> float:
+    """Linear beta_0 -> 1 anneal over the training budget (reference IS
+    schedule, SURVEY §2 row 1)."""
+    frac = min(frames / max(cfg.t_max, 1), 1.0)
+    return cfg.priority_weight + (1.0 - cfg.priority_weight) * frac
+
+
+def train(cfg: Config, max_frames: Optional[int] = None) -> Dict[str, Any]:
+    """Runs training; returns a summary dict (final eval, fps, steps)."""
+    total_frames = max_frames or cfg.t_max
+    lanes = cfg.num_envs_per_actor
+    env = make_vector_env(cfg.env_id, lanes, seed=cfg.seed)
+
+    agent = Agent(
+        cfg,
+        env.num_actions,
+        jax.random.PRNGKey(cfg.seed),
+        state_shape=(*env.frame_shape, cfg.history_length),
+    )
+    memory = PrioritizedReplay(
+        cfg.memory_capacity,
+        env.frame_shape,
+        history=cfg.history_length,
+        n_step=cfg.multi_step,
+        gamma=cfg.gamma,
+        lanes=lanes,
+        priority_exponent=cfg.priority_exponent,
+        priority_eps=cfg.priority_eps,
+        seed=cfg.seed,
+        use_native=cfg.use_native_sumtree,
+    )
+    run_dir = os.path.join(cfg.results_dir, cfg.run_id)
+    metrics = MetricsLogger(os.path.join(run_dir, "metrics.jsonl"), cfg.run_id)
+    ckpt = Checkpointer(os.path.join(cfg.checkpoint_dir, cfg.run_id))
+
+    frames = 0
+    if cfg.resume and ckpt.latest_step() is not None:
+        agent.state, extra = ckpt.restore(agent.state)
+        frames = int(extra.get("frames", 0))
+        metrics.log("resume", step=agent.step, frames=frames)
+
+    stacker = FrameStacker(lanes, env.frame_shape, cfg.history_length)
+    obs = env.reset()
+    returns: collections.deque = collections.deque(maxlen=100)
+    last_eval: Dict[str, Any] = {}
+
+    while frames < total_frames:
+        stacked = stacker.push(obs)
+        actions = agent.act(stacked)
+        new_obs, rewards, terminals, ep_returns = env.step(actions)
+        # store the pre-step frame with the transition's reward/terminal
+        # (reference memory layout: SURVEY §2 row 5 frame-dedup scheme)
+        memory.append_batch(obs, actions, rewards, terminals)
+        stacker.reset_lanes(terminals)
+        obs = new_obs
+        frames += lanes
+        for r in ep_returns[~np.isnan(ep_returns)]:
+            returns.append(float(r))
+
+        # one learner step per `replay_ratio` env frames once warm
+        if len(memory) >= cfg.learn_start and memory.sampleable:
+            steps_due = frames // cfg.replay_ratio - agent.step
+            for _ in range(max(steps_due, 0)):
+                sample = memory.sample(cfg.batch_size, priority_beta(cfg, frames))
+                info = agent.learn(sample)
+                memory.update_priorities(sample.idx, np.asarray(info["priorities"]))
+
+                step = agent.step
+                if step % cfg.metrics_interval == 0:
+                    metrics.log(
+                        "train",
+                        step=step,
+                        frames=frames,
+                        fps=metrics.fps(frames),
+                        loss=float(info["loss"]),
+                        q_mean=float(info["q_mean"]),
+                        grad_norm=float(info["grad_norm"]),
+                        mean_return=float(np.mean(returns)) if returns else float("nan"),
+                    )
+                if cfg.eval_interval and step % cfg.eval_interval == 0:
+                    last_eval = evaluate(cfg, agent, seed=cfg.seed + 977)
+                    metrics.log("eval", step=step, **last_eval)
+                if cfg.checkpoint_interval and step % cfg.checkpoint_interval == 0:
+                    ckpt.save(step, agent.state, {"frames": frames})
+
+    final_eval = evaluate(cfg, agent, seed=cfg.seed + 977)
+    metrics.log("eval", step=agent.step, **final_eval)
+    ckpt.save(agent.step, agent.state, {"frames": frames})
+    ckpt.wait()
+    metrics.close()
+    return {
+        "frames": frames,
+        "learn_steps": agent.step,
+        "train_return_mean": float(np.mean(returns)) if returns else float("nan"),
+        **{f"eval_{k}": v for k, v in final_eval.items()},
+    }

@@ -1,0 +1,4 @@
+from rainbow_iqn_apex_tpu.utils.checkpoint import Checkpointer
+from rainbow_iqn_apex_tpu.utils.logging import MetricsLogger
+
+__all__ = ["Checkpointer", "MetricsLogger"]

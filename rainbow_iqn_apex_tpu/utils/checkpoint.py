@@ -1,0 +1,66 @@
+"""Checkpoint / resume via Orbax.
+
+Parity: reference saves model weights with torch.save on an interval and at
+eval time (SURVEY.md §5 "Checkpoint/resume"); resume = load weights + refill
+replay.  Here the full TrainState (params, target params, optimizer state,
+step counter) plus the actor RNG seed state and env-frame counter are saved,
+so resume is exact for the learner and statistically faithful for actors.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import numpy as np
+import orbax.checkpoint as ocp
+
+from rainbow_iqn_apex_tpu.ops.learn import TrainState
+
+
+class Checkpointer:
+    def __init__(self, directory: str, max_to_keep: int = 3):
+        self.directory = os.path.abspath(directory)
+        os.makedirs(self.directory, exist_ok=True)
+        self._mngr = ocp.CheckpointManager(
+            self.directory,
+            options=ocp.CheckpointManagerOptions(
+                max_to_keep=max_to_keep, create=True
+            ),
+        )
+
+    def save(self, step: int, state: TrainState, extra: Optional[Dict[str, Any]] = None) -> None:
+        self._mngr.save(
+            step,
+            args=ocp.args.Composite(
+                state=ocp.args.StandardSave(state),
+                extra=ocp.args.JsonSave(extra or {}),
+            ),
+        )
+
+    def wait(self) -> None:
+        self._mngr.wait_until_finished()
+
+    def latest_step(self) -> Optional[int]:
+        return self._mngr.latest_step()
+
+    def restore(
+        self, abstract_state: TrainState, step: Optional[int] = None
+    ) -> Tuple[TrainState, Dict[str, Any]]:
+        """Restore into the structure of ``abstract_state`` (shapes/dtypes)."""
+        step = self.latest_step() if step is None else step
+        if step is None:
+            raise FileNotFoundError(f"no checkpoint under {self.directory}")
+        template = jax.tree.map(np.asarray, abstract_state)
+        out = self._mngr.restore(
+            step,
+            args=ocp.args.Composite(
+                state=ocp.args.StandardRestore(template),
+                extra=ocp.args.JsonRestore(),
+            ),
+        )
+        return out["state"], out["extra"]
+
+    def close(self) -> None:
+        self._mngr.close()

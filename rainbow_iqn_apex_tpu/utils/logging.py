@@ -1,0 +1,64 @@
+"""Structured metrics + stdout logging.
+
+Parity: the reference logs episode scores to stdout and plots curves
+(SURVEY.md §5 "Metrics/logging"); the build contract upgrades this to
+structured JSONL rows (one object per line, machine-readable) plus the same
+human-readable stdout stream.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+from typing import Any, Dict, Optional
+
+
+class MetricsLogger:
+    """Append-only JSONL metrics with wall-clock stamps and an FPS meter."""
+
+    def __init__(self, path: Optional[str], run_id: str = "run", echo: bool = True):
+        self.path = path
+        self.echo = echo
+        self.run_id = run_id
+        self._fh = None
+        if path:
+            os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+            self._fh = open(path, "a", buffering=1)
+        self._t0 = time.time()
+        self._last_t: Optional[float] = None
+        self._last_frames = 0
+
+    def log(self, kind: str, **fields: Any) -> Dict[str, Any]:
+        row = {
+            "t": round(time.time() - self._t0, 3),
+            "run": self.run_id,
+            "kind": kind,
+            **fields,
+        }
+        if self._fh:
+            self._fh.write(json.dumps(row) + "\n")
+        if self.echo:
+            pretty = " ".join(
+                f"{k}={v:.4g}" if isinstance(v, float) else f"{k}={v}"
+                for k, v in fields.items()
+            )
+            print(f"[{row['t']:9.1f}s] {kind:8s} {pretty}", file=sys.stderr)
+        return row
+
+    def fps(self, frames: int) -> float:
+        """Rolling frames/sec between successive calls."""
+        now = time.time()
+        if self._last_t is None:
+            self._last_t, self._last_frames = now, frames
+            return 0.0
+        dt = max(now - self._last_t, 1e-9)
+        fps = (frames - self._last_frames) / dt
+        self._last_t, self._last_frames = now, frames
+        return fps
+
+    def close(self) -> None:
+        if self._fh:
+            self._fh.close()
+            self._fh = None

@@ -1,0 +1,43 @@
+#!/usr/bin/env python
+"""Evaluation entry point (name kept for parity with the reference's
+`test_agent.py`, BASELINE.json:5 / SURVEY.md §3.5): load a checkpoint, run
+SABER-protocol eval episodes, print score statistics as JSON."""
+
+import json
+
+import jax
+
+from rainbow_iqn_apex_tpu.agents.agent import Agent
+from rainbow_iqn_apex_tpu.config import parse_config
+from rainbow_iqn_apex_tpu.envs import make_env
+from rainbow_iqn_apex_tpu.eval import evaluate
+from rainbow_iqn_apex_tpu.utils.checkpoint import Checkpointer
+import os
+
+
+def main(argv=None) -> int:
+    cfg = parse_config(argv)
+    env = make_env(cfg.env_id, seed=cfg.seed)
+    agent = Agent(
+        cfg,
+        env.num_actions,
+        jax.random.PRNGKey(cfg.seed),
+        train=False,
+        state_shape=(*env.frame_shape, cfg.history_length),
+    )
+
+    ckpt_dir = os.path.join(cfg.checkpoint_dir, cfg.run_id)
+    ckpt = Checkpointer(ckpt_dir)
+    if ckpt.latest_step() is not None:
+        agent.state, _ = ckpt.restore(agent.state)
+    else:
+        print(f"warning: no checkpoint in {ckpt_dir}; evaluating a fresh net")
+
+    out = evaluate(cfg, agent, seed=cfg.seed + 977)
+    out["checkpoint_step"] = ckpt.latest_step()
+    print(json.dumps(out))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,222 @@
+"""Env-layer tests: toy envs, vector lockstep, and the SABER/DeepMind
+preprocessing stack driven through a fake ALE (SURVEY §4 'preprocessing
+golden-frames'; the RawAtari seam is SURVEY §7's 'env-injection seam')."""
+
+import numpy as np
+import pytest
+
+from rainbow_iqn_apex_tpu.envs import (
+    AtariEnv,
+    CatchEnv,
+    ChainEnv,
+    VectorEnv,
+    make_env,
+    make_vector_env,
+)
+
+
+# ---------------------------------------------------------------- toy envs
+def test_catch_catches_and_misses():
+    env = CatchEnv(size=6, cell=2, seed=3)
+    f = env.reset()
+    assert f.shape == (12, 12) and f.dtype == np.uint8
+    # play "stay": deterministic outcome depends on ball column
+    total = 0.0
+    for _ in range(env.size - 1):
+        ts = env.step(0)
+        total += ts.reward
+    assert ts.terminal
+    assert total in (-1.0, 1.0)
+    assert ts.info["episode_return"] == total
+
+
+def test_catch_perfect_policy_always_wins():
+    env = CatchEnv(size=8, cell=1, seed=0)
+    for _ in range(20):
+        env.reset()
+        done = False
+        while not done:
+            move = 0 if env.paddle == env.ball_col else (1 if env.ball_col < env.paddle else 2)
+            ts = env.step(move)
+            done = ts.terminal
+        assert ts.reward == 1.0
+
+
+def test_chain_optimal_vs_myopic():
+    env = ChainEnv(length=5)
+    env.reset()
+    r = 0.0
+    for _ in range(4):
+        ts = env.step(1)
+        r += ts.reward
+    assert ts.terminal and r == 1.0
+    env.reset()
+    ts = env.step(0)
+    assert ts.terminal and ts.reward == 0.1
+
+
+def test_vector_env_lockstep_autoreset():
+    env = make_vector_env("toy:catch", 3, seed=0)
+    obs = env.reset()
+    assert obs.shape == (3, 80, 80)
+    done_seen = False
+    for t in range(30):
+        obs, rew, term, ep_ret = env.step(np.zeros(3, np.int64))
+        assert obs.shape == (3, 80, 80)
+        if term.any():
+            done_seen = True
+            # auto-reset: returned obs is the new episode's first frame (ball row 0)
+            i = int(np.flatnonzero(term)[0])
+            assert not np.isnan(ep_ret[i])
+    assert done_seen
+
+
+# ------------------------------------------------------------ fake-ALE SABER
+class FakeALE:
+    """Scripted ALE: pixel = frame counter; reward = action; 2 lives.
+
+    Life is lost every 10th act; game over after 2 losses. Deterministic and
+    transparent so every preprocessing step is checkable.
+    """
+
+    def __init__(self, raw_shape=(20, 16)):
+        self.num_actions = 4
+        self.raw_shape = raw_shape
+        self.t = 0
+        self.acts = 0
+        self._lives = 2
+        self.actions_taken = []
+
+    def reset(self):
+        self.t = 0
+        self.acts = 0
+        self._lives = 2
+        self.actions_taken = []
+
+    def act(self, action):
+        self.acts += 1
+        self.t += 1
+        self.actions_taken.append(action)
+        if self.acts % 10 == 0:
+            self._lives -= 1
+        return float(action)
+
+    def screen(self):
+        return np.full(self.raw_shape, self.t % 256, np.uint8)
+
+    def game_over(self):
+        return self._lives <= 0
+
+    def lives(self):
+        return self._lives
+
+
+def _env(**kw):
+    kw.setdefault("frame_shape", (8, 8))
+    kw.setdefault("sticky_actions", 0.0)
+    return AtariEnv(FakeALE(), **kw)
+
+
+def test_action_repeat_and_reward_sum():
+    env = _env(reward_clip=0.0)
+    env.reset()
+    ts = env.step(2)  # 4 repeats of action 2 -> raw reward 8
+    assert ts.reward == 8.0
+    assert env.raw.acts == 4
+
+
+def test_flicker_max_pool_uses_last_two_frames():
+    env = _env()
+    env.reset()
+    ts = env.step(0)
+    # counter goes 1,2,3,4 during the repeat; max(last two) = 4
+    assert ts.obs.max() == 4
+    assert ts.obs.min() == 4  # uniform frame
+
+
+def test_reward_clip():
+    env = _env(reward_clip=1.0)
+    env.reset()
+    ts = env.step(3)  # raw sum 12 -> clipped to 1
+    assert ts.reward == 1.0
+    ts_info_free = env.step(0)
+    assert ts_info_free.reward == 0.0
+
+
+def test_game_over_terminates_not_life_loss_by_default():
+    env = _env()
+    env.reset()
+    steps_to_end = 0
+    ts = None
+    for _ in range(100):
+        ts = env.step(1)
+        steps_to_end += 1
+        if ts.terminal:
+            break
+    # 2 lives x 10 acts each = 20 acts = 5 steps of 4 repeats
+    assert ts.terminal and steps_to_end == 5
+    assert ts.info["episode_return"] == 20.0  # raw, unclipped return
+
+
+def test_life_loss_mode_terminates_early():
+    env = _env(terminal_on_life_loss=True)
+    env.reset()
+    steps = 0
+    while True:
+        ts = env.step(1)
+        steps += 1
+        if ts.terminal:
+            break
+    assert steps == 3  # first life lost at act 10 -> step ceil(10/4)
+
+
+def test_sticky_actions_repeat_previous():
+    # p=1: every action is replaced by the previous one, which starts at 0
+    # after reset — the agent never regains control. Documents prev-action
+    # initialisation.
+    env = AtariEnv(FakeALE(), frame_shape=(8, 8), sticky_actions=1.0, seed=0)
+    env.reset()
+    env.step(3)
+    env.step(1)
+    assert set(env.raw.actions_taken) == {0}
+
+    # p=0.25 (SABER default): statistically ~25% of steps repeat the previous
+    # distinct action.
+    env = AtariEnv(FakeALE(), frame_shape=(8, 8), sticky_actions=0.25, seed=1)
+    env.reset()
+    env.raw._lives = 10**9
+    repeats = 0
+    trials = 400
+    for t in range(trials):
+        intended = (t % 3) + 1  # never 0, always != previous intended
+        before = len(env.raw.actions_taken)
+        env.step(intended)
+        taken = env.raw.actions_taken[before]
+        repeats += taken != intended
+    assert 0.15 < repeats / trials < 0.35
+
+
+def test_frame_cap_truncates_without_terminal():
+    env = _env(max_episode_frames=8)
+    env.reset()
+    env.raw._lives = 99  # never die
+    ts = env.step(0)
+    assert not ts.truncated
+    ts = env.step(0)  # 8 raw frames reached
+    assert ts.truncated and not ts.terminal
+    assert "episode_return" in ts.info
+
+
+def test_resize_shapes_and_range():
+    env = _env(frame_shape=(84, 84))
+    f = env.reset()
+    assert f.shape == (84, 84) and f.dtype == np.uint8
+
+
+def test_make_env_factory_errors():
+    with pytest.raises(ValueError):
+        make_env("nope:thing")
+    with pytest.raises(ValueError):
+        make_env("toy:nothing")
+    with pytest.raises(ImportError):
+        make_env("atari:Pong")  # no ale_py in this sandbox: clear error

@@ -1,0 +1,220 @@
+"""Multi-device tests on the virtual 8-device CPU mesh: learner dp-sharding,
+actor lane-sharding, weight publish across meshes, sharded replay semantics,
+actor-side priorities, and a short end-to-end apex run (SURVEY §4:
+'distributed tests on a single host ... pmap/pjit paths exercised on CPU')."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from rainbow_iqn_apex_tpu.config import Config
+from rainbow_iqn_apex_tpu.parallel import (
+    ActorPriorityEstimator,
+    ApexDriver,
+    ShardedReplay,
+    split_devices,
+    train_apex,
+)
+from rainbow_iqn_apex_tpu.replay.buffer import PrioritizedReplay, SampledBatch
+
+CFG = Config(
+    compute_dtype="float32",
+    frame_height=44,
+    frame_width=44,
+    history_length=2,
+    hidden_size=64,
+    num_cosines=16,
+    num_tau_samples=8,
+    num_tau_prime_samples=8,
+    num_quantile_samples=4,
+    batch_size=16,
+    learner_devices=4,
+    num_actors=1,
+    num_envs_per_actor=8,
+    replay_shards=2,
+)
+A = 3
+
+
+def test_eight_virtual_devices_present():
+    assert len(jax.devices()) == 8
+
+
+def test_split_devices():
+    devs = jax.devices()
+    l, a = split_devices(devs, 4)
+    assert len(l) == 4 and len(a) == 4 and set(l) ^ set(a) == set(devs)
+    l2, a2 = split_devices(devs, 0)  # colocated mode
+    assert l2 == a2 == devs
+
+
+def _fake_sample(b=16):
+    rng = np.random.default_rng(0)
+    return SampledBatch(
+        idx=np.arange(b),
+        obs=rng.integers(0, 255, (b, 44, 44, 2), dtype=np.uint8),
+        action=rng.integers(0, A, b).astype(np.int32),
+        reward=rng.normal(size=b).astype(np.float32),
+        next_obs=rng.integers(0, 255, (b, 44, 44, 2), dtype=np.uint8),
+        discount=np.full(b, 0.9, np.float32),
+        weight=np.ones(b, np.float32),
+        prob=np.full(b, 1.0 / b),
+    )
+
+
+@pytest.fixture(scope="module")
+def driver():
+    return ApexDriver(CFG, A)
+
+
+def test_learner_step_is_dp_sharded(driver):
+    before = driver.step
+    info = driver.learn(_fake_sample())
+    assert driver.step == before + 1
+    assert np.isfinite(float(info["loss"]))
+    # state replicated over the 4 learner devices
+    leaf = jax.tree.leaves(driver.state.params)[0]
+    assert len(leaf.sharding.device_set) == 4
+
+
+def test_dp_sharded_learn_matches_single_device():
+    """The mesh-sharded learn step must produce the same numbers as an
+    unsharded single-device run (collectives change layout, not math)."""
+    from rainbow_iqn_apex_tpu.ops.learn import Batch, build_learn_step, init_train_state
+
+    sample = _fake_sample()
+    batch = Batch(
+        obs=jnp.asarray(sample.obs),
+        action=jnp.asarray(sample.action),
+        reward=jnp.asarray(sample.reward),
+        next_obs=jnp.asarray(sample.next_obs),
+        discount=jnp.asarray(sample.discount),
+        weight=jnp.asarray(sample.weight),
+    )
+    key = jax.random.PRNGKey(3)
+    state0 = init_train_state(CFG, A, jax.random.PRNGKey(0))
+    ref_step = jax.jit(build_learn_step(CFG, A))
+    ref_state, ref_info = ref_step(state0, batch, key)
+
+    d = ApexDriver(CFG, A)
+    d.state = jax.device_put(
+        init_train_state(CFG, A, jax.random.PRNGKey(0)),
+        jax.tree.leaves(d.state.params)[0].sharding,
+    )
+    sh_state, sh_info = d._learn(d.state, batch, key)
+    np.testing.assert_allclose(float(ref_info["loss"]), float(sh_info["loss"]), rtol=1e-5)
+    np.testing.assert_allclose(
+        np.asarray(ref_info["priorities"]), np.asarray(sh_info["priorities"]), rtol=1e-4
+    )
+    for a, b in zip(jax.tree.leaves(ref_state.params), jax.tree.leaves(sh_state.params)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-5)
+
+
+def test_actor_lane_sharding_and_weight_publish(driver):
+    obs = np.random.default_rng(0).integers(0, 255, (8, 44, 44, 2)).astype(np.uint8)
+    actions, q = driver.act(obs)
+    assert actions.shape == (8,) and q.shape == (8, A)
+    # actor params live on the actor mesh (4 devices), fp32 after uncast
+    leaf = jax.tree.leaves(driver.actor_params)[0]
+    assert len(leaf.sharding.device_set) == 4
+    assert leaf.dtype == jnp.float32
+
+    # publish propagates learner updates: params equal after publish
+    driver.learn(_fake_sample())
+    driver.publish_weights()
+    for lp, ap in zip(
+        jax.tree.leaves(driver.state.params), jax.tree.leaves(driver.actor_params)
+    ):
+        # bf16 round-trip: equal to ~2^-8 relative
+        np.testing.assert_allclose(
+            np.asarray(lp), np.asarray(ap), rtol=2e-2, atol=1e-2
+        )
+
+
+def test_actor_priority_estimator_matches_replay_math():
+    """The actor's n-step TD priority must use the same return/discount the
+    replay assembles for the same transition."""
+    n, gamma, L = 3, 0.5, 2
+    est = ActorPriorityEstimator(L, n, gamma)
+    rng = np.random.default_rng(0)
+    qs, acts, rews, terms = [], [], [], []
+    out = []
+    for t in range(6):
+        q = rng.normal(size=(L, A)).astype(np.float32)
+        a = rng.integers(0, A, L)
+        r = rng.normal(size=L).astype(np.float32)
+        d = np.zeros(L, bool)
+        qs.append(q), acts.append(a), rews.append(r), terms.append(d)
+        out.append(est.push(q, a, r, d))
+    assert out[0] is None and out[n - 1] is None and out[n] is not None
+    # hand-check lane 0 at t=n (transition 0): R = r0 + g r1 + g^2 r2
+    expect_R = rews[0][0] + gamma * rews[1][0] + gamma**2 * rews[2][0]
+    boot = gamma**n * qs[n][0].max()
+    q_sel = qs[0][0][acts[0][0]]
+    np.testing.assert_allclose(out[n][0], abs(expect_R + boot - q_sel), rtol=1e-5)
+
+
+def test_actor_priority_estimator_terminal_cuts():
+    n, gamma, L = 3, 0.5, 1
+    est = ActorPriorityEstimator(L, n, gamma)
+    q = np.ones((1, A), np.float32)
+    # r=1 each step; terminal at t=1 -> transition 0: R = 1 + g*1, no bootstrap
+    outs = []
+    for t in range(4):
+        outs.append(
+            est.push(q, np.zeros(1, np.int64), np.ones(1, np.float32),
+                     np.array([t == 1]))
+        )
+    np.testing.assert_allclose(outs[n][0], abs(1 + gamma - 1.0), rtol=1e-5)
+
+
+def test_sharded_replay_routing_and_global_weights():
+    mem = ShardedReplay.build(
+        2, 128, 4, frame_shape=(8, 8), history=2, n_step=2, gamma=0.9,
+        use_native=False, priority_exponent=1.0,
+    )
+    f = np.zeros((4, 8, 8), np.uint8)
+    for t in range(30):
+        mem.append_batch(
+            f + t, np.arange(4), np.full(4, 1.0, np.float32), np.zeros(4, bool)
+        )
+    b = mem.sample(32, beta=1.0)
+    assert b.obs.shape == (32, 8, 8, 2)
+    assert b.weight.max() == pytest.approx(1.0)
+    # actions encode the lane: lanes 0,1 -> shard 0; lanes 2,3 -> shard 1
+    shard_of = b.idx // mem.shard_capacity
+    assert set(np.unique(shard_of)) == {0, 1}
+    for i in range(32):
+        lane_global = (b.idx[i] // mem.shards[0].seg)  # global lane index
+        assert b.action[i] == lane_global
+    # write-back must route to the right shard
+    mem.update_priorities(b.idx, np.full(32, 7.0))
+    np.testing.assert_allclose(
+        mem.shards[0].tree.get((b.idx[shard_of == 0]) % mem.shard_capacity),
+        (7.0 + mem.shards[0].eps),
+        rtol=1e-6,
+    )
+
+
+@pytest.mark.slow
+def test_apex_end_to_end_short(tmp_path):
+    cfg = CFG.replace(
+        env_id="toy:catch",
+        frame_height=80,
+        frame_width=80,
+        learn_start=256,
+        replay_ratio=8,
+        memory_capacity=4096,
+        weight_publish_interval=20,
+        metrics_interval=50,
+        checkpoint_interval=0,
+        eval_interval=0,
+        eval_episodes=2,
+        results_dir=str(tmp_path / "results"),
+        checkpoint_dir=str(tmp_path / "ckpt"),
+    )
+    summary = train_apex(cfg, max_frames=2_000)
+    assert summary["learn_steps"] > 0
+    assert summary["lanes"] == 8
+    assert np.isfinite(summary["eval_score_mean"])

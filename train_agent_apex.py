@@ -1,0 +1,46 @@
+#!/usr/bin/env python
+"""Training entry point (name kept for parity with the reference's
+`train_agent_apex.py`, BASELINE.json:5 / SURVEY.md §3.1-3.2).
+
+Roles (--role):
+  single   one process: act + learn interleaved (reference's 1-actor mode)
+  apex     one process driving the whole device mesh: learner cores + actor
+           lanes + sharded replay (the TPU-native Ape-X: the pod IS the
+           learner and the actor fleet — no Redis, no external processes)
+
+The reference selects learner/actor roles per *process* and couples them
+through Redis; here the coupling is XLA collectives + host shared memory, so
+both roles live in one SPMD program (SURVEY.md §5 "Distributed communication
+backend" mapping).
+"""
+
+import json
+import sys
+
+from rainbow_iqn_apex_tpu.config import parse_config
+
+
+def main(argv=None) -> int:
+    cfg = parse_config(argv)
+    if cfg.role == "single":
+        from rainbow_iqn_apex_tpu.train import train
+
+        summary = train(cfg)
+    elif cfg.role == "apex":
+        from rainbow_iqn_apex_tpu.parallel.apex import train_apex
+
+        summary = train_apex(cfg)
+    else:
+        print(
+            f"unknown --role '{cfg.role}' (want 'single' or 'apex'; the "
+            "reference's separate learner/actor processes are one SPMD "
+            "program here)",
+            file=sys.stderr,
+        )
+        return 2
+    print(json.dumps(summary))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
