@@ -4,28 +4,39 @@
 Prints ONE JSON line:
   {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
 
-What is measured: sustained full learn steps/sec on the real device at the
+What is measured: sustained full learn steps/sec on the device at the
 reference hyperparameters (batch 32, 84x84x4 uint8 frames, IQN N=N'=64, K=32
-double-Q selection, dueling noisy nets, Adam) — the §3.4 kernel end-to-end,
-including host->device batch transfer each step, i.e. what the learner role
-sustains in the Ape-X loop.
+double-Q selection, dueling noisy nets, Adam) — the SURVEY.md §3.4 kernel
+end-to-end, including host->device batch transfer each step, i.e. what the
+learner role sustains in the Ape-X loop.
 
 Baseline: the reference learner is a PyTorch 1-GPU process at the same shape.
 BASELINE.json records no published number ("published": {}); the documented
 reference class (SURVEY.md §6, RECON) is ~75 learn-steps/s for a Rainbow-IQN
 GPU learner of that era, so vs_baseline = steps_per_sec / 75.  Re-verify when
 reference numbers become available (SURVEY.md §8 item 6).
+
+Robustness: the TPU relay in this sandbox admits one claim and can wedge
+(see .claude/skills/verify/SKILL.md), so the measurement runs in a child
+process under a watchdog; if the device path never comes up, a CPU fallback
+provides a (clearly labelled) number rather than no output.
 """
 
 import json
+import os
+import subprocess
+import sys
 import time
 
-import jax
-import jax.numpy as jnp
-import numpy as np
+WATCHDOG_SECS = int(os.environ.get("BENCH_WATCHDOG_SECS", "480"))
 
 
-def main() -> None:
+def measure() -> None:
+    """Child-process body: measure on whatever device jax gives us."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
     from rainbow_iqn_apex_tpu.config import Config
     from rainbow_iqn_apex_tpu.ops.learn import (
         Batch,
@@ -33,6 +44,7 @@ def main() -> None:
         init_train_state,
     )
 
+    platform = jax.devices()[0].platform
     cfg = Config()  # reference defaults: 84x84x4, N=N'=64, K=32, batch 32
     num_actions = 18  # SABER full action set
     batch_size = cfg.batch_size
@@ -61,13 +73,11 @@ def main() -> None:
         state, info = learn(state, batch, k)
         return state, info, key
 
-    # warmup / compile
-    for _ in range(3):
+    for _ in range(3):  # warmup / compile
         state, info, key = step(state, host_batch(), key)
     jax.block_until_ready(info["loss"])
 
-    # timed run: fresh host batch every step (runtime-realistic transfer)
-    iters = 300
+    iters = 300 if platform != "cpu" else 30
     batches = [host_batch() for _ in range(8)]
     t0 = time.perf_counter()
     for i in range(iters):
@@ -81,11 +91,63 @@ def main() -> None:
             {
                 "metric": "iqn_learner_steps_per_sec_atari_shape",
                 "value": round(steps_per_sec, 2),
-                "unit": "learn_steps/s (batch=32, 84x84x4, N=N'=64)",
+                "unit": f"learn_steps/s (batch=32, 84x84x4, N=N'=64, {platform})",
                 "vs_baseline": round(steps_per_sec / 75.0, 3),
             }
         )
     )
+
+
+def main() -> None:
+    if os.environ.get("_BENCH_CHILD") == "1":
+        measure()
+        return
+
+    here = os.path.dirname(os.path.abspath(__file__))
+
+    def run_child(extra_env, timeout):
+        env = dict(os.environ)
+        env.update(extra_env)
+        env["_BENCH_CHILD"] = "1"
+        env["PYTHONPATH"] = here + os.pathsep + env.get("PYTHONPATH", "")
+        try:
+            p = subprocess.run(
+                [sys.executable, os.path.abspath(__file__)],
+                env=env,
+                capture_output=True,
+                text=True,
+                timeout=timeout,
+            )
+        except subprocess.TimeoutExpired:
+            print("bench child timed out", file=sys.stderr)
+            return None
+        for line in reversed(p.stdout.strip().splitlines()):
+            try:
+                json.loads(line)
+                return line
+            except ValueError:
+                continue
+        # no JSON line: surface the child's failure so the 0.0 row is
+        # diagnosable from the driver's logs
+        tail = "\n".join(p.stderr.strip().splitlines()[-15:])
+        print(f"bench child produced no result (rc={p.returncode}):\n{tail}",
+              file=sys.stderr)
+        return None
+
+    # device path (axon/TPU env as-is), under the watchdog
+    line = run_child({}, WATCHDOG_SECS)
+    if line is None:
+        # CPU fallback: never leave the driver without a benchmark row
+        env = {"JAX_PLATFORMS": "cpu"}
+        if "PALLAS_AXON_POOL_IPS" in os.environ:
+            env["PALLAS_AXON_POOL_IPS"] = ""  # empty string disables the relay hook
+        line = run_child(env, WATCHDOG_SECS)
+    print(line if line else json.dumps({
+        "metric": "iqn_learner_steps_per_sec_atari_shape",
+        "value": 0.0,
+        "unit": "learn_steps/s (benchmark could not run)",
+        "vs_baseline": 0.0,
+    }))
 
 
 if __name__ == "__main__":

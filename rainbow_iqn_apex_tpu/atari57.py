@@ -1,0 +1,136 @@
+"""Atari-57 benchmark harness: game list, normalisation baselines, sweep
+driver, and the median human-normalized aggregate.
+
+Parity: the reference's headline benchmark is the 200M-frame median
+human-normalized score over the 57-game ALE suite under SABER
+(BASELINE.json:2, SURVEY.md §6), with per-game result CSVs shipped in the
+repo (SURVEY.md §2 row 9).
+
+The random/human baseline table below is the standard one from the
+Rainbow/IQN literature (Wang et al. / Hessel et al. appendices).  Values are
+from training-data recall and carry the survey's RECON caveat (SURVEY.md §0):
+re-verify against the published appendix before using in a paper.  The
+aggregation math (score normalisation, median) does not depend on their
+exactness.
+"""
+
+from __future__ import annotations
+
+import csv
+import json
+import os
+from typing import Dict, List, Optional
+
+# game -> (random, human) raw-score baselines [RECON — re-verify]
+ATARI57_BASELINES: Dict[str, tuple] = {
+    "Alien": (227.8, 7127.7), "Amidar": (5.8, 1719.5),
+    "Assault": (222.4, 742.0), "Asterix": (210.0, 8503.3),
+    "Asteroids": (719.1, 47388.7), "Atlantis": (12850.0, 29028.1),
+    "BankHeist": (14.2, 753.1), "BattleZone": (2360.0, 37187.5),
+    "BeamRider": (363.9, 16926.5), "Berzerk": (123.7, 2630.4),
+    "Bowling": (23.1, 160.7), "Boxing": (0.1, 12.1),
+    "Breakout": (1.7, 30.5), "Centipede": (2090.9, 12017.0),
+    "ChopperCommand": (811.0, 7387.8), "CrazyClimber": (10780.5, 35829.4),
+    "Defender": (2874.5, 18688.9), "DemonAttack": (152.1, 1971.0),
+    "DoubleDunk": (-18.6, -16.4), "Enduro": (0.0, 860.5),
+    "FishingDerby": (-91.7, -38.7), "Freeway": (0.0, 29.6),
+    "Frostbite": (65.2, 4334.7), "Gopher": (257.6, 2412.5),
+    "Gravitar": (173.0, 3351.4), "Hero": (1027.0, 30826.4),
+    "IceHockey": (-11.2, 0.9), "Jamesbond": (29.0, 302.8),
+    "Kangaroo": (52.0, 3035.0), "Krull": (1598.0, 2665.5),
+    "KungFuMaster": (258.5, 22736.3), "MontezumaRevenge": (0.0, 4753.3),
+    "MsPacman": (307.3, 6951.6), "NameThisGame": (2292.3, 8049.0),
+    "Phoenix": (761.4, 7242.6), "Pitfall": (-229.4, 6463.7),
+    "Pong": (-20.7, 14.6), "PrivateEye": (24.9, 69571.3),
+    "Qbert": (163.9, 13455.0), "Riverraid": (1338.5, 17118.0),
+    "RoadRunner": (11.5, 7845.0), "Robotank": (2.2, 11.9),
+    "Seaquest": (68.4, 42054.7), "Skiing": (-17098.1, -4336.9),
+    "Solaris": (1236.3, 12326.7), "SpaceInvaders": (148.0, 1668.7),
+    "StarGunner": (664.0, 10250.0), "Surround": (-10.0, 6.5),
+    "Tennis": (-23.8, -8.3), "TimePilot": (3568.0, 5229.2),
+    "Tutankham": (11.4, 167.6), "UpNDown": (533.4, 11693.2),
+    "Venture": (0.0, 1187.5), "VideoPinball": (16256.9, 17667.9),
+    "WizardOfWor": (563.5, 4756.5), "YarsRevenge": (3092.9, 54576.9),
+    "Zaxxon": (32.5, 9173.3),
+}
+
+ATARI57 = sorted(ATARI57_BASELINES)
+
+
+def human_normalized_score(game: str, raw: float) -> Optional[float]:
+    base = ATARI57_BASELINES.get(game)
+    if base is None or base[1] == base[0]:
+        return None
+    return (raw - base[0]) / (base[1] - base[0])
+
+
+def aggregate(per_game_raw: Dict[str, float]) -> Dict[str, float]:
+    """Median/mean human-normalized over the evaluated games."""
+    hns = [
+        hn
+        for g, s in per_game_raw.items()
+        if (hn := human_normalized_score(g, s)) is not None
+    ]
+    if not hns:
+        return {"games": 0}
+    hns.sort()
+    n = len(hns)
+    median = hns[n // 2] if n % 2 else 0.5 * (hns[n // 2 - 1] + hns[n // 2])
+    return {
+        "games": n,
+        "median_human_normalized": median,
+        "mean_human_normalized": sum(hns) / n,
+    }
+
+
+def write_results_csv(path: str, rows: List[Dict]) -> None:
+    """Per-game results CSV (parity: the reference ships per-game CSVs)."""
+    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+    fields = sorted({k for r in rows for k in r})
+    with open(path, "w", newline="") as f:
+        w = csv.DictWriter(f, fieldnames=fields)
+        w.writeheader()
+        w.writerows(rows)
+
+
+def run_sweep(base_args: List[str], games: Optional[List[str]] = None,
+              results_dir: str = "results/atari57") -> Dict[str, float]:
+    """Sequentially train+eval each game via the training CLI.
+
+    One game at a time on one host's slice; pod-scale sweeps launch one game
+    per slice with scripts/launch_apex.sh.  Returns the aggregate.
+    """
+    import subprocess
+    import sys
+
+    games = games or ATARI57
+    per_game: Dict[str, float] = {}
+    rows = []
+    for game in games:
+        run_id = f"atari57_{game}"
+        cmd = [
+            sys.executable, "train_agent_apex.py",
+            "--env-id", f"atari:{game}", "--run-id", run_id, *base_args,
+        ]
+        out = subprocess.run(cmd, capture_output=True, text=True)
+        summary = {}
+        for line in reversed(out.stdout.strip().splitlines()):
+            try:
+                summary = json.loads(line)
+                break
+            except (ValueError, json.JSONDecodeError):
+                continue
+        raw = summary.get("eval_score_mean")
+        if raw is not None:
+            per_game[game] = raw
+            rows.append({
+                "game": game,
+                "score_mean": raw,
+                "human_normalized": human_normalized_score(game, raw),
+                **{k: v for k, v in summary.items() if k.startswith("eval_")},
+            })
+    write_results_csv(os.path.join(results_dir, "per_game.csv"), rows)
+    agg = aggregate(per_game)
+    with open(os.path.join(results_dir, "aggregate.json"), "w") as f:
+        json.dump(agg, f, indent=2)
+    return agg

@@ -52,13 +52,17 @@ class Config:
     # R2D2 (stretch) ----------------------------------------------------------------
     lstm_size: int = 512
     r2d2_burn_in: int = 40
-    r2d2_seq_len: int = 80
+    r2d2_seq_len: int = 80  # trained steps per sequence (after burn-in)
+    r2d2_overlap: int = 40  # stride = burn_in + seq_len - overlap
+    r2d2_eta: float = 0.9  # sequence priority: eta*max|td| + (1-eta)*mean|td|
+    value_rescale_eps: float = 1e-3  # h(x) epsilon (R2D2 value rescaling)
 
     # ---- IQN tau sampling (SURVEY §3.4) -------------------------------------------
     num_tau_samples: int = 64  # N  : online-net tau draws in the loss
     num_tau_prime_samples: int = 64  # N' : target-net tau draws in the loss
     num_quantile_samples: int = 32  # K  : tau draws used for acting
     kappa: float = 1.0  # Huber threshold
+    use_pallas_loss: bool = False  # fused Pallas quantile-Huber kernel
 
     # ---- agent / optimisation (SURVEY §2 row 4) -----------------------------------
     gamma: float = 0.99

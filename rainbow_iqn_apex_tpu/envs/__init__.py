@@ -4,17 +4,31 @@ from rainbow_iqn_apex_tpu.envs.atari import ALEAdapter, AtariEnv, make_atari_env
 
 
 def make_env(env_id: str, seed: int = 0, **kwargs) -> Env:
-    """Env factory keyed by the config's env_id: "toy:catch", "atari:Pong"."""
+    """Env factory keyed by the config's env_id:
+    "toy:catch" | "atari:Pong" | "gym:<gymnasium id>" | "procgen:<game>"."""
     kind, _, name = env_id.partition(":")
     if kind == "toy":
         return make_toy_env(name, seed=seed)
     if kind == "atari":
         return make_atari_env(name, seed=seed, **kwargs)
-    raise ValueError(f"unknown env id '{env_id}' (want 'toy:...' or 'atari:...')")
+    if kind == "gym":
+        from rainbow_iqn_apex_tpu.envs.gym import make_gym_env
+
+        return make_gym_env(name, seed=seed, **kwargs)
+    if kind == "procgen":
+        from rainbow_iqn_apex_tpu.envs.gym import make_procgen_env
+
+        return make_procgen_env(name, seed=seed, **kwargs)
+    raise ValueError(
+        f"unknown env id '{env_id}' (want 'toy:', 'atari:', 'gym:' or 'procgen:')"
+    )
 
 
 def make_vector_env(env_id: str, num_envs: int, seed: int = 0, **kwargs) -> VectorEnv:
-    return VectorEnv([make_env(env_id, seed=seed + i, **kwargs) for i in range(num_envs)])
+    def factory(lane: int) -> Env:
+        return make_env(env_id, seed=seed + lane, **kwargs)
+
+    return VectorEnv([factory(i) for i in range(num_envs)], env_factory=factory)
 
 
 __all__ = [

@@ -1,0 +1,153 @@
+"""Gymnasium adapter: Procgen / CARLA / any pixel gym env behind our Env API.
+
+Parity: BASELINE.json:11 lists "Procgen-16 + CARLA NoCrash driving (Valeo
+domain — generalization bench)" as a reference benchmark config.  Neither
+package is installed in this sandbox (SURVEY.md §7), so — like the Atari
+path — the adapter keeps every gym-specific assumption behind one seam:
+anything exposing gymnasium's `reset()/step()` with an RGB or grayscale
+pixel observation becomes a framework Env producing preprocessed uint8
+frames.  CI exercises it with a synthetic gymnasium env.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional, Tuple
+
+import numpy as np
+
+from rainbow_iqn_apex_tpu.envs.atari import _resize
+from rainbow_iqn_apex_tpu.envs.base import Env, TimeStep
+
+
+def _to_gray(frame: np.ndarray) -> np.ndarray:
+    """RGB [H,W,3] (or [H,W]) uint8 -> grayscale [H,W] uint8 (BT.601)."""
+    if frame.ndim == 2:
+        return frame.astype(np.uint8)
+    if frame.ndim == 3 and frame.shape[-1] == 3:
+        g = frame @ np.asarray([0.299, 0.587, 0.114], np.float32)
+        return g.astype(np.uint8)
+    raise ValueError(f"expected [H,W] or [H,W,3] pixels, got {frame.shape}")
+
+
+class GymEnv(Env):
+    """Wraps a gymnasium-API env (Procgen, CARLA wrappers, Box2D pixels...).
+
+    Rewards are optionally clipped (training parity with the Atari path);
+    the raw episode return is reported via info for evaluation.
+    """
+
+    def __init__(
+        self,
+        gym_env: Any,
+        frame_shape: Tuple[int, int] = (84, 84),
+        reward_clip: float = 1.0,
+        max_episode_steps: int = 0,  # 0 = trust the env's own limit
+        seed: int = 0,
+    ):
+        self.gym = gym_env
+        self._frame_shape = frame_shape
+        self.reward_clip = reward_clip
+        self.max_steps = max_episode_steps
+        self._seed = seed
+        self._steps = 0
+        self._ret = 0.0
+        n = getattr(gym_env.action_space, "n", None)
+        if n is None:
+            raise ValueError(
+                "GymEnv needs a discrete action space (Procgen/CARLA discrete "
+                "wrappers qualify); got " + repr(gym_env.action_space)
+            )
+        self._num_actions = int(n)
+
+    @property
+    def num_actions(self) -> int:
+        return self._num_actions
+
+    @property
+    def frame_shape(self) -> Tuple[int, int]:
+        return self._frame_shape
+
+    def _frame(self, obs: np.ndarray) -> np.ndarray:
+        return _resize(_to_gray(np.asarray(obs)), self._frame_shape)
+
+    def reset(self) -> np.ndarray:
+        try:
+            out = self.gym.reset(seed=self._seed)
+        except TypeError:  # legacy gym reset() without seed kwarg
+            out = self.gym.reset()
+        obs = out[0] if isinstance(out, tuple) else out
+        self._seed = None  # gymnasium: seed only the first reset
+        self._steps = 0
+        self._ret = 0.0
+        return self._frame(obs)
+
+    def step(self, action: int) -> TimeStep:
+        out = self.gym.step(action)
+        if len(out) == 5:  # gymnasium API
+            obs, reward, terminated, truncated, _info = out
+        elif len(out) == 4:  # legacy gym 4-tuple (procgen et al.)
+            obs, reward, done, _info = out
+            truncated = bool(_info.get("TimeLimit.truncated", False))
+            terminated = bool(done) and not truncated
+        else:  # pragma: no cover
+            raise ValueError(f"unrecognised step() return of length {len(out)}")
+        self._steps += 1
+        self._ret += float(reward)
+        if self.max_steps and self._steps >= self.max_steps and not terminated:
+            truncated = True
+        r = float(reward)
+        if self.reward_clip > 0:
+            r = float(np.clip(r, -self.reward_clip, self.reward_clip))
+        info = (
+            {"episode_return": self._ret} if (terminated or truncated) else None
+        )
+        return TimeStep(self._frame(obs), r, bool(terminated), bool(truncated), info)
+
+    def close(self) -> None:
+        self.gym.close()
+
+
+def make_gym_env(env_id: str, seed: int = 0, **kwargs) -> GymEnv:
+    """Factory for `gym:<id>` env ids (any gymnasium-registered pixel env)."""
+    try:
+        import gymnasium
+    except ImportError as e:  # pragma: no cover
+        raise ImportError(
+            "gymnasium is not installed; gym:/procgen: env ids need it"
+        ) from e
+    return GymEnv(gymnasium.make(env_id), seed=seed, **kwargs)
+
+
+def make_procgen_env(game: str, seed: int = 0, **kwargs) -> GymEnv:
+    """Factory for `procgen:<game>`.
+
+    procgen registers its envs with legacy gym, not gymnasium, so we go
+    through gymnasium's compatibility entry point when available and fall
+    back to wrapping the legacy env directly (GymEnv.step handles both the
+    5-tuple and legacy 4-tuple returns).
+    """
+    shim_error: Optional[Exception] = None
+    try:
+        import gymnasium
+
+        try:  # gymnasium shim over a legacy-gym registration (needs shimmy)
+            env = gymnasium.make(
+                "GymV21Environment-v0", env_id=f"procgen:procgen-{game}-v0"
+            )
+            return GymEnv(env, seed=seed, **kwargs)
+        except Exception as e:
+            shim_error = e  # keep for the final error chain
+    except ImportError:
+        pass
+    try:
+        import gym as legacy_gym  # procgen's native registry
+
+        env = legacy_gym.make(f"procgen:procgen-{game}-v0")
+        return GymEnv(env, seed=seed, **kwargs)
+    except ImportError as e:
+        raise ImportError(
+            "procgen env ids need the procgen package (registered with "
+            "legacy gym) or a gymnasium+shimmy compatibility shim"
+            + (f"; the gymnasium shim attempt failed with: {shim_error!r}"
+               if shim_error else "")
+        ) from (shim_error or e)

@@ -1,0 +1,122 @@
+"""R2D2 recurrent Q-network (flax): conv trunk -> LSTM -> dueling noisy head.
+
+Parity: the reference's R2D2 stretch configuration (BASELINE.json:10,
+SURVEY.md §7 step 7; Kapturowski et al., "Recurrent Experience Replay in
+Distributed Reinforcement Learning", R2D2) — an LSTM Q-network trained on
+stored-state replay sequences with burn-in.  R2D2 uses a plain (scalar)
+dueling Q head rather than IQN quantiles; noisy layers keep the Rainbow
+exploration story.
+
+TPU-first notes:
+- Time unrolling is a `lax.scan` over an `OptimizedLSTMCell` step inside one
+  jit: [B, T, H, W, C] -> conv trunk applied as one [B*T] batch (single big
+  MXU GEMM per layer), then the scan carries only the small LSTM state.
+- Recurrent state is an explicit (c, h) pair the caller owns — nothing hidden
+  in module state, so actor-side stored-state replay and burn-in are pure
+  data plumbing.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from flax import linen as nn
+
+from rainbow_iqn_apex_tpu.models.layers import ConvTrunk, NoisyLinear
+
+Dtype = Any
+LSTMState = Tuple[jnp.ndarray, jnp.ndarray]  # (c, h), each [B, lstm_size]
+
+
+class _ResettableLSTMStep(nn.Module):
+    """One LSTM step with an optional pre-step state reset (episode cut)."""
+
+    features: int
+
+    @nn.compact
+    def __call__(self, carry: LSTMState, xs):
+        x_t, reset_t = xs  # [B, F], [B] bool
+        c, h = carry
+        keep = (1.0 - reset_t.astype(jnp.float32))[:, None]
+        c, h = c * keep, h * keep
+        (c, h), out = nn.OptimizedLSTMCell(features=self.features, name="cell")(
+            (c, h), x_t
+        )
+        return (c, h), out
+
+
+class R2D2Net(nn.Module):
+    """Recurrent dueling noisy Q-network over frame sequences."""
+
+    num_actions: int
+    lstm_size: int = 512
+    hidden_size: int = 512
+    noisy_sigma0: float = 0.5
+    dueling: bool = True
+    use_noise: bool = True
+    compute_dtype: Dtype = jnp.bfloat16
+
+    def initial_state(self, batch: int) -> LSTMState:
+        z = jnp.zeros((batch, self.lstm_size), jnp.float32)
+        return (z, z)
+
+    @nn.compact
+    def __call__(
+        self,
+        obs_seq: jnp.ndarray,  # [B, T, H, W, C] uint8 (or float in [0,1])
+        state: LSTMState,
+        resets: Optional[jnp.ndarray] = None,  # [B, T] bool: reset state BEFORE step t
+    ) -> Tuple[jnp.ndarray, LSTMState]:
+        """Returns (q_values [B, T, A] fp32, final LSTM state)."""
+        B, T = obs_seq.shape[:2]
+        if obs_seq.dtype == jnp.uint8:
+            obs_seq = obs_seq.astype(self.compute_dtype) * (1.0 / 255.0)
+
+        # conv trunk over the folded [B*T] batch: one large GEMM per layer
+        phi = ConvTrunk(compute_dtype=self.compute_dtype)(
+            obs_seq.reshape(B * T, *obs_seq.shape[2:])
+        )
+        phi = phi.reshape(B, T, -1).astype(jnp.float32)  # LSTM carries in fp32
+
+        xs = (
+            jnp.moveaxis(phi, 1, 0),  # [T, B, F]
+            jnp.moveaxis(
+                resets if resets is not None else jnp.zeros((B, T), bool), 1, 0
+            ),
+        )
+        scan = nn.scan(
+            _ResettableLSTMStep,
+            variable_broadcast="params",
+            split_rngs={"params": False},
+            in_axes=0,
+            out_axes=0,
+        )
+        final_state, outs = scan(features=self.lstm_size, name="lstm")(state, xs)
+        feat = jnp.moveaxis(outs, 0, 1).reshape(B * T, self.lstm_size)  # [B*T, L]
+
+        def head(name: str, out_dim: int) -> jnp.ndarray:
+            h1 = NoisyLinear(
+                self.hidden_size,
+                sigma0=self.noisy_sigma0,
+                use_noise=self.use_noise,
+                compute_dtype=self.compute_dtype,
+                name=f"{name}_hidden",
+            )(feat)
+            h1 = nn.relu(h1)
+            return NoisyLinear(
+                out_dim,
+                sigma0=self.noisy_sigma0,
+                use_noise=self.use_noise,
+                compute_dtype=self.compute_dtype,
+                name=f"{name}_out",
+            )(h1)
+
+        if self.dueling:
+            value = head("value", 1)
+            adv = head("advantage", self.num_actions)
+            q = value + adv - adv.mean(axis=-1, keepdims=True)
+        else:
+            q = head("q", self.num_actions)
+        return q.reshape(B, T, self.num_actions).astype(jnp.float32), final_state

@@ -142,7 +142,17 @@ def loss_and_priorities(
     )  # [B, N, A]
     z_online = jnp.take_along_axis(on_q, batch.action[:, None, None], axis=-1)[..., 0]
 
-    per_sample, td_abs = quantile_huber_loss(z_online, taus, td_target, cfg.kappa)
+    if cfg.use_pallas_loss:
+        from rainbow_iqn_apex_tpu.ops.pallas.quantile_huber import (
+            pallas_quantile_huber,
+        )
+
+        interpret = jax.default_backend() not in ("tpu", "axon")
+        per_sample, td_abs = pallas_quantile_huber(
+            z_online, taus, td_target, cfg.kappa, interpret
+        )
+    else:
+        per_sample, td_abs = quantile_huber_loss(z_online, taus, td_target, cfg.kappa)
     loss = jnp.mean(batch.weight * per_sample)
     aux = {
         "td_abs": td_abs,

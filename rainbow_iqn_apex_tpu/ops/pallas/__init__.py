@@ -1,0 +1,3 @@
+from rainbow_iqn_apex_tpu.ops.pallas.quantile_huber import pallas_quantile_huber
+
+__all__ = ["pallas_quantile_huber"]

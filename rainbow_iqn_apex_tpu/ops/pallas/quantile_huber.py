@@ -1,0 +1,108 @@
+"""Pallas TPU kernel: fused pairwise quantile-Huber loss with custom VJP.
+
+The §3.4 kernel's hot middle: u = target[:,None,:] - online[:,:,None] is a
+[B, N, N'] intermediate.  XLA usually fuses the elementwise chain, but the
+backward pass re-materialises the pairwise tensor from HBM-resident inputs.
+This kernel computes, in one VMEM pass per batch block:
+  - per-sample loss   sum_i mean_j rho_ij
+  - td_abs            mean_ij |u_ij|        (the PER priority signal)
+  - d loss / d online (the only input that needs a gradient; taus are
+    sampled, targets are stop-gradient)
+so the [B, N, N'] tensor never touches HBM in either direction.
+
+Gated by Config.use_pallas_loss; ops/losses.py is the jnp reference the unit
+tests compare against (interpret mode on CPU, compiled on TPU).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+BLOCK_B = 8  # samples per program instance
+
+
+def _qh_kernel(online_ref, taus_ref, target_ref, kappa_ref,
+               loss_ref, td_ref, grad_ref):
+    """One batch block: online/taus [TB, N], target [TB, N'] in VMEM."""
+    online = online_ref[:]  # [TB, N]
+    taus = taus_ref[:]
+    target = target_ref[:]  # [TB, N']
+    kappa = kappa_ref[0]
+
+    u = target[:, None, :] - online[:, :, None]  # [TB, N, N'] in registers/VMEM
+    abs_u = jnp.abs(u)
+    quad = abs_u <= kappa
+    hub = jnp.where(quad, 0.5 * u * u, kappa * (abs_u - 0.5 * kappa))
+    w = jnp.abs(taus[:, :, None] - (u < 0.0).astype(jnp.float32))
+    rho = w * hub / kappa
+
+    npr = u.shape[-1]
+    loss_ref[:] = rho.mean(axis=2).sum(axis=1)
+    td_ref[:] = abs_u.mean(axis=(1, 2))
+    # d rho/d online_i = -w_ij * clip(u, -kappa, kappa)/kappa ; mean over j
+    dhub = jnp.clip(u, -kappa, kappa) / kappa
+    grad_ref[:] = -(w * dhub).sum(axis=2) / npr  # [TB, N]
+
+
+def _run_kernel(online, taus, target, kappa, interpret):
+    B, N = online.shape
+    NP = target.shape[1]
+    TB = BLOCK_B if B % BLOCK_B == 0 else 1
+    grid = (B // TB,)
+    kappa_arr = jnp.full((1,), kappa, jnp.float32)
+    out_shapes = (
+        jax.ShapeDtypeStruct((B,), jnp.float32),  # loss
+        jax.ShapeDtypeStruct((B,), jnp.float32),  # td_abs
+        jax.ShapeDtypeStruct((B, N), jnp.float32),  # grad wrt online
+    )
+    loss, td, grad = pl.pallas_call(
+        _qh_kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((TB, N), lambda i: (i, 0)),
+            pl.BlockSpec((TB, N), lambda i: (i, 0)),
+            pl.BlockSpec((TB, NP), lambda i: (i, 0)),
+            pl.BlockSpec((1,), lambda i: (0,)),
+        ],
+        out_specs=(
+            pl.BlockSpec((TB,), lambda i: (i,)),
+            pl.BlockSpec((TB,), lambda i: (i,)),
+            pl.BlockSpec((TB, N), lambda i: (i, 0)),
+        ),
+        out_shape=out_shapes,
+        interpret=interpret,
+    )(online.astype(jnp.float32), taus.astype(jnp.float32),
+      target.astype(jnp.float32), kappa_arr)
+    return loss, td, grad
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
+def pallas_quantile_huber(
+    online: jnp.ndarray,  # [B, N]
+    taus: jnp.ndarray,  # [B, N]
+    target: jnp.ndarray,  # [B, N']
+    kappa: float = 1.0,
+    interpret: bool = False,
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Returns (per_sample_loss [B], td_abs [B]); grads flow to online only."""
+    loss, td, _ = _run_kernel(online, taus, target, kappa, interpret)
+    return loss, td
+
+
+def _fwd(online, taus, target, kappa, interpret):
+    loss, td, grad = _run_kernel(online, taus, target, kappa, interpret)
+    return (loss, td), grad
+
+
+def _bwd(kappa, interpret, grad, cotangents):
+    g_loss, _g_td = cotangents  # td_abs path carries no gradient (priorities)
+    d_online = grad * g_loss[:, None]
+    return d_online, None, None
+
+
+pallas_quantile_huber.defvjp(_fwd, _bwd)

@@ -1,0 +1,250 @@
+"""R2D2 sequence learn step: burn-in, value rescaling, n-step double-Q.
+
+Parity: the reference's R2D2 stretch config (BASELINE.json:10) per
+Kapturowski et al. (R2D2): train a recurrent Q-net on stored-state replay
+sequences — replay the first `burn_in` steps with stop-gradient to warm the
+LSTM state, train on the remainder; targets use the invertible value rescale
+h(x) = sign(x)(sqrt(|x|+1) - 1) + eps*x; sequence priority is the eta-mix
+eta*max|td| + (1-eta)*mean|td|.
+
+Everything is one jitted graph over [B, L] sequences: two lax.scans (burn-in
+and train unroll) plus dense [B, T] target algebra — no per-step Python.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, Optional, Tuple
+
+import chex
+import jax
+import jax.numpy as jnp
+import optax
+from flax import struct
+
+from rainbow_iqn_apex_tpu.config import Config
+from rainbow_iqn_apex_tpu.models.r2d2 import LSTMState, R2D2Net
+from rainbow_iqn_apex_tpu.ops.learn import make_optimizer
+from rainbow_iqn_apex_tpu.ops.losses import huber
+
+Params = Any
+
+
+# ----------------------------------------------------------- value rescaling
+def value_rescale(x: jnp.ndarray, eps: float = 1e-3) -> jnp.ndarray:
+    """h(x) = sign(x) * (sqrt(|x| + 1) - 1) + eps * x."""
+    return jnp.sign(x) * (jnp.sqrt(jnp.abs(x) + 1.0) - 1.0) + eps * x
+
+
+def value_unrescale(x: jnp.ndarray, eps: float = 1e-3) -> jnp.ndarray:
+    """h^-1: exact closed form (R2D2 appendix)."""
+    inner = jnp.sqrt(1.0 + 4.0 * eps * (jnp.abs(x) + 1.0 + eps)) - 1.0
+    return jnp.sign(x) * ((inner / (2.0 * eps)) ** 2 - 1.0)
+
+
+# ------------------------------------------------------------------ batches
+@struct.dataclass
+class SequenceBatch:
+    """[B, L] training sequences; L = burn_in + train_len."""
+
+    obs: jnp.ndarray  # [B, L, H, W, C] uint8
+    action: jnp.ndarray  # [B, L] int32
+    reward: jnp.ndarray  # [B, L] f32
+    done: jnp.ndarray  # [B, L] bool — episode ended AT step t
+    valid: jnp.ndarray  # [B, L] bool — step belongs to the episode
+    init_c: jnp.ndarray  # [B, lstm] stored recurrent state at sequence start
+    init_h: jnp.ndarray  # [B, lstm]
+    weight: jnp.ndarray  # [B] f32 IS weights
+
+
+@struct.dataclass
+class R2D2TrainState:
+    params: Params
+    target_params: Params
+    opt_state: optax.OptState
+    step: jnp.ndarray
+
+
+def make_r2d2_network(cfg: Config, num_actions: int, use_noise: bool = True) -> R2D2Net:
+    return R2D2Net(
+        num_actions=num_actions,
+        lstm_size=cfg.lstm_size,
+        hidden_size=cfg.hidden_size,
+        noisy_sigma0=cfg.noisy_sigma0,
+        dueling=cfg.dueling,
+        use_noise=use_noise,
+        compute_dtype=jnp.dtype(cfg.compute_dtype),
+    )
+
+
+def init_r2d2_state(
+    cfg: Config,
+    num_actions: int,
+    key: chex.PRNGKey,
+    frame_shape: Tuple[int, int],
+    channels: int = 1,
+) -> R2D2TrainState:
+    net = make_r2d2_network(cfg, num_actions)
+    k1, k2 = jax.random.split(key)
+    dummy = jnp.zeros((1, 2, *frame_shape, channels), jnp.uint8)
+    params = net.init(
+        {"params": k1, "noise": k2}, dummy, net.initial_state(1)
+    )["params"]
+    opt_state = make_optimizer(cfg).init(params)
+    return R2D2TrainState(
+        params=params,
+        target_params=jax.tree.map(jnp.copy, params),
+        opt_state=opt_state,
+        step=jnp.zeros((), jnp.int32),
+    )
+
+
+def _unroll(
+    net: R2D2Net,
+    params: Params,
+    batch: SequenceBatch,
+    burn_in: int,
+    noise_key: chex.PRNGKey,
+) -> jnp.ndarray:
+    """Burn-in (stop-grad) then train unroll; returns q [B, T, A] for the
+    train slice.  LSTM state resets where a step follows a terminal."""
+    # reset BEFORE step t when the previous step ended the episode
+    prev_done = jnp.concatenate(
+        [jnp.zeros_like(batch.done[:, :1]), batch.done[:, :-1]], axis=1
+    )
+    state: LSTMState = (batch.init_c, batch.init_h)
+    kb, kt = jax.random.split(noise_key)
+    if burn_in > 0:
+        _, state = net.apply(
+            {"params": params},
+            batch.obs[:, :burn_in],
+            state,
+            resets=prev_done[:, :burn_in],
+            rngs={"noise": kb},
+        )
+        state = jax.lax.stop_gradient(state)
+    q, _ = net.apply(
+        {"params": params},
+        batch.obs[:, burn_in:],
+        state,
+        resets=prev_done[:, burn_in:],
+        rngs={"noise": kt},
+    )
+    return q  # [B, T, A]
+
+
+def build_r2d2_learn_step(
+    cfg: Config, num_actions: int
+) -> Callable[[R2D2TrainState, SequenceBatch, chex.PRNGKey],
+              Tuple[R2D2TrainState, Dict[str, jnp.ndarray]]]:
+    net = make_r2d2_network(cfg, num_actions)
+    tx = make_optimizer(cfg)
+    burn, n, gamma = cfg.r2d2_burn_in, cfg.multi_step, cfg.gamma
+    eta, eps_h = cfg.r2d2_eta, cfg.value_rescale_eps
+
+    def learn_step(state: R2D2TrainState, batch: SequenceBatch, key: chex.PRNGKey):
+        k_on, k_tgt = jax.random.split(key)
+        T = batch.obs.shape[1] - burn  # train slice length
+
+        def loss_fn(params):
+            q_on = _unroll(net, params, batch, burn, k_on)  # [B, T, A]
+            # Double-Q selection reuses the online unroll (stop-grad) rather
+            # than paying a third full conv+LSTM unroll for an independent
+            # noise draw — selection and evaluation already use different
+            # nets, which is where double-Q's bias correction comes from.
+            q_sel = jax.lax.stop_gradient(q_on)
+            q_tgt = _unroll(net, state.target_params, batch, burn, k_tgt)
+
+            a = batch.action[:, burn:]  # [B, T]
+            r = batch.reward[:, burn:]
+            d = batch.done[:, burn:].astype(jnp.float32)
+            v = batch.valid[:, burn:].astype(jnp.float32)
+
+            q_taken = jnp.take_along_axis(q_on, a[..., None], axis=-1)[..., 0]
+
+            # --- n-step double-Q bootstrap, all within the train slice ------
+            a_star = jnp.argmax(q_sel, axis=-1)  # [B, T]
+            q_boot = value_unrescale(
+                jnp.take_along_axis(q_tgt, a_star[..., None], axis=-1)[..., 0],
+                eps_h,
+            )
+            # shifted windows: for t in [0, T-n): R = sum_k gamma^k r[t+k]
+            # (truncated at terminal), bootstrap from t+n if alive.
+            Tn = T - n
+            gammas = gamma ** jnp.arange(n, dtype=jnp.float32)
+            r_win = jnp.stack([r[:, k : k + Tn] for k in range(n)], axis=-1)  # [B,Tn,n]
+            d_win = jnp.stack([d[:, k : k + Tn] for k in range(n)], axis=-1)
+            alive_prefix = jnp.cumprod(1.0 - d_win[..., :-1], axis=-1)
+            alive_prefix = jnp.concatenate(
+                [jnp.ones_like(alive_prefix[..., :1]), alive_prefix], axis=-1
+            )
+            rn = (r_win * alive_prefix * gammas).sum(axis=-1)  # [B, Tn]
+            no_done = 1.0 - jnp.clip(d_win.sum(axis=-1), 0.0, 1.0)
+            y = value_rescale(
+                rn + (gamma**n) * no_done * q_boot[:, n:], eps_h
+            )
+            td = jax.lax.stop_gradient(y) - q_taken[:, :Tn]
+            mask = v[:, :Tn]
+            td = td * mask
+
+            per_seq_loss = (huber(td, 1.0).sum(axis=1)) / jnp.maximum(
+                mask.sum(axis=1), 1.0
+            )
+            loss = jnp.mean(batch.weight * per_seq_loss)
+
+            abs_td = jnp.abs(td)
+            max_td = abs_td.max(axis=1)
+            mean_td = abs_td.sum(axis=1) / jnp.maximum(mask.sum(axis=1), 1.0)
+            priorities = eta * max_td + (1.0 - eta) * mean_td
+            aux = {
+                "priorities": priorities,
+                "q_mean": (q_taken * v).sum() / jnp.maximum(v.sum(), 1.0),
+            }
+            return loss, aux
+
+        (loss, aux), grads = jax.value_and_grad(loss_fn, has_aux=True)(state.params)
+        updates, opt_state = tx.update(grads, state.opt_state, state.params)
+        params = optax.apply_updates(state.params, updates)
+        step = state.step + 1
+        do_copy = (step % cfg.target_update_period == 0).astype(jnp.float32)
+        target_params = jax.tree.map(
+            lambda t, o: do_copy * o + (1.0 - do_copy) * t,
+            state.target_params,
+            params,
+        )
+        info = {
+            "loss": loss,
+            "priorities": aux["priorities"],
+            "q_mean": aux["q_mean"],
+            "grad_norm": optax.global_norm(grads),
+        }
+        return (
+            R2D2TrainState(
+                params=params,
+                target_params=target_params,
+                opt_state=opt_state,
+                step=step,
+            ),
+            info,
+        )
+
+    return learn_step
+
+
+def build_r2d2_act_step(
+    cfg: Config, num_actions: int, use_noise: bool = True
+) -> Callable:
+    """Recurrent acting: (params, obs [B,H,W,C] u8, state, key) ->
+    (action [B], q [B,A], new_state)."""
+    net = make_r2d2_network(cfg, num_actions, use_noise=use_noise)
+
+    def act_step(params, obs, state: LSTMState, key):
+        q, new_state = net.apply(
+            {"params": params},
+            obs[:, None],  # [B, 1, H, W, C]
+            state,
+            rngs={"noise": key},
+        )
+        q = q[:, 0]
+        return jnp.argmax(q, axis=-1).astype(jnp.int32), q, new_state
+
+    return act_step

@@ -248,10 +248,11 @@ def train_apex(cfg: Config, max_frames: Optional[int] = None) -> Dict[str, Any]:
     while frames < total_frames:
         stacked = stacker.push(obs)
         actions, q = driver.act(stacked)
-        new_obs, rewards, terminals, ep_returns = env.step(actions)
-        pri = estimator.push(q, actions, rewards, terminals) if estimator else None
-        memory.append_batch(obs, actions, rewards, terminals, pri)
-        stacker.reset_lanes(terminals)
+        new_obs, rewards, terminals, truncs, ep_returns = env.step(actions)
+        cuts = terminals | truncs  # truncation cuts windows like a terminal
+        pri = estimator.push(q, actions, rewards, cuts) if estimator else None
+        memory.append_batch(obs, actions, rewards, cuts, pri)
+        stacker.reset_lanes(cuts)
         obs = new_obs
         frames += lanes
         for r in ep_returns[~np.isnan(ep_returns)]:

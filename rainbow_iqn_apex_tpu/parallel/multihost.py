@@ -1,0 +1,88 @@
+"""Multi-host scaffolding: one SPMD program per pod host.
+
+Parity: the reference scales by pointing more actor *processes* (possibly on
+other machines) at shared Redis servers (SURVEY.md §2 rows 6-7).  The
+TPU-native multi-host shape (north star BASELINE.json:5) keeps the same
+topology but swaps the transport:
+
+  reference                       multi-host here
+  ----------------------------    ------------------------------------------
+  redis-server per shard host     one replay shard in each host's DRAM
+  actors dial their shard         each host's env lanes append LOCALLY
+  learner fetches over TCP        each host feeds the dp-sharded learn step
+                                  its LOCAL sub-batch (jax.make_array_from_
+                                  single_device_arrays); the gradient
+                                  all-reduce over ICI/DCN is the only
+                                  cross-host traffic XLA inserts
+  weight mailbox over TCP         params already replicated by the mesh
+
+`initialize()` wraps jax.distributed.initialize; `host_lanes`/`host_shard`
+carve the global lane/shard space by process index so apex.train_apex can be
+driven per host with purely local replay.  This module is exercised on a
+single host (process_count == 1) in CI; multi-host execution needs a real
+multi-host slice, which this sandbox does not provide (SURVEY.md §7).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional, Tuple
+
+import jax
+
+
+def initialize(
+    coordinator_address: Optional[str] = None,
+    num_processes: Optional[int] = None,
+    process_id: Optional[int] = None,
+) -> None:
+    """Initialise the JAX distributed runtime (no-op if single-process args).
+
+    On TPU pods the three arguments are inferred from the environment; on
+    CPU/GPU clusters pass them explicitly (reference parity: the redis
+    host/port CLI flags, SURVEY §2 row 1, become the coordinator address).
+    """
+    if num_processes is not None and num_processes <= 1:
+        return
+    kwargs = {}
+    if coordinator_address is not None:
+        kwargs["coordinator_address"] = coordinator_address
+    if num_processes is not None:
+        kwargs["num_processes"] = num_processes
+    if process_id is not None:
+        kwargs["process_id"] = process_id
+    jax.distributed.initialize(**kwargs)
+
+
+@dataclasses.dataclass(frozen=True)
+class HostTopology:
+    process_id: int
+    process_count: int
+    local_devices: int
+    global_devices: int
+
+    @classmethod
+    def current(cls) -> "HostTopology":
+        return cls(
+            process_id=jax.process_index(),
+            process_count=jax.process_count(),
+            local_devices=jax.local_device_count(),
+            global_devices=jax.device_count(),
+        )
+
+    def host_lanes(self, lanes_total: int) -> Tuple[int, int]:
+        """This host's [start, end) slice of the global env-lane space."""
+        if lanes_total % self.process_count:
+            raise ValueError(
+                f"{lanes_total} lanes do not divide over {self.process_count} hosts"
+            )
+        per = lanes_total // self.process_count
+        return self.process_id * per, (self.process_id + 1) * per
+
+    def host_shard(self, num_shards: int) -> int:
+        """Replay shard owned by this host (one shard per host by default)."""
+        if num_shards % self.process_count:
+            raise ValueError(
+                f"{num_shards} shards do not divide over {self.process_count} hosts"
+            )
+        return self.process_id * (num_shards // self.process_count)

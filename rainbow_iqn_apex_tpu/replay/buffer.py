@@ -238,6 +238,40 @@ class PrioritizedReplay:
             prob=prob,
         )
 
+    # -------------------------------------------------------------- snapshot
+    def snapshot(self, path: str) -> None:
+        """Persist the full replay state (parity: the reference's replay
+        survives via Redis RDB/AOF persistence, SURVEY.md §5 'Checkpoint';
+        here one compressed npz per shard)."""
+        np.savez_compressed(
+            path,
+            frames=self.frames,
+            actions=self.actions,
+            rewards=self.rewards,
+            terminals=self.terminals,
+            tree=self.tree.tree,
+            pos=self.pos,
+            filled=self.filled,
+            max_priority=self.max_priority,
+        )
+
+    def restore(self, path: str) -> None:
+        if not path.endswith(".npz"):
+            path += ".npz"  # np.savez auto-appends on save; mirror it here
+        z = np.load(path)
+        if z["frames"].shape != self.frames.shape:
+            raise ValueError(
+                f"snapshot shape {z['frames'].shape} != buffer {self.frames.shape}"
+            )
+        self.frames[:] = z["frames"]
+        self.actions[:] = z["actions"]
+        self.rewards[:] = z["rewards"]
+        self.terminals[:] = z["terminals"]
+        self.tree.tree[:] = z["tree"]
+        self.pos = int(z["pos"])
+        self.filled = int(z["filled"])
+        self.max_priority = float(z["max_priority"])
+
     # -------------------------------------------------------------- priorities
     def update_priorities(self, idx: np.ndarray, td_abs: np.ndarray) -> None:
         """Learner write-back: p = (|TD| + eps)^omega (reference semantics)."""

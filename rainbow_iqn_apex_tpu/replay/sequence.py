@@ -1,0 +1,192 @@
+"""Stored-state sequence replay for R2D2.
+
+Parity: the reference's R2D2 stretch config (BASELINE.json:10; SURVEY.md §5
+"long-context": sequence replay is replay-format work — stored LSTM state +
+burn-in — not sequence-parallel compute).  Design per Kapturowski et al.:
+
+- actors chop each lane's episode stream into fixed-length sequences of
+  L = burn_in + seq_len steps, adjacent sequences overlapping by L - stride;
+- each sequence records the actor's LSTM state at its first step (the
+  "stored state" that seeds burn-in at training time) — exact for overlapped
+  windows too, via a per-step state history;
+- sequences never mix episodes: a terminal inside the window ends the valid
+  region and the remainder is zero-padded with valid=False;
+- a sum-tree prioritizes whole sequences (max-priority on insert, eta-mix
+  write-back from the learner).
+
+Storage is sequence-major NumPy: frames are duplicated across overlapping
+windows (factor ~L/stride) in exchange for contiguous [B, L] gathers that
+feed the TPU directly — the dedup trick of the frame replay doesn't pay here
+because the LSTM needs contiguous time anyway.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional, Tuple
+
+import numpy as np
+
+from rainbow_iqn_apex_tpu.replay.sumtree import SumTree
+
+
+@dataclasses.dataclass
+class SequenceSample:
+    idx: np.ndarray  # [B] sequence slot ids
+    obs: np.ndarray  # [B, L, H, W, 1] uint8
+    action: np.ndarray  # [B, L] int32
+    reward: np.ndarray  # [B, L] f32
+    done: np.ndarray  # [B, L] bool
+    valid: np.ndarray  # [B, L] bool
+    init_c: np.ndarray  # [B, lstm] f32
+    init_h: np.ndarray  # [B, lstm] f32
+    weight: np.ndarray  # [B] f32
+
+
+class SequenceReplay:
+    """Prioritized ring of fixed-length sequences with stored LSTM states."""
+
+    def __init__(
+        self,
+        capacity: int,  # number of sequences
+        seq_len: int,  # L = burn_in + trained steps
+        frame_shape: Tuple[int, int],
+        lstm_size: int,
+        lanes: int = 1,
+        stride: Optional[int] = None,  # steps between sequence starts
+        priority_exponent: float = 0.9,
+        priority_eps: float = 1e-6,
+        seed: int = 0,
+    ):
+        if stride is not None and not (0 < stride <= seq_len):
+            raise ValueError("stride must be in (0, seq_len]")
+        self.capacity = capacity
+        self.L = seq_len
+        self.lanes = lanes
+        self.stride = stride or max(seq_len // 2, 1)
+        self.omega = priority_exponent
+        self.eps = priority_eps
+        self.rng = np.random.default_rng(seed)
+
+        h, w = frame_shape
+        self.frames = np.zeros((capacity, seq_len, h, w), np.uint8)
+        self.actions = np.zeros((capacity, seq_len), np.int32)
+        self.rewards = np.zeros((capacity, seq_len), np.float32)
+        self.dones = np.zeros((capacity, seq_len), bool)
+        self.valids = np.zeros((capacity, seq_len), bool)
+        self.init_c = np.zeros((capacity, lstm_size), np.float32)
+        self.init_h = np.zeros((capacity, lstm_size), np.float32)
+
+        self.tree = SumTree(capacity)
+        self.pos = 0
+        self.filled = 0
+        self.max_priority = 1.0
+
+        # ---- per-lane builders: step data + the actor LSTM state BEFORE
+        # each buffered step (so any window start has its exact state) ------
+        self._buf_frames = np.zeros((lanes, seq_len, h, w), np.uint8)
+        self._buf_actions = np.zeros((lanes, seq_len), np.int32)
+        self._buf_rewards = np.zeros((lanes, seq_len), np.float32)
+        self._buf_dones = np.zeros((lanes, seq_len), bool)
+        self._buf_c = np.zeros((lanes, seq_len, lstm_size), np.float32)
+        self._buf_h = np.zeros((lanes, seq_len, lstm_size), np.float32)
+        self._buf_len = np.zeros(lanes, np.int64)
+
+    # -------------------------------------------------------------- building
+    def append_batch(
+        self,
+        frames: np.ndarray,  # [lanes, H, W] uint8 — frame the action saw
+        actions: np.ndarray,
+        rewards: np.ndarray,
+        terminals: np.ndarray,
+        lstm_c: np.ndarray,  # [lanes, lstm] actor state BEFORE this step
+        lstm_h: np.ndarray,
+    ) -> int:
+        """Push one lockstep tick; emits completed sequences. Returns the
+        number of sequences emitted this tick."""
+        emitted = 0
+        for i in range(self.lanes):
+            k = int(self._buf_len[i])
+            self._buf_frames[i, k] = frames[i]
+            self._buf_actions[i, k] = actions[i]
+            self._buf_rewards[i, k] = rewards[i]
+            self._buf_dones[i, k] = terminals[i]
+            self._buf_c[i, k] = lstm_c[i]
+            self._buf_h[i, k] = lstm_h[i]
+            self._buf_len[i] = k + 1
+
+            if terminals[i] or self._buf_len[i] == self.L:
+                emitted += self._emit(i, flush=bool(terminals[i]))
+        return emitted
+
+    def _emit(self, lane: int, flush: bool) -> int:
+        """Store the lane's buffered window as one sequence.  On flush
+        (terminal) the builder restarts empty; otherwise the last
+        L - stride steps carry over so adjacent sequences overlap, seeded
+        with the exact stored state from the per-step history."""
+        k = int(self._buf_len[lane])
+        if k == 0:
+            return 0
+        slot = self.pos
+        for store, buf in (
+            (self.frames, self._buf_frames),
+            (self.actions, self._buf_actions),
+            (self.rewards, self._buf_rewards),
+            (self.dones, self._buf_dones),
+        ):
+            store[slot] = 0
+            store[slot, :k] = buf[lane, :k]
+        self.valids[slot] = False
+        self.valids[slot, :k] = True
+        self.init_c[slot] = self._buf_c[lane, 0]
+        self.init_h[slot] = self._buf_h[lane, 0]
+        self.tree.set(np.asarray([slot]), np.asarray([self.max_priority]))
+        self.pos = (self.pos + 1) % self.capacity
+        self.filled = min(self.filled + 1, self.capacity)
+
+        if flush:
+            self._buf_len[lane] = 0
+        else:
+            tail = self.L - self.stride
+            if tail > 0:
+                for buf in (
+                    self._buf_frames,
+                    self._buf_actions,
+                    self._buf_rewards,
+                    self._buf_dones,
+                    self._buf_c,
+                    self._buf_h,
+                ):
+                    buf[lane, :tail] = buf[lane, self.stride :].copy()
+            self._buf_len[lane] = tail
+        return 1
+
+    def __len__(self) -> int:
+        return self.filled
+
+    @property
+    def sampleable(self) -> bool:
+        return self.tree.total > 0
+
+    # -------------------------------------------------------------- sampling
+    def sample(self, batch_size: int, beta: float) -> SequenceSample:
+        idx, prob = self.tree.sample_stratified(batch_size, self.rng)
+        prob = np.maximum(prob, 1e-12)
+        weights = (self.filled * prob) ** (-beta)
+        weights = (weights / weights.max()).astype(np.float32)
+        return SequenceSample(
+            idx=idx,
+            obs=self.frames[idx][..., None],
+            action=self.actions[idx],
+            reward=self.rewards[idx],
+            done=self.dones[idx],
+            valid=self.valids[idx],
+            init_c=self.init_c[idx],
+            init_h=self.init_h[idx],
+            weight=weights,
+        )
+
+    def update_priorities(self, idx: np.ndarray, td_mix: np.ndarray) -> None:
+        pri = (np.asarray(td_mix, np.float64) + self.eps) ** self.omega
+        self.max_priority = max(self.max_priority, float(pri.max()))
+        self.tree.set(idx, pri)

@@ -74,11 +74,13 @@ def train(cfg: Config, max_frames: Optional[int] = None) -> Dict[str, Any]:
     while frames < total_frames:
         stacked = stacker.push(obs)
         actions = agent.act(stacked)
-        new_obs, rewards, terminals, ep_returns = env.step(actions)
+        new_obs, rewards, terminals, truncs, ep_returns = env.step(actions)
         # store the pre-step frame with the transition's reward/terminal
-        # (reference memory layout: SURVEY §2 row 5 frame-dedup scheme)
-        memory.append_batch(obs, actions, rewards, terminals)
-        stacker.reset_lanes(terminals)
+        # (reference memory layout: SURVEY §2 row 5 frame-dedup scheme).
+        # Truncations cut windows like terminals (reference SABER-cap
+        # behaviour; see docs/DESIGN.md known deviations).
+        memory.append_batch(obs, actions, rewards, terminals | truncs)
+        stacker.reset_lanes(terminals | truncs)
         obs = new_obs
         frames += lanes
         for r in ep_returns[~np.isnan(ep_returns)]:

@@ -1,0 +1,69 @@
+"""Tracing / profiling hooks.
+
+Parity: the reference has no first-party tracing (SURVEY.md §5); the build
+contract asks for JAX profiler traces plus block_until_ready-bracketed step
+timing and per-role FPS counters (FPS lives in MetricsLogger.fps)."""
+
+from __future__ import annotations
+
+import contextlib
+import time
+from typing import Dict, Iterator, Optional
+
+import jax
+
+
+@contextlib.contextmanager
+def device_trace(logdir: Optional[str]) -> Iterator[None]:
+    """Capture a JAX profiler trace (TensorBoard/xplane format) around a code
+    region.  No-op when logdir is None, so call sites can be unconditional."""
+    if not logdir:
+        yield
+        return
+    jax.profiler.start_trace(logdir)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()
+
+
+class StepTimer:
+    """Wall-clock timing of device steps with explicit completion barriers.
+
+    Usage:
+        with timer.step(result_to_block_on):
+            ...
+    or functional:  timer.lap(info["loss"]) each step, then timer.stats().
+    """
+
+    def __init__(self, warmup: int = 3):
+        self.warmup = warmup
+        self._laps = []
+        self._count = 0
+        self._last: Optional[float] = None
+
+    def lap(self, block_on=None) -> Optional[float]:
+        if block_on is not None:
+            jax.block_until_ready(block_on)
+        now = time.perf_counter()
+        dt = None
+        if self._last is not None:
+            dt = now - self._last
+            self._count += 1
+            if self._count > self.warmup:
+                self._laps.append(dt)
+        self._last = now
+        return dt
+
+    def stats(self) -> Dict[str, float]:
+        if not self._laps:
+            return {"steps": 0}
+        laps = sorted(self._laps)
+        n = len(laps)
+        return {
+            "steps": n,
+            "mean_s": sum(laps) / n,
+            "p50_s": laps[n // 2],
+            "p90_s": laps[int(n * 0.9)],
+            "steps_per_sec": n / sum(laps),
+        }

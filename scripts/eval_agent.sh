@@ -1,0 +1,6 @@
+#!/usr/bin/env bash
+# SABER evaluation of a checkpoint (reference parity: test_agent.py usage).
+set -euo pipefail
+GAME="${1:-Pong}"
+RUN_ID="${2:?usage: eval_agent.sh GAME RUN_ID [extra flags]}"
+exec python test_agent.py --env-id "atari:${GAME}" --run-id "${RUN_ID}" "${@:3}"

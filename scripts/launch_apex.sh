@@ -1,0 +1,20 @@
+#!/usr/bin/env bash
+# Launch glue (parity: the reference's README/shell instructions that start
+# redis-server instances and point learner/actor processes at them —
+# SURVEY.md par.2 row 10). The TPU-native launch is ONE command per host:
+# there is no external replay server to start, and learner + actors are a
+# single SPMD program over the host's slice.
+set -euo pipefail
+
+GAME="${1:-Pong}"
+RUN_ID="${2:-apex_$(date +%s)}"
+
+exec python train_agent_apex.py \
+  --role apex \
+  --env-id "atari:${GAME}" \
+  --run-id "${RUN_ID}" \
+  --num-actors 4 --num-envs-per-actor 16 \
+  --replay-shards 2 \
+  --learner-devices 0 \
+  --t-max 200000000 \
+  "${@:3}"

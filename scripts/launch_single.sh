@@ -1,0 +1,5 @@
+#!/usr/bin/env bash
+# Single-process Rainbow-IQN (reference parity: the 1-actor no-Ape-X mode).
+set -euo pipefail
+GAME="${1:-Pong}"
+exec python train_agent_apex.py --role single --env-id "atari:${GAME}" "${@:2}"

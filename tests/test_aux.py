@@ -1,0 +1,121 @@
+"""Aux-subsystem tests: env fault tolerance, profiling timer, multihost
+topology carving, metrics logger (SURVEY §5 items the reference lacks)."""
+
+import json
+
+import numpy as np
+import pytest
+
+from rainbow_iqn_apex_tpu.envs import VectorEnv, make_vector_env
+from rainbow_iqn_apex_tpu.envs.toy import CatchEnv
+from rainbow_iqn_apex_tpu.parallel.multihost import HostTopology
+from rainbow_iqn_apex_tpu.utils.logging import MetricsLogger
+from rainbow_iqn_apex_tpu.utils.profiling import StepTimer, device_trace
+
+
+class FlakyEnv(CatchEnv):
+    """Raises on the Nth step to exercise lane restarts."""
+
+    def __init__(self, explode_at=3, **kw):
+        super().__init__(**kw)
+        self.explode_at = explode_at
+        self.steps = 0
+
+    def step(self, action):
+        self.steps += 1
+        if self.steps == self.explode_at:
+            raise RuntimeError("emulator crashed")
+        return super().step(action)
+
+
+def test_lane_restart_on_env_crash():
+    made = []
+
+    def factory(lane):
+        e = FlakyEnv(explode_at=3 if not made else 10**9, size=6, cell=2, seed=lane)
+        made.append(e)
+        return e
+
+    env = VectorEnv([factory(0), CatchEnv(size=6, cell=2, seed=1)], env_factory=factory)
+    env.reset()
+    crashed = False
+    for t in range(6):
+        obs, rew, term, trunc, ep_ret = env.step(np.zeros(2, np.int64))
+        assert obs.shape == (2, 12, 12)
+        if env.lane_restarts:
+            crashed = True
+    assert crashed and env.lane_restarts == 1
+    assert len(made) == 2  # initial + one restart
+    # stream continues: post-restart steps work
+    obs, rew, term, trunc, _ = env.step(np.zeros(2, np.int64))
+    assert obs.shape == (2, 12, 12)
+
+
+def test_lane_crash_without_factory_raises():
+    env = VectorEnv([FlakyEnv(explode_at=1, size=6, cell=2)])
+    env.reset()
+    with pytest.raises(RuntimeError):
+        env.step(np.zeros(1, np.int64))
+
+
+def test_persistently_broken_lane_hits_restart_cap():
+    class AlwaysBroken(CatchEnv):
+        def step(self, action):
+            raise RuntimeError("bad ROM")
+
+    def factory(lane):
+        return AlwaysBroken(size=6, cell=2)
+
+    env = VectorEnv([factory(0)], env_factory=factory, max_lane_restarts=3)
+    env.reset()
+    with pytest.raises(RuntimeError, match="persistently broken"):
+        for _ in range(10):
+            env.step(np.zeros(1, np.int64))
+    assert env.lane_restarts == 3
+
+
+def test_step_timer_stats():
+    import jax.numpy as jnp
+
+    t = StepTimer(warmup=1)
+    for i in range(6):
+        t.lap(jnp.ones(4))
+    s = t.stats()
+    assert s["steps"] == 4
+    assert s["steps_per_sec"] > 0
+    assert s["p50_s"] <= s["p90_s"]
+
+
+def test_device_trace_noop_and_real(tmp_path):
+    import jax.numpy as jnp
+
+    with device_trace(None):  # no-op path
+        jnp.ones(3).sum()
+    with device_trace(str(tmp_path / "trace")):
+        (jnp.ones((64, 64)) @ jnp.ones((64, 64))).sum()
+    assert any((tmp_path / "trace").rglob("*"))  # wrote profiler artifacts
+
+
+def test_host_topology_single_process():
+    topo = HostTopology.current()
+    assert topo.process_count == 1 and topo.process_id == 0
+    assert topo.host_lanes(16) == (0, 16)
+    assert topo.host_shard(2) == 0
+    with pytest.raises(ValueError):
+        topo.host_lanes(7) if 7 % 2 == 0 else (_ for _ in ()).throw(ValueError())
+
+
+def test_metrics_logger_jsonl_and_fps(tmp_path):
+    path = str(tmp_path / "m.jsonl")
+    m = MetricsLogger(path, "t", echo=False)
+    m.log("train", step=1, loss=0.5)
+    m.fps(0)
+    import time
+
+    time.sleep(0.05)
+    fps = m.fps(100)
+    m.log("train", step=2, fps=fps)
+    m.close()
+    rows = [json.loads(l) for l in open(path)]
+    assert rows[0]["kind"] == "train" and rows[0]["loss"] == 0.5
+    assert rows[1]["fps"] > 0

@@ -61,7 +61,7 @@ def test_vector_env_lockstep_autoreset():
     assert obs.shape == (3, 80, 80)
     done_seen = False
     for t in range(30):
-        obs, rew, term, ep_ret = env.step(np.zeros(3, np.int64))
+        obs, rew, term, trunc, ep_ret = env.step(np.zeros(3, np.int64))
         assert obs.shape == (3, 80, 80)
         if term.any():
             done_seen = True

@@ -1,0 +1,266 @@
+"""R2D2 tests: value rescaling, recurrent unroll semantics, sequence replay
+invariants, the burn-in learn step, and a short end-to-end learning run."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from rainbow_iqn_apex_tpu.config import Config
+from rainbow_iqn_apex_tpu.models.r2d2 import R2D2Net
+from rainbow_iqn_apex_tpu.ops.r2d2 import (
+    SequenceBatch,
+    build_r2d2_learn_step,
+    init_r2d2_state,
+    value_rescale,
+    value_unrescale,
+)
+from rainbow_iqn_apex_tpu.replay.sequence import SequenceReplay
+
+CFG = Config(
+    compute_dtype="float32",
+    history_length=1,
+    hidden_size=32,
+    lstm_size=32,
+    r2d2_burn_in=4,
+    r2d2_seq_len=8,
+    r2d2_overlap=4,
+    multi_step=2,
+    gamma=0.9,
+    batch_size=4,
+    learning_rate=1e-3,
+    target_update_period=10,
+)
+A = 3
+FRAME = (44, 44)
+L = CFG.r2d2_burn_in + CFG.r2d2_seq_len  # 12
+
+
+# ------------------------------------------------------------ value rescale
+def test_value_rescale_roundtrip():
+    x = jnp.array([-100.0, -1.0, -1e-4, 0.0, 1e-4, 1.0, 7.3, 1000.0])
+    np.testing.assert_allclose(value_unrescale(value_rescale(x)), x, rtol=1e-4, atol=1e-5)
+
+
+def test_value_rescale_compresses():
+    assert float(value_rescale(jnp.asarray(100.0))) < 100.0
+    assert float(value_rescale(jnp.asarray(100.0))) > 0.0
+    np.testing.assert_allclose(float(value_rescale(jnp.asarray(0.0))), 0.0)
+
+
+# ------------------------------------------------------------------- model
+def _init_net():
+    net = R2D2Net(num_actions=A, lstm_size=32, hidden_size=32,
+                  compute_dtype=jnp.float32)
+    obs = jnp.zeros((2, 3, *FRAME, 1), jnp.uint8)
+    params = net.init(
+        {"params": jax.random.PRNGKey(0), "noise": jax.random.PRNGKey(1)},
+        obs,
+        net.initial_state(2),
+    )["params"]
+    return net, params
+
+
+def test_unroll_shapes_and_state_carry():
+    net, params = _init_net()
+    obs = jax.random.randint(jax.random.PRNGKey(2), (2, 5, *FRAME, 1), 0, 255).astype(jnp.uint8)
+    q, state = net.apply({"params": params}, obs, net.initial_state(2),
+                         rngs={"noise": jax.random.PRNGKey(3)})
+    assert q.shape == (2, 5, A)
+    assert state[0].shape == (2, 32) and state[1].shape == (2, 32)
+    assert not np.allclose(np.asarray(state[1]), 0)
+
+
+def test_unroll_equals_stepwise():
+    """One 5-step unroll == five 1-step calls threading the state."""
+    net, params = _init_net()
+    obs = jax.random.randint(jax.random.PRNGKey(4), (1, 5, *FRAME, 1), 0, 255).astype(jnp.uint8)
+    key = jax.random.PRNGKey(5)
+    q_full, state_full = net.apply({"params": params}, obs, net.initial_state(1),
+                                   rngs={"noise": key})
+    state = net.initial_state(1)
+    qs = []
+    for t in range(5):
+        q_t, state = net.apply({"params": params}, obs[:, t : t + 1], state,
+                               rngs={"noise": key})  # same noise each step
+        qs.append(q_t[:, 0])
+    np.testing.assert_allclose(np.asarray(q_full[0]), np.asarray(jnp.stack(qs, 1)[0]),
+                               rtol=2e-4, atol=2e-4)
+
+
+def test_reset_flag_cuts_memory():
+    """With a reset at t, outputs from t onward must not depend on the past."""
+    net, params = _init_net()
+    key = jax.random.PRNGKey(6)
+    obs_a = jax.random.randint(jax.random.PRNGKey(7), (1, 4, *FRAME, 1), 0, 255).astype(jnp.uint8)
+    obs_b = obs_a.at[:, :2].set(0)  # different history before the reset
+    resets = jnp.array([[False, False, True, False]])
+    q_a, _ = net.apply({"params": params}, obs_a, net.initial_state(1),
+                       resets=resets, rngs={"noise": key})
+    q_b, _ = net.apply({"params": params}, obs_b, net.initial_state(1),
+                       resets=resets, rngs={"noise": key})
+    assert not np.allclose(np.asarray(q_a[:, 1]), np.asarray(q_b[:, 1]))  # pre-reset differs
+    np.testing.assert_allclose(np.asarray(q_a[:, 2:]), np.asarray(q_b[:, 2:]),
+                               rtol=1e-5, atol=1e-5)  # post-reset identical
+
+
+# --------------------------------------------------------- sequence replay
+def _seq_mem(lanes=1, **kw):
+    kw.setdefault("stride", 4)
+    return SequenceReplay(32, 8, (4, 4), lstm_size=6, lanes=lanes, **kw)
+
+
+def _tick(mem, t, lane_vals=None, terminal=False, lanes=1):
+    f = np.full((lanes, 4, 4), t % 256, np.uint8)
+    mem.append_batch(
+        f,
+        np.full(lanes, t, np.int32),
+        np.full(lanes, float(t), np.float32),
+        np.full(lanes, terminal, bool),
+        np.full((lanes, 6), 10.0 * t, np.float32),
+        np.full((lanes, 6), -10.0 * t, np.float32),
+    )
+
+
+def test_sequence_emission_and_overlap():
+    mem = _seq_mem()
+    for t in range(16):
+        _tick(mem, t)
+    # window emits at t=7 (8 steps), then every stride=4: t=11, t=15
+    assert len(mem) == 3
+    s = mem.sample(8, beta=1.0)
+    # first sequence: actions 0..7, stored state from t=0
+    i0 = np.flatnonzero(s.idx == 0)[0]
+    np.testing.assert_array_equal(s.action[i0], np.arange(8))
+    np.testing.assert_allclose(s.init_c[i0], 0.0)
+    # second sequence starts at t=4 (overlap 4): actions 4..11, state from t=4
+    i1 = np.flatnonzero(s.idx == 1)
+    if i1.size:
+        np.testing.assert_array_equal(s.action[i1[0]], np.arange(4, 12))
+        np.testing.assert_allclose(s.init_c[i1[0]], 40.0)
+
+
+def test_sequence_terminal_flush_pads():
+    mem = _seq_mem()
+    for t in range(5):
+        _tick(mem, t, terminal=(t == 4))
+    assert len(mem) == 1
+    s = mem.sample(4, beta=1.0)
+    assert s.valid[0, :5].all() and not s.valid[0, 5:].any()
+    assert s.done[0, 4] and not s.done[0, :4].any()
+    # next episode starts a fresh window (no carry across terminal)
+    for t in range(8):
+        _tick(mem, 100 + t)
+    assert len(mem) == 2
+    s2 = mem.sample(8, beta=1.0)
+    i1 = np.flatnonzero(s2.idx == 1)[0]
+    np.testing.assert_array_equal(s2.action[i1], np.arange(100, 108))
+
+
+def test_sequence_priority_update():
+    mem = _seq_mem(priority_exponent=1.0)
+    for t in range(20):
+        _tick(mem, t)
+    s = mem.sample(4, beta=1.0)
+    mem.update_priorities(np.array([int(s.idx[0])]), np.array([100.0]))
+    hits = 0
+    for _ in range(20):
+        hits += (mem.sample(8, beta=0.5).idx == s.idx[0]).sum()
+    assert hits > 80  # dominates sampling
+
+
+# -------------------------------------------------------------- learn step
+def _seq_batch(key, b=4):
+    ks = jax.random.split(key, 3)
+    return SequenceBatch(
+        obs=jax.random.randint(ks[0], (b, L, *FRAME, 1), 0, 255).astype(jnp.uint8),
+        action=jax.random.randint(ks[1], (b, L), 0, A).astype(jnp.int32),
+        reward=jax.random.normal(ks[2], (b, L)),
+        done=jnp.zeros((b, L), bool),
+        valid=jnp.ones((b, L), bool),
+        init_c=jnp.zeros((b, 32)),
+        init_h=jnp.zeros((b, 32)),
+        weight=jnp.ones((b,)),
+    )
+
+
+@pytest.fixture(scope="module")
+def r2d2_setup():
+    state = init_r2d2_state(CFG, A, jax.random.PRNGKey(0), FRAME)
+    step = jax.jit(build_r2d2_learn_step(CFG, A), donate_argnums=0)
+    return state, step
+
+
+def test_r2d2_learn_step_runs(r2d2_setup):
+    state, step = r2d2_setup
+    state = jax.tree.map(jnp.copy, state)
+    new_state, info = step(state, _seq_batch(jax.random.PRNGKey(1)), jax.random.PRNGKey(2))
+    assert int(new_state.step) == 1
+    assert np.isfinite(float(info["loss"]))
+    assert float(info["grad_norm"]) > 0
+    assert info["priorities"].shape == (4,)
+
+
+def test_r2d2_loss_decreases_on_fixed_batch(r2d2_setup):
+    state, step = r2d2_setup
+    state = jax.tree.map(jnp.copy, state)
+    batch = _seq_batch(jax.random.PRNGKey(42))
+    key = jax.random.PRNGKey(7)
+    first = last = None
+    for i in range(60):
+        state, info = step(state, batch, key)
+        if first is None:
+            first = float(info["loss"])
+    last = float(info["loss"])
+    assert last < 0.6 * first, (first, last)
+
+
+def test_r2d2_invalid_steps_do_not_contribute(r2d2_setup):
+    state, step = r2d2_setup
+    b = _seq_batch(jax.random.PRNGKey(3))
+    all_invalid = SequenceBatch(
+        obs=b.obs, action=b.action, reward=b.reward, done=b.done,
+        valid=jnp.zeros_like(b.valid), init_c=b.init_c, init_h=b.init_h,
+        weight=b.weight,
+    )
+    s = jax.tree.map(jnp.copy, state)
+    _, info = step(s, all_invalid, jax.random.PRNGKey(4))
+    np.testing.assert_allclose(float(info["loss"]), 0.0, atol=1e-7)
+    np.testing.assert_allclose(np.asarray(info["priorities"]), 0.0, atol=1e-7)
+
+
+@pytest.mark.slow
+def test_r2d2_learns_catch(tmp_path):
+    from rainbow_iqn_apex_tpu.train_r2d2 import train_r2d2
+
+    cfg = Config(
+        env_id="toy:catch",
+        compute_dtype="float32",
+        history_length=1,
+        hidden_size=64,
+        lstm_size=64,
+        r2d2_burn_in=2,
+        r2d2_seq_len=10,
+        r2d2_overlap=4,
+        multi_step=2,
+        gamma=0.9,
+        batch_size=16,
+        learning_rate=2e-3,
+        target_update_period=100,
+        memory_capacity=40_000,
+        learn_start=2_000,
+        replay_ratio=1,  # 1 step / seq_len(=10) frames -> 2000 steps @ 20k
+        num_envs_per_actor=8,
+        metrics_interval=100,
+        checkpoint_interval=0,
+        eval_interval=0,
+        eval_episodes=30,
+        results_dir=str(tmp_path / "results"),
+        checkpoint_dir=str(tmp_path / "ckpt"),
+        seed=3,
+    )
+    summary = train_r2d2(cfg, max_frames=20_000)
+    assert summary["learn_steps"] > 100
+    # the same cadence (2000 learn steps) reached eval 1.0 (perfect) in the
+    # tuning run; require a solid margin over random (-0.6)
+    assert summary["eval_score_mean"] > 0.3, summary

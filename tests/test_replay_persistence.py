@@ -1,0 +1,93 @@
+"""Replay snapshot/restore + concurrent append/sample stress.
+
+SURVEY §5: the reference's replay persistence is Redis RDB; its concurrency
+story is redis's single-threaded command loop.  Here: npz snapshots, and the
+in-process single-writer-per-shard discipline exercised under real threads."""
+
+import threading
+
+import numpy as np
+import pytest
+
+from rainbow_iqn_apex_tpu.replay import PrioritizedReplay
+
+
+def _mk(**kw):
+    kw.setdefault("use_native", False)
+    return PrioritizedReplay(128, (8, 8), history=2, n_step=2, gamma=0.9, **kw)
+
+
+def _fill(mem, n, start=0):
+    for t in range(n):
+        mem.append(
+            np.full((8, 8), (start + t) % 256, np.uint8), t % 3, float(t), t % 11 == 10
+        )
+
+
+def test_snapshot_roundtrip(tmp_path):
+    mem = _mk(seed=1)
+    _fill(mem, 100)
+    p = str(tmp_path / "shard0.npz")
+    mem.snapshot(p)
+
+    mem2 = _mk(seed=1)
+    mem2.restore(p)
+    assert len(mem2) == len(mem)
+    assert mem2.tree.total == pytest.approx(mem.tree.total)
+    s1 = mem.sample(16, beta=0.5)
+    s2 = mem2.sample(16, beta=0.5)  # same rng state? not guaranteed -> compare storage
+    np.testing.assert_array_equal(mem.frames, mem2.frames)
+    np.testing.assert_array_equal(mem.terminals, mem2.terminals)
+    # restored buffer keeps working
+    _fill(mem2, 50, start=200)
+    b = mem2.sample(8, beta=1.0)
+    assert np.isfinite(b.weight).all()
+
+
+def test_snapshot_shape_mismatch_rejected(tmp_path):
+    mem = _mk()
+    _fill(mem, 20)
+    p = str(tmp_path / "s.npz")
+    mem.snapshot(p)
+    other = PrioritizedReplay(64, (8, 8), history=2, n_step=2, use_native=False)
+    with pytest.raises(ValueError):
+        other.restore(p)
+
+
+@pytest.mark.parametrize("use_native", [False, True])
+def test_concurrent_append_sample_stress(use_native):
+    """One writer thread (actor) + one sampler thread (learner) on the same
+    shard: the design's single-writer discipline must keep every sampled
+    batch internally consistent (no crashes, finite weights, valid shapes)."""
+    try:
+        mem = _mk(use_native=use_native, seed=3)
+    except RuntimeError:
+        pytest.skip("native tree unavailable")
+    _fill(mem, 64)
+    stop = threading.Event()
+    errors = []
+
+    def writer():
+        t = 0
+        while not stop.is_set():
+            mem.append(np.full((8, 8), t % 256, np.uint8), 0, 0.5, t % 7 == 6)
+            t += 1
+
+    def learner():
+        try:
+            for _ in range(300):
+                b = mem.sample(16, beta=0.6)
+                assert b.obs.shape == (16, 8, 8, 2)
+                assert np.isfinite(b.weight).all()
+                mem.update_priorities(b.idx, np.random.rand(16) + 0.1)
+        except Exception as e:  # pragma: no cover
+            errors.append(e)
+
+    w = threading.Thread(target=writer, daemon=True)
+    l = threading.Thread(target=learner)
+    w.start()
+    l.start()
+    l.join(timeout=60)
+    stop.set()
+    w.join(timeout=5)
+    assert not errors, errors

@@ -22,11 +22,29 @@ from rainbow_iqn_apex_tpu.config import parse_config
 
 def main(argv=None) -> int:
     cfg = parse_config(argv)
-    if cfg.role == "single":
+    if cfg.architecture not in ("iqn", "r2d2"):
+        print(
+            f"unknown --architecture '{cfg.architecture}' (want 'iqn' or 'r2d2')",
+            file=sys.stderr,
+        )
+        return 2
+    if cfg.role == "single" and cfg.architecture == "r2d2":
+        from rainbow_iqn_apex_tpu.train_r2d2 import train_r2d2
+
+        summary = train_r2d2(cfg)
+    elif cfg.role == "single":
         from rainbow_iqn_apex_tpu.train import train
 
         summary = train(cfg)
     elif cfg.role == "apex":
+        if cfg.architecture != "iqn":
+            print(
+                "--role apex currently trains the IQN architecture only; "
+                "r2d2 runs with --role single (mesh-parallel R2D2 is on the "
+                "roadmap, not silently substituted)",
+                file=sys.stderr,
+            )
+            return 2
         from rainbow_iqn_apex_tpu.parallel.apex import train_apex
 
         summary = train_apex(cfg)
