@@ -34,6 +34,18 @@ from rainbow_iqn_apex_tpu.ops.learn import (
 from rainbow_iqn_apex_tpu.replay.buffer import SampledBatch
 
 
+def to_device_batch(sample: SampledBatch) -> Batch:
+    """Host SampledBatch -> device Batch (async transfers via jnp.asarray)."""
+    return Batch(
+        obs=jnp.asarray(sample.obs),
+        action=jnp.asarray(sample.action),
+        reward=jnp.asarray(sample.reward),
+        next_obs=jnp.asarray(sample.next_obs),
+        discount=jnp.asarray(sample.discount),
+        weight=jnp.asarray(sample.weight),
+    )
+
+
 class FrameStacker:
     """Rolling [L, H, W, hist] uint8 stack with per-lane terminal reset."""
 
@@ -94,14 +106,10 @@ class Agent:
     def learn(self, sample: SampledBatch) -> Dict[str, Any]:
         """One learner step on a host SampledBatch; returns info with host
         priorities for the replay write-back."""
-        batch = Batch(
-            obs=jnp.asarray(sample.obs),
-            action=jnp.asarray(sample.action),
-            reward=jnp.asarray(sample.reward),
-            next_obs=jnp.asarray(sample.next_obs),
-            discount=jnp.asarray(sample.discount),
-            weight=jnp.asarray(sample.weight),
-        )
+        return self.learn_batch(to_device_batch(sample))
+
+    def learn_batch(self, batch: Batch) -> Dict[str, Any]:
+        """One learner step on an already-staged device Batch (prefetch path)."""
         self.state, info = self._learn(self.state, batch, self._next_key())
         return info
 

@@ -78,6 +78,7 @@ class Config:
 
     # ---- prioritized replay (SURVEY §2 rows 5-6) ----------------------------------
     memory_capacity: int = 1_000_000
+    prefetch_depth: int = 2  # learner batch pipeline depth; 0 disables
     priority_exponent: float = 0.5  # omega
     priority_weight: float = 0.4  # beta_0, annealed to 1 over training
     priority_eps: float = 1e-6

@@ -35,7 +35,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from rainbow_iqn_apex_tpu.agents.agent import FrameStacker
+from rainbow_iqn_apex_tpu.agents.agent import FrameStacker, to_device_batch
+from rainbow_iqn_apex_tpu.utils.prefetch import BatchPrefetcher, make_replay_prefetcher
 from rainbow_iqn_apex_tpu.config import Config
 from rainbow_iqn_apex_tpu.envs import make_vector_env
 from rainbow_iqn_apex_tpu.ops.learn import (
@@ -166,14 +167,9 @@ class ApexDriver:
         return np.asarray(a), np.asarray(q)
 
     def learn(self, sample) -> Dict[str, Any]:
-        batch = Batch(
-            obs=jnp.asarray(sample.obs),
-            action=jnp.asarray(sample.action),
-            reward=jnp.asarray(sample.reward),
-            next_obs=jnp.asarray(sample.next_obs),
-            discount=jnp.asarray(sample.discount),
-            weight=jnp.asarray(sample.weight),
-        )
+        return self.learn_batch(to_device_batch(sample))
+
+    def learn_batch(self, batch: Batch) -> Dict[str, Any]:
         self.state, info = self._learn(self.state, batch, self._next_key())
         return info
 
@@ -244,48 +240,62 @@ def train_apex(cfg: Config, max_frames: Optional[int] = None) -> Dict[str, Any]:
     returns: collections.deque = collections.deque(maxlen=100)
     frames = 0
     last_pub = 0
+    prefetcher: Optional[BatchPrefetcher] = None
 
-    while frames < total_frames:
-        stacked = stacker.push(obs)
-        actions, q = driver.act(stacked)
-        new_obs, rewards, terminals, truncs, ep_returns = env.step(actions)
-        cuts = terminals | truncs  # truncation cuts windows like a terminal
-        pri = estimator.push(q, actions, rewards, cuts) if estimator else None
-        memory.append_batch(obs, actions, rewards, cuts, pri)
-        stacker.reset_lanes(cuts)
-        obs = new_obs
-        frames += lanes
-        for r in ep_returns[~np.isnan(ep_returns)]:
-            returns.append(float(r))
+    try:
+        while frames < total_frames:
+            stacked = stacker.push(obs)
+            actions, q = driver.act(stacked)
+            new_obs, rewards, terminals, truncs, ep_returns = env.step(actions)
+            cuts = terminals | truncs  # truncation cuts windows like a terminal
+            pri = estimator.push(q, actions, rewards, cuts) if estimator else None
+            memory.append_batch(obs, actions, rewards, cuts, pri)
+            stacker.reset_lanes(cuts)
+            obs = new_obs
+            frames += lanes
+            for r in ep_returns[~np.isnan(ep_returns)]:
+                returns.append(float(r))
 
-        if len(memory) >= cfg.learn_start and memory.sampleable:
-            steps_due = frames // cfg.replay_ratio - driver.step
-            for _ in range(max(steps_due, 0)):
-                sample = memory.sample(cfg.batch_size, priority_beta(cfg, frames))
-                info = driver.learn(sample)
-                memory.update_priorities(sample.idx, np.asarray(info["priorities"]))
-                step = driver.step
-                if step - last_pub >= cfg.weight_publish_interval:
-                    driver.publish_weights()
-                    last_pub = step
-                if step % cfg.metrics_interval == 0:
-                    metrics.log(
-                        "train",
-                        step=step,
-                        frames=frames,
-                        fps=metrics.fps(frames),
-                        loss=float(info["loss"]),
-                        q_mean=float(info["q_mean"]),
-                        mean_return=float(np.mean(returns)) if returns else float("nan"),
-                        staleness=step - last_pub,
+            if len(memory) >= cfg.learn_start and memory.sampleable:
+                if cfg.prefetch_depth > 0 and prefetcher is None:
+                    prefetcher = make_replay_prefetcher(
+                        memory, cfg, lambda: priority_beta(cfg, frames)
                     )
-                if cfg.eval_interval and step % cfg.eval_interval == 0:
-                    metrics.log(
-                        "eval", step=step, **_eval_learner(cfg, env, driver)
-                    )
-                if cfg.checkpoint_interval and step % cfg.checkpoint_interval == 0:
-                    ckpt.save(step, driver.state, {"frames": frames})
+                steps_due = frames // cfg.replay_ratio - driver.step
+                for _ in range(max(steps_due, 0)):
+                    if prefetcher is not None:
+                        idx, batch = prefetcher.get()
+                        info = driver.learn_batch(batch)
+                    else:
+                        sample = memory.sample(cfg.batch_size, priority_beta(cfg, frames))
+                        idx = sample.idx
+                        info = driver.learn(sample)
+                    memory.update_priorities(idx, np.asarray(info["priorities"]))
+                    step = driver.step
+                    if step - last_pub >= cfg.weight_publish_interval:
+                        driver.publish_weights()
+                        last_pub = step
+                    if step % cfg.metrics_interval == 0:
+                        metrics.log(
+                            "train",
+                            step=step,
+                            frames=frames,
+                            fps=metrics.fps(frames),
+                            loss=float(info["loss"]),
+                            q_mean=float(info["q_mean"]),
+                            mean_return=float(np.mean(returns)) if returns else float("nan"),
+                            staleness=step - last_pub,
+                        )
+                    if cfg.eval_interval and step % cfg.eval_interval == 0:
+                        metrics.log(
+                            "eval", step=step, **_eval_learner(cfg, env, driver)
+                        )
+                    if cfg.checkpoint_interval and step % cfg.checkpoint_interval == 0:
+                        ckpt.save(step, driver.state, {"frames": frames})
 
+    finally:
+        if prefetcher is not None:
+            prefetcher.close()
     final_eval = _eval_learner(cfg, env, driver)
     metrics.log("eval", step=driver.step, **final_eval)
     ckpt.save(driver.step, driver.state, {"frames": frames})

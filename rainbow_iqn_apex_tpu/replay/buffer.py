@@ -23,6 +23,7 @@ TPU-first design notes:
 from __future__ import annotations
 
 import dataclasses
+import threading
 from typing import Optional, Tuple
 
 import numpy as np
@@ -98,6 +99,13 @@ class PrioritizedReplay:
         self.pos = 0  # lane-local write cursor (lockstep across lanes)
         self.filled = 0  # lane-local count of written slots (<= seg)
         self.max_priority = 1.0  # tree-space (already ^omega) value for new items
+        # Serialises the multi-statement append/sample/update sequences so a
+        # background prefetch thread (utils/prefetch.py) never observes a
+        # half-applied tree update or a frame array mid-overwrite. Held only
+        # for the ~ms host-side critical sections; device compute overlaps
+        # freely. This is the explicit single-writer discipline SURVEY §5
+        # calls for in place of Redis's single-threaded command loop.
+        self._lock = threading.Lock()
 
         # discount ladder gamma^0..gamma^n, reused every sample
         self._gammas = self.gamma ** np.arange(self.n_step + 1, dtype=np.float32)
@@ -116,6 +124,10 @@ class PrioritizedReplay:
         L = frames.shape[0]
         if L != self.lanes:
             raise ValueError(f"expected {self.lanes} lanes, got {L}")
+        with self._lock:
+            return self._append_locked(frames, actions, rewards, terminals, priorities)
+
+    def _append_locked(self, frames, actions, rewards, terminals, priorities):
         slots = self._lane_base + self.pos
         self.frames[slots] = frames
         self.actions[slots] = actions
@@ -199,6 +211,10 @@ class PrioritizedReplay:
 
     def sample(self, batch_size: int, beta: float) -> SampledBatch:
         """Stratified proportional sample + n-step assembly + IS weights."""
+        with self._lock:
+            return self._sample_locked(batch_size, beta)
+
+    def _sample_locked(self, batch_size: int, beta: float) -> SampledBatch:
         idx, prob = self.tree.sample_stratified(batch_size, self.rng)
         prob = np.maximum(prob, 1e-12)  # fp edge-fall can land on a zero leaf
         lane = idx // self.seg
@@ -243,6 +259,10 @@ class PrioritizedReplay:
         """Persist the full replay state (parity: the reference's replay
         survives via Redis RDB/AOF persistence, SURVEY.md §5 'Checkpoint';
         here one compressed npz per shard)."""
+        with self._lock:
+            self._snapshot_locked(path)
+
+    def _snapshot_locked(self, path: str) -> None:
         np.savez_compressed(
             path,
             frames=self.frames,
@@ -275,9 +295,10 @@ class PrioritizedReplay:
     # -------------------------------------------------------------- priorities
     def update_priorities(self, idx: np.ndarray, td_abs: np.ndarray) -> None:
         """Learner write-back: p = (|TD| + eps)^omega (reference semantics)."""
-        pri = (np.asarray(td_abs, np.float64) + self.eps) ** self.omega
-        self.max_priority = max(self.max_priority, float(pri.max()))
-        # Never resurrect slots the cursor has since invalidated.
-        current = self.tree.get(np.asarray(idx))
-        pri = np.where(current > 0, pri, 0.0)
-        self.tree.set(idx, pri)
+        with self._lock:
+            pri = (np.asarray(td_abs, np.float64) + self.eps) ** self.omega
+            self.max_priority = max(self.max_priority, float(pri.max()))
+            # Never resurrect slots the cursor has since invalidated.
+            current = self.tree.get(np.asarray(idx))
+            pri = np.where(current > 0, pri, 0.0)
+            self.tree.set(idx, pri)

@@ -17,6 +17,7 @@ import jax
 import numpy as np
 
 from rainbow_iqn_apex_tpu.agents.agent import Agent, FrameStacker
+from rainbow_iqn_apex_tpu.utils.prefetch import BatchPrefetcher, make_replay_prefetcher
 from rainbow_iqn_apex_tpu.config import Config
 from rainbow_iqn_apex_tpu.envs import make_vector_env
 from rainbow_iqn_apex_tpu.eval import evaluate
@@ -70,48 +71,63 @@ def train(cfg: Config, max_frames: Optional[int] = None) -> Dict[str, Any]:
     obs = env.reset()
     returns: collections.deque = collections.deque(maxlen=100)
     last_eval: Dict[str, Any] = {}
+    prefetcher: Optional[BatchPrefetcher] = None
 
-    while frames < total_frames:
-        stacked = stacker.push(obs)
-        actions = agent.act(stacked)
-        new_obs, rewards, terminals, truncs, ep_returns = env.step(actions)
-        # store the pre-step frame with the transition's reward/terminal
-        # (reference memory layout: SURVEY §2 row 5 frame-dedup scheme).
-        # Truncations cut windows like terminals (reference SABER-cap
-        # behaviour; see docs/DESIGN.md known deviations).
-        memory.append_batch(obs, actions, rewards, terminals | truncs)
-        stacker.reset_lanes(terminals | truncs)
-        obs = new_obs
-        frames += lanes
-        for r in ep_returns[~np.isnan(ep_returns)]:
-            returns.append(float(r))
+    try:
+        while frames < total_frames:
+            stacked = stacker.push(obs)
+            actions = agent.act(stacked)
+            new_obs, rewards, terminals, truncs, ep_returns = env.step(actions)
+            # store the pre-step frame with the transition's reward/terminal
+            # (reference memory layout: SURVEY §2 row 5 frame-dedup scheme).
+            # Truncations cut windows like terminals (reference SABER-cap
+            # behaviour; see docs/DESIGN.md known deviations).
+            memory.append_batch(obs, actions, rewards, terminals | truncs)
+            stacker.reset_lanes(terminals | truncs)
+            obs = new_obs
+            frames += lanes
+            for r in ep_returns[~np.isnan(ep_returns)]:
+                returns.append(float(r))
 
-        # one learner step per `replay_ratio` env frames once warm
-        if len(memory) >= cfg.learn_start and memory.sampleable:
-            steps_due = frames // cfg.replay_ratio - agent.step
-            for _ in range(max(steps_due, 0)):
-                sample = memory.sample(cfg.batch_size, priority_beta(cfg, frames))
-                info = agent.learn(sample)
-                memory.update_priorities(sample.idx, np.asarray(info["priorities"]))
-
-                step = agent.step
-                if step % cfg.metrics_interval == 0:
-                    metrics.log(
-                        "train",
-                        step=step,
-                        frames=frames,
-                        fps=metrics.fps(frames),
-                        loss=float(info["loss"]),
-                        q_mean=float(info["q_mean"]),
-                        grad_norm=float(info["grad_norm"]),
-                        mean_return=float(np.mean(returns)) if returns else float("nan"),
+            # one learner step per `replay_ratio` env frames once warm
+            if len(memory) >= cfg.learn_start and memory.sampleable:
+                if cfg.prefetch_depth > 0 and prefetcher is None:
+                    # background sampler overlaps batch assembly + transfer
+                    # with the device step (beta_fn reads live `frames`)
+                    prefetcher = make_replay_prefetcher(
+                        memory, cfg, lambda: priority_beta(cfg, frames)
                     )
-                if cfg.eval_interval and step % cfg.eval_interval == 0:
-                    last_eval = evaluate(cfg, agent, seed=cfg.seed + 977)
-                    metrics.log("eval", step=step, **last_eval)
-                if cfg.checkpoint_interval and step % cfg.checkpoint_interval == 0:
-                    ckpt.save(step, agent.state, {"frames": frames})
+                steps_due = frames // cfg.replay_ratio - agent.step
+                for _ in range(max(steps_due, 0)):
+                    if prefetcher is not None:
+                        idx, batch = prefetcher.get()
+                        info = agent.learn_batch(batch)
+                    else:
+                        sample = memory.sample(cfg.batch_size, priority_beta(cfg, frames))
+                        idx = sample.idx
+                        info = agent.learn(sample)
+                    memory.update_priorities(idx, np.asarray(info["priorities"]))
 
+                    step = agent.step
+                    if step % cfg.metrics_interval == 0:
+                        metrics.log(
+                            "train",
+                            step=step,
+                            frames=frames,
+                            fps=metrics.fps(frames),
+                            loss=float(info["loss"]),
+                            q_mean=float(info["q_mean"]),
+                            grad_norm=float(info["grad_norm"]),
+                            mean_return=float(np.mean(returns)) if returns else float("nan"),
+                        )
+                    if cfg.eval_interval and step % cfg.eval_interval == 0:
+                        last_eval = evaluate(cfg, agent, seed=cfg.seed + 977)
+                        metrics.log("eval", step=step, **last_eval)
+                    if cfg.checkpoint_interval and step % cfg.checkpoint_interval == 0:
+                        ckpt.save(step, agent.state, {"frames": frames})
+    finally:
+        if prefetcher is not None:
+            prefetcher.close()
     final_eval = evaluate(cfg, agent, seed=cfg.seed + 977)
     metrics.log("eval", step=agent.step, **final_eval)
     ckpt.save(agent.step, agent.state, {"frames": frames})

@@ -1,0 +1,102 @@
+"""Learner-side batch prefetch pipeline.
+
+Parity: the reference learner's Redis batch fetch overlaps the GPU step only
+by accident of redis-py socket buffering (SURVEY.md §3.1); here the overlap
+is explicit — a worker thread samples the replay, assembles the dense batch,
+and stages it to the device while the learn step for the previous batch is
+still executing.  With JAX's async dispatch the main thread never blocks on
+host-side sampling, so the accelerator step time is the loop's floor.
+
+Priority write-back consequently lags by the pipeline depth — exactly the
+staleness semantics the distributed reference already has (the learner's
+priority updates race later samples through Redis).
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from typing import Any, Callable, Optional
+
+import jax
+
+
+class BatchPrefetcher:
+    """Background sampler: fn() -> host batch, staged to device ahead of use.
+
+    The GIL is the synchronisation story, matching the replay's in-process
+    single-writer discipline (appends happen on the main thread between
+    get() calls; NumPy ops release the GIL only inside C loops that don't
+    observe partial Python-level state).
+    """
+
+    def __init__(
+        self,
+        sample_fn: Callable[[], Any],
+        depth: int = 2,
+        device_put: bool = True,
+    ):
+        self.sample_fn = sample_fn
+        self.depth = depth
+        self.device_put = device_put
+        self._q: queue.Queue = queue.Queue(maxsize=depth)
+        self._stop = threading.Event()
+        self._exc: Optional[BaseException] = None
+        self._thread = threading.Thread(target=self._worker, daemon=True)
+        self._thread.start()
+
+    def _worker(self) -> None:
+        while not self._stop.is_set():
+            try:
+                batch = self.sample_fn()
+                if self.device_put:
+                    batch = jax.tree.map(jax.device_put, batch)
+            except BaseException as e:  # surfaced on the consumer thread
+                self._exc = e
+                self._q.put(None)
+                return
+            # block while the queue is full (bounded staleness)
+            while not self._stop.is_set():
+                try:
+                    self._q.put(batch, timeout=0.1)
+                    break
+                except queue.Full:
+                    continue
+
+    def get(self, timeout: float = 60.0):
+        if self._exc is not None and self._q.empty():
+            # repeated get() after a surfaced failure: fail fast, don't hang
+            raise RuntimeError("prefetch worker failed") from self._exc
+        try:
+            item = self._q.get(timeout=timeout)
+        except queue.Empty:
+            raise TimeoutError(
+                f"prefetch worker produced nothing for {timeout}s "
+                "(replay sampler stalled or device transfer wedged)"
+            ) from None
+        if item is None and self._exc is not None:
+            raise RuntimeError("prefetch worker failed") from self._exc
+        return item
+
+    def close(self) -> None:
+        self._stop.set()
+        try:
+            while True:
+                self._q.get_nowait()
+        except queue.Empty:
+            pass
+        self._thread.join(timeout=5)
+
+
+def make_replay_prefetcher(memory, cfg, beta_fn: Callable[[], float]) -> "BatchPrefetcher":
+    """The train-loop wiring, shared by the single-process and apex loops:
+    sample -> (idx, device-staged Batch); jnp.asarray inside to_device_batch
+    already performs the (async) host->device transfer, so device_put=False.
+    """
+    from rainbow_iqn_apex_tpu.agents.agent import to_device_batch
+
+    def _sample():
+        s = memory.sample(cfg.batch_size, beta_fn())
+        return s.idx, to_device_batch(s)
+
+    return BatchPrefetcher(_sample, depth=cfg.prefetch_depth, device_put=False)

@@ -1,0 +1,69 @@
+"""CLI/Config coverage: flag parsing, JSON round-trip, entry dispatch."""
+
+import json
+
+import pytest
+
+from rainbow_iqn_apex_tpu.config import Config, parse_config
+
+
+def test_defaults_match_reference_hyperparameters():
+    """SURVEY §2 row 1: the reference's headline defaults."""
+    cfg = Config()
+    assert cfg.t_max == 200_000_000
+    assert cfg.memory_capacity == 1_000_000
+    assert cfg.learning_rate == 6.25e-5
+    assert cfg.batch_size == 32
+    assert cfg.multi_step == 3
+    assert cfg.gamma == 0.99
+    assert cfg.num_tau_samples == 64
+    assert cfg.num_tau_prime_samples == 64
+    assert cfg.num_quantile_samples == 32
+    assert cfg.noisy_sigma0 == 0.5
+    assert cfg.sticky_actions == 0.25  # SABER
+    assert cfg.max_episode_frames == 108_000  # SABER 30-min cap
+    assert cfg.history_length == 4 and cfg.frame_height == 84
+
+
+def test_cli_overrides_and_dashes():
+    cfg = parse_config(
+        ["--learning-rate", "0.001", "--num-envs-per-actor", "4",
+         "--eval-noisy", "true", "--env-id", "toy:chain"]
+    )
+    assert cfg.learning_rate == 0.001
+    assert cfg.num_envs_per_actor == 4
+    assert cfg.eval_noisy is True
+    assert cfg.env_id == "toy:chain"
+
+
+def test_bool_flag_parsing_variants():
+    for v, expect in [("1", True), ("true", True), ("YES", True),
+                      ("0", False), ("false", False), ("off", False)]:
+        cfg = parse_config(["--dueling", v])
+        assert cfg.dueling is expect, v
+
+
+def test_config_json_roundtrip():
+    cfg = Config(env_id="toy:catch", learning_rate=1e-3, replay_shards=2)
+    cfg2 = Config.from_json(cfg.to_json())
+    assert cfg == cfg2
+
+
+def test_config_hashable_for_jit_closure():
+    assert hash(Config()) == hash(Config())
+    assert hash(Config()) != hash(Config(gamma=0.95))
+
+
+def test_state_shape_property():
+    assert Config().state_shape == (84, 84, 4)
+    assert Config(frame_height=44, frame_width=40, history_length=2).state_shape == (44, 40, 2)
+
+
+def test_entrypoint_role_dispatch_errors(capsys):
+    import train_agent_apex
+
+    assert train_agent_apex.main(["--role", "nope"]) == 2
+    assert "unknown --role" in capsys.readouterr().err
+    assert train_agent_apex.main(["--architecture", "bogus"]) == 2
+    assert train_agent_apex.main(["--role", "apex", "--architecture", "r2d2"]) == 2
+    assert "roadmap" in capsys.readouterr().err
