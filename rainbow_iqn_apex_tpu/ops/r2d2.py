@@ -56,6 +56,20 @@ class SequenceBatch:
     weight: jnp.ndarray  # [B] f32 IS weights
 
 
+def to_device_seq_batch(s) -> "SequenceBatch":
+    """Host SequenceSample -> device SequenceBatch (async jnp.asarray)."""
+    return SequenceBatch(
+        obs=jnp.asarray(s.obs),
+        action=jnp.asarray(s.action),
+        reward=jnp.asarray(s.reward),
+        done=jnp.asarray(s.done),
+        valid=jnp.asarray(s.valid),
+        init_c=jnp.asarray(s.init_c),
+        init_h=jnp.asarray(s.init_h),
+        weight=jnp.asarray(s.weight),
+    )
+
+
 @struct.dataclass
 class R2D2TrainState:
     params: Params

@@ -3,6 +3,7 @@ from rainbow_iqn_apex_tpu.parallel.apex import (
     ApexDriver,
     train_apex,
 )
+from rainbow_iqn_apex_tpu.parallel.apex_r2d2 import R2D2ApexDriver, train_apex_r2d2
 from rainbow_iqn_apex_tpu.parallel.mesh import (
     actor_mesh,
     batch_sharding,
@@ -16,7 +17,9 @@ from rainbow_iqn_apex_tpu.parallel.sharded_replay import ShardedReplay
 __all__ = [
     "ActorPriorityEstimator",
     "ApexDriver",
+    "R2D2ApexDriver",
     "train_apex",
+    "train_apex_r2d2",
     "ShardedReplay",
     "actor_mesh",
     "batch_sharding",

@@ -1,0 +1,267 @@
+"""Mesh-parallel R2D2: the recurrent architecture under the Ape-X topology.
+
+Same shape as parallel/apex.py (SURVEY.md §2 rows 6-8 mapping), with the
+recurrent differences:
+- actor inference is lane-sharded AND stateful: the per-lane LSTM (c, h)
+  lives on the actor mesh, sharded with the lanes, and is carried on-device
+  tick to tick (episode cuts zero it via a device-side mask — no per-tick
+  host round-trip of the state);
+- the host still snapshots the pre-step state each tick (one device->host
+  copy) because the sequence replay must store exact states for burn-in
+  (Kapturowski et al. stored-state replay);
+- the learner runs the sequence learn step dp-sharded (numerics proven equal
+  to single-device in tests/test_r2d2_sharding.py);
+- weight publish is the same bf16 cross-mesh broadcast.
+"""
+
+from __future__ import annotations
+
+import collections
+import os
+from typing import Any, Dict, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from rainbow_iqn_apex_tpu.config import Config
+from rainbow_iqn_apex_tpu.envs import make_vector_env
+from rainbow_iqn_apex_tpu.ops.r2d2 import (
+    R2D2TrainState,
+    SequenceBatch,
+    build_r2d2_act_step,
+    build_r2d2_learn_step,
+    init_r2d2_state,
+    to_device_seq_batch,
+)
+from rainbow_iqn_apex_tpu.parallel.mesh import (
+    actor_mesh,
+    batch_sharding,
+    learner_mesh,
+    replicated,
+    split_devices,
+)
+from rainbow_iqn_apex_tpu.replay.sequence import SequenceReplay, SequenceSample
+from rainbow_iqn_apex_tpu.train import priority_beta
+from rainbow_iqn_apex_tpu.utils.checkpoint import Checkpointer
+from rainbow_iqn_apex_tpu.utils.logging import MetricsLogger
+from rainbow_iqn_apex_tpu.utils.prefetch import BatchPrefetcher
+
+
+class R2D2ApexDriver:
+    def __init__(
+        self,
+        cfg: Config,
+        num_actions: int,
+        frame_shape: Tuple[int, int],
+        lanes: int,
+        devices: Optional[Sequence[jax.Device]] = None,
+    ):
+        self.cfg = cfg
+        ldevs, adevs = split_devices(devices, cfg.learner_devices)
+        self.lmesh = learner_mesh(ldevs)
+        self.amesh = actor_mesh(adevs)
+        self.n_actor_devices = len(adevs)
+        if lanes % self.n_actor_devices:
+            raise ValueError(
+                f"lanes {lanes} must divide across {self.n_actor_devices} actor devices"
+            )
+        rep_l, rep_a = replicated(self.lmesh), replicated(self.amesh)
+        lane_sh = batch_sharding(self.amesh, "actor")
+
+        self.key = jax.random.PRNGKey(cfg.seed)
+        self.key, k_init = jax.random.split(self.key)
+        self.state: R2D2TrainState = jax.device_put(
+            init_r2d2_state(cfg, num_actions, k_init, frame_shape), rep_l
+        )
+
+        self._learn = jax.jit(
+            build_r2d2_learn_step(cfg, num_actions),
+            in_shardings=(rep_l, batch_sharding(self.lmesh, "dp"), rep_l),
+            donate_argnums=0,
+        )
+        # act: obs + (c, h) lane-sharded; params replicated on the actor mesh
+        self._act = jax.jit(
+            build_r2d2_act_step(cfg, num_actions, use_noise=True),
+            in_shardings=(rep_a, lane_sh, (lane_sh, lane_sh), rep_a),
+            out_shardings=(lane_sh, lane_sh, (lane_sh, lane_sh)),
+        )
+        # device-side episode-cut mask for the carried state
+        self._mask_state = jax.jit(
+            lambda st, keep: jax.tree.map(lambda x: x * keep[:, None], st),
+            in_shardings=((lane_sh, lane_sh), lane_sh),
+            out_shardings=(lane_sh, lane_sh),
+        )
+        if cfg.bf16_weight_sync:
+            self._cast = jax.jit(
+                lambda p: jax.tree.map(lambda x: x.astype(jnp.bfloat16), p)
+            )
+            self._uncast = jax.jit(
+                lambda p: jax.tree.map(lambda x: x.astype(jnp.float32), p),
+                out_shardings=rep_a,
+            )
+        self._rep_a = rep_a
+        self._lane_sh = lane_sh
+        self.actor_params = None
+        self.lstm_state = jax.device_put(
+            (
+                jnp.zeros((lanes, cfg.lstm_size), jnp.float32),
+                jnp.zeros((lanes, cfg.lstm_size), jnp.float32),
+            ),
+            lane_sh,  # applied to both (c, h) leaves
+        )
+        self.publish_weights()
+
+    def _next_key(self):
+        self.key, k = jax.random.split(self.key)
+        return k
+
+    def publish_weights(self) -> None:
+        p = self.state.params
+        if self.cfg.bf16_weight_sync:
+            p = self._uncast(jax.device_put(self._cast(p), self._rep_a))
+        else:
+            p = jax.device_put(p, self._rep_a)
+        self.actor_params = p
+
+    def act(self, obs: np.ndarray) -> Tuple[np.ndarray, Tuple[np.ndarray, np.ndarray]]:
+        """obs [L, H, W] u8 -> (actions [L], pre-step host state (c, h)).
+
+        The pre-step state snapshot is what the sequence replay stores."""
+        pre_c = np.asarray(self.lstm_state[0])
+        pre_h = np.asarray(self.lstm_state[1])
+        a, _q, self.lstm_state = self._act(
+            self.actor_params,
+            jnp.asarray(obs)[..., None],
+            self.lstm_state,
+            self._next_key(),
+        )
+        return np.asarray(a), (pre_c, pre_h)
+
+    def reset_lanes(self, cuts: np.ndarray) -> None:
+        keep = jnp.asarray(1.0 - cuts.astype(np.float32))
+        self.lstm_state = self._mask_state(self.lstm_state, keep)
+
+    def learn_batch(self, batch: SequenceBatch) -> Dict[str, Any]:
+        self.state, info = self._learn(self.state, batch, self._next_key())
+        return info
+
+    @property
+    def step(self) -> int:
+        return int(self.state.step)
+
+
+def _eval_r2d2_learner(cfg: Config, env, driver: "R2D2ApexDriver") -> Dict[str, Any]:
+    """Evaluate the learner's current params on a single-device eval agent."""
+    from rainbow_iqn_apex_tpu.train_r2d2 import R2D2Agent, evaluate_r2d2
+
+    eval_agent = R2D2Agent(
+        cfg, env.num_actions, env.frame_shape, jax.random.PRNGKey(cfg.seed + 1),
+        train=False,
+    )
+    eval_agent.state = jax.device_put(driver.state, jax.devices()[0])
+    return evaluate_r2d2(cfg, eval_agent, seed=cfg.seed + 977)
+
+
+def train_apex_r2d2(cfg: Config, max_frames: Optional[int] = None) -> Dict[str, Any]:
+    total_frames = max_frames or cfg.t_max
+    lanes = cfg.num_actors * cfg.num_envs_per_actor
+    env = make_vector_env(cfg.env_id, lanes, seed=cfg.seed)
+    driver = R2D2ApexDriver(cfg, env.num_actions, env.frame_shape, lanes)
+
+    seq_total = cfg.r2d2_burn_in + cfg.r2d2_seq_len
+    memory = SequenceReplay(
+        capacity=max(cfg.memory_capacity // seq_total, 64),
+        seq_len=seq_total,
+        frame_shape=env.frame_shape,
+        lstm_size=cfg.lstm_size,
+        lanes=lanes,
+        stride=max(seq_total - cfg.r2d2_overlap, 1),
+        priority_exponent=cfg.priority_exponent,
+        priority_eps=cfg.priority_eps,
+        seed=cfg.seed,
+    )
+    run_dir = os.path.join(cfg.results_dir, cfg.run_id)
+    metrics = MetricsLogger(os.path.join(run_dir, "metrics.jsonl"), cfg.run_id)
+    ckpt = Checkpointer(os.path.join(cfg.checkpoint_dir, cfg.run_id))
+
+    obs = env.reset()
+    returns: collections.deque = collections.deque(maxlen=100)
+    frames = 0
+    last_pub = 0
+    prefetcher: Optional[BatchPrefetcher] = None
+    learn_start_seqs = max(cfg.learn_start // seq_total, 8)
+    frames_per_step = cfg.replay_ratio * cfg.r2d2_seq_len
+
+    try:
+        while frames < total_frames:
+            actions, (pre_c, pre_h) = driver.act(obs)
+            new_obs, rewards, terminals, truncs, ep_returns = env.step(actions)
+            cuts = terminals | truncs
+            memory.append_batch(obs, actions, rewards, cuts, pre_c, pre_h)
+            driver.reset_lanes(cuts)
+            obs = new_obs
+            frames += lanes
+            for r in ep_returns[~np.isnan(ep_returns)]:
+                returns.append(float(r))
+
+            if len(memory) >= learn_start_seqs:
+                if cfg.prefetch_depth > 0 and prefetcher is None:
+                    prefetcher = BatchPrefetcher(
+                        lambda: (
+                            (s := memory.sample(
+                                cfg.batch_size, priority_beta(cfg, frames)
+                            )).idx,
+                            to_device_seq_batch(s),
+                        ),
+                        depth=cfg.prefetch_depth,
+                        device_put=False,
+                    )
+                steps_due = frames // frames_per_step - driver.step
+                for _ in range(max(steps_due, 0)):
+                    if prefetcher is not None:
+                        idx, batch = prefetcher.get()
+                    else:
+                        s = memory.sample(cfg.batch_size, priority_beta(cfg, frames))
+                        idx, batch = s.idx, to_device_seq_batch(s)
+                    info = driver.learn_batch(batch)
+                    memory.update_priorities(idx, np.asarray(info["priorities"]))
+                    step = driver.step
+                    if step - last_pub >= cfg.weight_publish_interval:
+                        driver.publish_weights()
+                        last_pub = step
+                    if step % cfg.metrics_interval == 0:
+                        metrics.log(
+                            "train",
+                            step=step,
+                            frames=frames,
+                            fps=metrics.fps(frames),
+                            loss=float(info["loss"]),
+                            q_mean=float(info["q_mean"]),
+                            mean_return=float(np.mean(returns)) if returns else float("nan"),
+                            sequences=len(memory),
+                            staleness=step - last_pub,
+                        )
+                    if cfg.eval_interval and step % cfg.eval_interval == 0:
+                        metrics.log(
+                            "eval", step=step, **_eval_r2d2_learner(cfg, env, driver)
+                        )
+                    if cfg.checkpoint_interval and step % cfg.checkpoint_interval == 0:
+                        ckpt.save(step, driver.state, {"frames": frames})
+    finally:
+        if prefetcher is not None:
+            prefetcher.close()
+
+    final_eval = _eval_r2d2_learner(cfg, env, driver)
+    metrics.log("eval", step=driver.step, **final_eval)
+    ckpt.save(driver.step, driver.state, {"frames": frames})
+    ckpt.wait()
+    metrics.close()
+    return {
+        "frames": frames,
+        "learn_steps": driver.step,
+        "lanes": lanes,
+        "sequences": len(memory),
+        "train_return_mean": float(np.mean(returns)) if returns else float("nan"),
+        **{f"eval_{k}": v for k, v in final_eval.items()},
+    }

@@ -23,6 +23,7 @@ because the LSTM needs contiguous time anyway.
 from __future__ import annotations
 
 import dataclasses
+import threading
 from typing import Optional, Tuple
 
 import numpy as np
@@ -81,6 +82,9 @@ class SequenceReplay:
         self.pos = 0
         self.filled = 0
         self.max_priority = 1.0
+        # same single-writer discipline as PrioritizedReplay: serialise
+        # append/sample/update so a prefetch thread never sees partial state
+        self._lock = threading.Lock()
 
         # ---- per-lane builders: step data + the actor LSTM state BEFORE
         # each buffered step (so any window start has its exact state) ------
@@ -104,6 +108,12 @@ class SequenceReplay:
     ) -> int:
         """Push one lockstep tick; emits completed sequences. Returns the
         number of sequences emitted this tick."""
+        with self._lock:
+            return self._append_locked(
+                frames, actions, rewards, terminals, lstm_c, lstm_h
+            )
+
+    def _append_locked(self, frames, actions, rewards, terminals, lstm_c, lstm_h):
         emitted = 0
         for i in range(self.lanes):
             k = int(self._buf_len[i])
@@ -170,6 +180,10 @@ class SequenceReplay:
 
     # -------------------------------------------------------------- sampling
     def sample(self, batch_size: int, beta: float) -> SequenceSample:
+        with self._lock:
+            return self._sample_locked(batch_size, beta)
+
+    def _sample_locked(self, batch_size: int, beta: float) -> SequenceSample:
         idx, prob = self.tree.sample_stratified(batch_size, self.rng)
         prob = np.maximum(prob, 1e-12)
         weights = (self.filled * prob) ** (-beta)
@@ -187,6 +201,7 @@ class SequenceReplay:
         )
 
     def update_priorities(self, idx: np.ndarray, td_mix: np.ndarray) -> None:
-        pri = (np.asarray(td_mix, np.float64) + self.eps) ** self.omega
-        self.max_priority = max(self.max_priority, float(pri.max()))
-        self.tree.set(idx, pri)
+        with self._lock:
+            pri = (np.asarray(td_mix, np.float64) + self.eps) ** self.omega
+            self.max_priority = max(self.max_priority, float(pri.max()))
+            self.tree.set(idx, pri)

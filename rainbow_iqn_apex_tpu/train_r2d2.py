@@ -19,10 +19,10 @@ import numpy as np
 from rainbow_iqn_apex_tpu.config import Config
 from rainbow_iqn_apex_tpu.envs import make_env, make_vector_env
 from rainbow_iqn_apex_tpu.ops.r2d2 import (
-    SequenceBatch,
     build_r2d2_act_step,
     build_r2d2_learn_step,
     init_r2d2_state,
+    to_device_seq_batch,
 )
 from rainbow_iqn_apex_tpu.replay.sequence import SequenceReplay
 from rainbow_iqn_apex_tpu.train import priority_beta
@@ -69,17 +69,9 @@ class R2D2Agent:
         return np.asarray(a), new_state
 
     def learn(self, sample) -> Dict[str, Any]:
-        batch = SequenceBatch(
-            obs=jnp.asarray(sample.obs),
-            action=jnp.asarray(sample.action),
-            reward=jnp.asarray(sample.reward),
-            done=jnp.asarray(sample.done),
-            valid=jnp.asarray(sample.valid),
-            init_c=jnp.asarray(sample.init_c),
-            init_h=jnp.asarray(sample.init_h),
-            weight=jnp.asarray(sample.weight),
+        self.state, info = self._learn(
+            self.state, to_device_seq_batch(sample), self._next_key()
         )
-        self.state, info = self._learn(self.state, batch, self._next_key())
         return info
 
     @property

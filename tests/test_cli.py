@@ -65,5 +65,30 @@ def test_entrypoint_role_dispatch_errors(capsys):
     assert train_agent_apex.main(["--role", "nope"]) == 2
     assert "unknown --role" in capsys.readouterr().err
     assert train_agent_apex.main(["--architecture", "bogus"]) == 2
-    assert train_agent_apex.main(["--role", "apex", "--architecture", "r2d2"]) == 2
-    assert "roadmap" in capsys.readouterr().err
+
+
+def test_entrypoint_dispatch_routes(monkeypatch):
+    """Each (role, architecture) pair must reach ITS trainer — guards against
+    elif-chain reordering silently substituting algorithms."""
+    import train_agent_apex
+    import rainbow_iqn_apex_tpu.train as m_single
+    import rainbow_iqn_apex_tpu.train_r2d2 as m_r2d2
+    import rainbow_iqn_apex_tpu.parallel.apex as m_apex
+    import rainbow_iqn_apex_tpu.parallel.apex_r2d2 as m_apex_r2d2
+
+    calls = []
+    monkeypatch.setattr(m_single, "train", lambda cfg: calls.append("single-iqn") or {})
+    monkeypatch.setattr(m_r2d2, "train_r2d2", lambda cfg: calls.append("single-r2d2") or {})
+    monkeypatch.setattr(m_apex, "train_apex", lambda cfg: calls.append("apex-iqn") or {})
+    monkeypatch.setattr(
+        m_apex_r2d2, "train_apex_r2d2", lambda cfg: calls.append("apex-r2d2") or {}
+    )
+    for args, expect in [
+        (["--role", "single"], "single-iqn"),
+        (["--role", "single", "--architecture", "r2d2"], "single-r2d2"),
+        (["--role", "apex"], "apex-iqn"),
+        (["--role", "apex", "--architecture", "r2d2"], "apex-r2d2"),
+    ]:
+        calls.clear()
+        assert train_agent_apex.main(args) == 0
+        assert calls == [expect], (args, calls)

@@ -36,15 +36,11 @@ def main(argv=None) -> int:
         from rainbow_iqn_apex_tpu.train import train
 
         summary = train(cfg)
+    elif cfg.role == "apex" and cfg.architecture == "r2d2":
+        from rainbow_iqn_apex_tpu.parallel.apex_r2d2 import train_apex_r2d2
+
+        summary = train_apex_r2d2(cfg)
     elif cfg.role == "apex":
-        if cfg.architecture != "iqn":
-            print(
-                "--role apex currently trains the IQN architecture only; "
-                "r2d2 runs with --role single (mesh-parallel R2D2 is on the "
-                "roadmap, not silently substituted)",
-                file=sys.stderr,
-            )
-            return 2
         from rainbow_iqn_apex_tpu.parallel.apex import train_apex
 
         summary = train_apex(cfg)
