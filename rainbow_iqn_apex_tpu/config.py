@@ -92,6 +92,11 @@ class Config:
     num_envs_per_actor: int = 16  # batched vector-env width per actor loop
     weight_publish_interval: int = 400  # learner steps between weight publishes
     weight_poll_interval: int = 400  # actor frames between weight pulls
+    pipelined_actor: bool = False  # overlap device inference with env stepping
+    # (one-tick action lag: the action executed at tick t was computed from
+    # the observation at t-1 — Podracer/SEED-style; replay stores the action
+    # actually executed, so transitions stay valid and only the behaviour
+    # policy is one tick stale)
     initial_priority_from_actor: bool = True  # Ape-X: actors compute initial TD
 
     # ---- device mesh / sharding (TPU-native; replaces Redis TCP, SURVEY §5) -------

@@ -162,8 +162,13 @@ class ApexDriver:
         self.key, k = jax.random.split(self.key)
         return k
 
+    def act_async(self, stacked_obs: np.ndarray):
+        """Dispatch lane-sharded inference; returns DEVICE arrays immediately
+        (JAX async dispatch) so the host can overlap env work."""
+        return self._act(self.actor_params, jnp.asarray(stacked_obs), self._next_key())
+
     def act(self, stacked_obs: np.ndarray) -> Tuple[np.ndarray, np.ndarray]:
-        a, q = self._act(self.actor_params, jnp.asarray(stacked_obs), self._next_key())
+        a, q = self.act_async(stacked_obs)
         return np.asarray(a), np.asarray(q)
 
     def learn(self, sample) -> Dict[str, Any]:
@@ -242,14 +247,42 @@ def train_apex(cfg: Config, max_frames: Optional[int] = None) -> Dict[str, Any]:
     last_pub = 0
     prefetcher: Optional[BatchPrefetcher] = None
 
+    pending = None  # pipelined: device (actions, q) dispatched last tick
+    held = None  # pipelined: completed transition awaiting its Q for append
     try:
         while frames < total_frames:
             stacked = stacker.push(obs)
-            actions, q = driver.act(stacked)
+            if cfg.pipelined_actor:
+                # Overlap: dispatch inference for THIS obs; execute the action
+                # computed from the PREVIOUS obs (one-tick behaviour lag; the
+                # first tick primes the pipe synchronously).
+                nxt = driver.act_async(stacked)
+                if pending is None:
+                    pending = nxt
+                actions = np.asarray(pending[0])
+            else:
+                actions, q = driver.act(stacked)
             new_obs, rewards, terminals, truncs, ep_returns = env.step(actions)
             cuts = terminals | truncs  # truncation cuts windows like a terminal
-            pri = estimator.push(q, actions, rewards, cuts) if estimator else None
-            memory.append_batch(obs, actions, rewards, cuts, pri)
+            if cfg.pipelined_actor:
+                # The transition (s_t, a_t, r_t) needs Q(s_t) — that's `nxt`,
+                # still computing while the envs stepped. Hold the transition
+                # one tick and append it when its Q has certainly landed, so
+                # actor-side priorities use the RIGHT observation's values
+                # (only the behaviour policy is stale, not the estimates).
+                if held is not None:
+                    h_obs, h_act, h_rew, h_cuts, h_q = held
+                    pri = (
+                        estimator.push(np.asarray(h_q), h_act, h_rew, h_cuts)
+                        if estimator
+                        else None
+                    )
+                    memory.append_batch(h_obs, h_act, h_rew, h_cuts, pri)
+                held = (obs, actions, rewards, cuts, nxt[1])
+                pending = nxt
+            else:
+                pri = estimator.push(q, actions, rewards, cuts) if estimator else None
+                memory.append_batch(obs, actions, rewards, cuts, pri)
             stacker.reset_lanes(cuts)
             obs = new_obs
             frames += lanes

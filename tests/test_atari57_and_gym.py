@@ -37,6 +37,22 @@ def test_aggregate_median():
     assert agg["mean_human_normalized"] == pytest.approx(2 / 3)
 
 
+def test_world_record_normalized_saber_metric():
+    from rainbow_iqn_apex_tpu.atari57 import world_record_normalized
+
+    # Pong: random -20.7, record 21 -> a perfect 21 is exactly 1.0
+    assert world_record_normalized("Pong", 21.0) == pytest.approx(1.0)
+    # Breakout: "superhuman" vs the lab human (30.5) is a tiny fraction of
+    # the 864 record — the SABER paper's core point
+    wr = world_record_normalized("Breakout", 400.0)
+    assert 0.4 < wr < 0.5
+    assert world_record_normalized("Alien", 100.0) is None  # no record entry
+
+    agg = aggregate({"Pong": 21.0, "Breakout": 400.0, "Alien": 1000.0})
+    assert agg["world_record_coverage"] == 2
+    assert 0.4 < agg["median_world_record_normalized"] < 1.0
+
+
 def test_results_csv(tmp_path):
     p = str(tmp_path / "per_game.csv")
     write_results_csv(p, [{"game": "Pong", "score_mean": 10.0}])

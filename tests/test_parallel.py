@@ -197,6 +197,30 @@ def test_sharded_replay_routing_and_global_weights():
     )
 
 
+def test_pipelined_actor_short_run(tmp_path):
+    """Pipelined (one-tick action lag) apex acting must run and record
+    valid transitions; learning machinery untouched."""
+    cfg = CFG.replace(
+        env_id="toy:catch",
+        pipelined_actor=True,
+        frame_height=80,
+        frame_width=80,
+        learn_start=512,
+        replay_ratio=8,
+        memory_capacity=4096,
+        metrics_interval=50,
+        checkpoint_interval=0,
+        eval_interval=0,
+        eval_episodes=2,
+        results_dir=str(tmp_path / "results"),
+        checkpoint_dir=str(tmp_path / "ckpt"),
+    )
+    summary = train_apex(cfg, max_frames=1_000)
+    assert summary["frames"] == 1_000
+    assert summary["learn_steps"] > 0
+    assert np.isfinite(summary["eval_score_mean"])
+
+
 @pytest.mark.slow
 def test_apex_end_to_end_short(tmp_path):
     cfg = CFG.replace(

@@ -1,0 +1,67 @@
+"""bf16-compute vs fp32 parity: the TPU path (bfloat16 matmuls, fp32 params
+and accumulators) must track the fp32 reference within bf16 tolerance —
+guards against accidental fp32 casts (slow on MXU) or bf16 accumulation
+(inaccurate) sneaking into the model."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from rainbow_iqn_apex_tpu.config import Config
+from rainbow_iqn_apex_tpu.ops.learn import Batch, build_learn_step, init_train_state
+
+BASE = dict(
+    frame_height=44,
+    frame_width=44,
+    history_length=2,
+    hidden_size=64,
+    num_cosines=16,
+    num_tau_samples=8,
+    num_tau_prime_samples=8,
+    num_quantile_samples=4,
+    learning_rate=1e-3,
+)
+A = 4
+
+
+def _batch(key, cfg, b=8):
+    ks = jax.random.split(key, 4)
+    return Batch(
+        obs=jax.random.randint(ks[0], (b, *cfg.state_shape), 0, 255).astype(jnp.uint8),
+        action=jax.random.randint(ks[1], (b,), 0, A).astype(jnp.int32),
+        reward=jax.random.normal(ks[2], (b,)),
+        next_obs=jax.random.randint(ks[3], (b, *cfg.state_shape), 0, 255).astype(jnp.uint8),
+        discount=jnp.full((b,), 0.9),
+        weight=jnp.ones((b,)),
+    )
+
+
+def test_bf16_params_stay_fp32_and_outputs_track_fp32():
+    cfg16 = Config(compute_dtype="bfloat16", **BASE)
+    cfg32 = Config(compute_dtype="float32", **BASE)
+    s16 = init_train_state(cfg16, A, jax.random.PRNGKey(0))
+    s32 = init_train_state(cfg32, A, jax.random.PRNGKey(0))
+
+    # identical initial params, all fp32 regardless of compute dtype
+    for a, b in zip(jax.tree.leaves(s16.params), jax.tree.leaves(s32.params)):
+        assert a.dtype == jnp.float32
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+    step16 = jax.jit(build_learn_step(cfg16, A))
+    step32 = jax.jit(build_learn_step(cfg32, A))
+    key = jax.random.PRNGKey(7)
+    b16 = _batch(jax.random.PRNGKey(1), cfg16)
+
+    for i in range(3):
+        s16, i16 = step16(s16, b16, key)
+        s32, i32 = step32(s32, b16, key)
+
+    # outputs stay fp32 and finite in both modes
+    assert i16["priorities"].dtype == jnp.float32
+    assert np.isfinite(float(i16["loss"])) and np.isfinite(float(i32["loss"]))
+    # bf16 has ~8 bits of mantissa: demand coarse agreement after 3 steps
+    np.testing.assert_allclose(float(i16["loss"]), float(i32["loss"]), rtol=0.15)
+    q16, q32 = float(i16["q_mean"]), float(i32["q_mean"])
+    assert abs(q16 - q32) < 0.1, (q16, q32)
+    # params remain fp32 after updates (optimizer state never degrades)
+    assert all(p.dtype == jnp.float32 for p in jax.tree.leaves(s16.params))
