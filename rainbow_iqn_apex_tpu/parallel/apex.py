@@ -271,18 +271,20 @@ def train_apex(cfg: Config, max_frames: Optional[int] = None) -> Dict[str, Any]:
                 # actor-side priorities use the RIGHT observation's values
                 # (only the behaviour policy is stale, not the estimates).
                 if held is not None:
-                    h_obs, h_act, h_rew, h_cuts, h_q = held
+                    h_obs, h_act, h_rew, h_term, h_trunc, h_q = held
                     pri = (
-                        estimator.push(np.asarray(h_q), h_act, h_rew, h_cuts)
+                        estimator.push(np.asarray(h_q), h_act, h_rew, h_term | h_trunc)
                         if estimator
                         else None
                     )
-                    memory.append_batch(h_obs, h_act, h_rew, h_cuts, pri)
-                held = (obs, actions, rewards, cuts, nxt[1])
+                    memory.append_batch(
+                        h_obs, h_act, h_rew, h_term, pri, truncations=h_trunc
+                    )
+                held = (obs, actions, rewards, terminals, truncs, nxt[1])
                 pending = nxt
             else:
                 pri = estimator.push(q, actions, rewards, cuts) if estimator else None
-                memory.append_batch(obs, actions, rewards, cuts, pri)
+                memory.append_batch(obs, actions, rewards, terminals, pri, truncations=truncs)
             stacker.reset_lanes(cuts)
             obs = new_obs
             frames += lanes

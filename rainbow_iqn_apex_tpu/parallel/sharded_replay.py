@@ -65,6 +65,7 @@ class ShardedReplay:
         rewards: np.ndarray,
         terminals: np.ndarray,
         priorities: Optional[np.ndarray] = None,
+        truncations: Optional[np.ndarray] = None,
     ) -> None:
         """Lockstep append of all lanes, block-partitioned across shards."""
         lps = self.lanes_per_shard
@@ -76,6 +77,7 @@ class ShardedReplay:
                 rewards[sl],
                 terminals[sl],
                 None if priorities is None else priorities[sl],
+                None if truncations is None else truncations[sl],
             )
 
     def __len__(self) -> int:

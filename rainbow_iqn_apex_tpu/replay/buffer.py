@@ -86,7 +86,12 @@ class PrioritizedReplay:
         self.frames = np.zeros((capacity, h, w), dtype=np.uint8)
         self.actions = np.zeros(capacity, dtype=np.int32)
         self.rewards = np.zeros(capacity, dtype=np.float32)
-        self.terminals = np.zeros(capacity, dtype=bool)
+        self.terminals = np.zeros(capacity, dtype=bool)  # true env terminals
+        # cuts = terminal OR truncation: where the episode STREAM breaks
+        # (frame stacks and n-step windows must not cross a cut; only true
+        # terminals stop value bootstrapping — the two-channel design that
+        # removes the time-limit bias, docs/DESIGN.md)
+        self.cuts = np.zeros(capacity, dtype=bool)
 
         self.tree: SumTree
         if use_native:
@@ -117,22 +122,28 @@ class PrioritizedReplay:
         frames: np.ndarray,  # [L, H, W] uint8
         actions: np.ndarray,  # [L]
         rewards: np.ndarray,  # [L]
-        terminals: np.ndarray,  # [L] bool
+        terminals: np.ndarray,  # [L] bool — true env terminals (stop bootstrap)
         priorities: Optional[np.ndarray] = None,  # [L] raw |TD| (Ape-X actors)
+        truncations: Optional[np.ndarray] = None,  # [L] bool — time-limit cuts
     ) -> np.ndarray:
         """Append one lockstep step of all lanes. Returns global slot ids."""
         L = frames.shape[0]
         if L != self.lanes:
             raise ValueError(f"expected {self.lanes} lanes, got {L}")
         with self._lock:
-            return self._append_locked(frames, actions, rewards, terminals, priorities)
+            return self._append_locked(
+                frames, actions, rewards, terminals, priorities, truncations
+            )
 
-    def _append_locked(self, frames, actions, rewards, terminals, priorities):
+    def _append_locked(self, frames, actions, rewards, terminals, priorities, truncations):
         slots = self._lane_base + self.pos
         self.frames[slots] = frames
         self.actions[slots] = actions
         self.rewards[slots] = rewards
         self.terminals[slots] = terminals
+        self.cuts[slots] = (
+            terminals if truncations is None else (terminals | truncations)
+        )
 
         # One fused priority write per step covers three DISJOINT slot groups
         # (disjointness holds because seg > history + n_step):
@@ -156,6 +167,19 @@ class PrioritizedReplay:
             else:
                 pri = (np.asarray(priorities, np.float64) + self.eps) ** self.omega
                 self.max_priority = max(self.max_priority, float(pri.max()))
+            # Unbiased time-limit handling: a transition whose n-step window
+            # hits a TRUNCATION before any terminal cannot form a correct
+            # bootstrap target (the post-cut state belongs to a new episode
+            # and the pre-cut final state was never stored) — it is simply
+            # never eligible, rather than faking a terminal.
+            w_offs = (ready + np.arange(self.n_step)) % self.seg
+            w_slots = self._lane_base[:, None] + w_offs[None, :]
+            cuts_w = self.cuts[w_slots]  # [L, n]
+            term_w = self.terminals[w_slots]
+            first_cut = cuts_w.argmax(axis=1)
+            has_cut = cuts_w.any(axis=1)
+            first_is_trunc = ~term_w[np.arange(self.lanes), first_cut]
+            pri = np.where(has_cut & first_is_trunc, 0.0, pri)
             upd_idx.append(self._lane_base + ready)
             upd_pri.append(pri)
         self.tree.set(np.concatenate(upd_idx), np.concatenate(upd_pri))
@@ -198,8 +222,8 @@ class PrioritizedReplay:
         slots = lane[:, None] * self.seg + offs
         stacks = self.frames[slots]  # [B, h, H, W]
 
-        # terminal at window position j (j < h-1) kills frames [.. j]
-        term = self.terminals[slots[:, :-1]]  # [B, h-1]
+        # an episode cut at window position j (j < h-1) kills frames [.. j]
+        term = self.cuts[slots[:, :-1]]  # [B, h-1]
         dead_tail = np.cumsum(term[:, ::-1], axis=1)[:, ::-1] > 0  # any terminal at/after j
         valid = np.concatenate([~dead_tail, np.ones((B, 1), bool)], axis=1)
         # frames older than what's been written in a young buffer are invalid too
@@ -269,6 +293,7 @@ class PrioritizedReplay:
             actions=self.actions,
             rewards=self.rewards,
             terminals=self.terminals,
+            cuts=self.cuts,
             tree=self.tree.tree,
             pos=self.pos,
             filled=self.filled,
@@ -287,6 +312,8 @@ class PrioritizedReplay:
         self.actions[:] = z["actions"]
         self.rewards[:] = z["rewards"]
         self.terminals[:] = z["terminals"]
+        # older snapshots (pre two-channel) carry no cuts array
+        self.cuts[:] = z["cuts"] if "cuts" in z.files else z["terminals"]
         self.tree.tree[:] = z["tree"]
         self.pos = int(z["pos"])
         self.filled = int(z["filled"])

@@ -80,9 +80,9 @@ def train(cfg: Config, max_frames: Optional[int] = None) -> Dict[str, Any]:
             new_obs, rewards, terminals, truncs, ep_returns = env.step(actions)
             # store the pre-step frame with the transition's reward/terminal
             # (reference memory layout: SURVEY §2 row 5 frame-dedup scheme).
-            # Truncations cut windows like terminals (reference SABER-cap
-            # behaviour; see docs/DESIGN.md known deviations).
-            memory.append_batch(obs, actions, rewards, terminals | truncs)
+            # Truncations are a separate channel: they cut stack/n-step
+            # windows but never fake a terminal (docs/DESIGN.md).
+            memory.append_batch(obs, actions, rewards, terminals, truncations=truncs)
             stacker.reset_lanes(terminals | truncs)
             obs = new_obs
             frames += lanes

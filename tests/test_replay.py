@@ -148,6 +148,65 @@ def test_priority_update_roundtrip_and_is_weights():
     assert b2.weight[b2.idx == hot].max() < 0.1
 
 
+def test_truncation_cuts_windows_without_fake_terminal():
+    """Two-channel semantics: a truncation separates episodes in the stacks
+    and blocks sampling of windows that cross it, but transitions clear of
+    the cut keep their full gamma^n bootstrap (no terminal bias)."""
+    mem = _mk(n_step=2, history=2, gamma=0.5)
+    # episode A: 6 steps, TRUNCATED at t=5 (no terminal); episode B follows
+    for t in range(6):
+        mem.append_batch(
+            _frame(10 + t)[None], np.array([0]), np.array([1.0], np.float32),
+            np.array([False]), truncations=np.array([t == 5]),
+        )
+    for t in range(8):
+        mem.append_batch(
+            _frame(100 + t)[None], np.array([1]), np.array([0.0], np.float32),
+            np.array([False]),
+        )
+    b = mem.sample(256, beta=1.0)
+    sampled = set(b.idx.tolist())
+    # windows [4,5] and [5,6] cross the truncation -> slots 4 and 5 ineligible
+    assert 4 not in sampled and 5 not in sampled
+    # slot 3 (window [3,4], clear of the cut) keeps FULL bootstrap: no terminal
+    sel = b.idx == 3
+    assert sel.any()
+    np.testing.assert_allclose(b.discount[sel], 0.25, atol=1e-6)  # gamma^2
+    np.testing.assert_allclose(b.reward[sel], 1.5, atol=1e-6)  # 1 + .5*1
+    # episode-B stacks never contain episode-A frames
+    for i in np.flatnonzero(b.idx == 7):  # frame 101, stack [100, 101]
+        assert int(b.obs[i][0, 0, 0]) == 100 and int(b.obs[i][0, 0, 1]) == 101
+
+
+def test_terminal_within_window_still_beats_truncation_rule():
+    """terminal-then-truncation in one window: the terminal governs (the
+    return is truncated there anyway) and the transition stays eligible."""
+    mem = _mk(n_step=3, history=2, gamma=0.5)
+    # t=0,1 normal; t=2 TERMINAL; t=3 TRUNCATION (new episode cut short);
+    # the window [0,1,2] of slot 0 ends at the terminal, and slot 1's window
+    # [1,2,3] contains terminal-then-truncation — the terminal comes first,
+    # so the precedence rule keeps BOTH eligible.
+    flags = [(False, False), (False, False), (True, False), (False, True)] + [
+        (False, False)
+    ] * 8
+    for t, (term, trunc) in enumerate(flags):
+        mem.append_batch(
+            _frame(t)[None], np.array([0]), np.array([1.0], np.float32),
+            np.array([term]), truncations=np.array([trunc]),
+        )
+    b = mem.sample(256, beta=1.0)
+    sel = b.idx == 0  # window [0,1,2]: terminal at 2 -> R = 1 + .5 + .25, disc 0
+    assert sel.any()
+    np.testing.assert_allclose(b.reward[sel], 1.75, atol=1e-6)
+    np.testing.assert_allclose(b.discount[sel], 0.0, atol=1e-6)
+    # slot 1's window [1,2,3] = terminal THEN truncation: still eligible
+    # (return truncates at the terminal; the later trunc is irrelevant)
+    sel1 = b.idx == 1
+    assert sel1.any()
+    np.testing.assert_allclose(b.reward[sel1], 1.5, atol=1e-6)  # 1 + .5, cut at term
+    np.testing.assert_allclose(b.discount[sel1], 0.0, atol=1e-6)
+
+
 def test_update_priorities_cannot_resurrect_dead_slots():
     mem = _mk(capacity=16, n_step=2, history=2)
     for t in range(16):
