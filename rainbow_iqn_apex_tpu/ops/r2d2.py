@@ -56,6 +56,26 @@ class SequenceBatch:
     weight: jnp.ndarray  # [B] f32 IS weights
 
 
+def stack_seq_frames(obs_seq: jnp.ndarray, history: int) -> jnp.ndarray:
+    """Within-sequence frame stacking on device: [B, L, H, W, 1] ->
+    [B, L, H, W, history], channel k holding the frame from t-(history-1-k).
+
+    The R2D2 paper feeds 4-stacked frames AND an LSTM; sequences are stored
+    as single frames (dedup) and the stack is rebuilt here as shifted slices
+    — static shapes, fused by XLA, no extra HBM-resident copies on the host
+    path. Steps earlier than the sequence start zero-pad, which only touches
+    the first history-1 steps of the burn-in region (burn_in >= history-1 in
+    any sane config), whose sole job is LSTM warm-up.
+    """
+    if history <= 1:
+        return obs_seq
+    shifted = [
+        jnp.pad(obs_seq[:, : obs_seq.shape[1] - k], ((0, 0), (k, 0), (0, 0), (0, 0), (0, 0)))
+        for k in range(history - 1, -1, -1)
+    ]
+    return jnp.concatenate(shifted, axis=-1)
+
+
 def to_device_seq_batch(s) -> "SequenceBatch":
     """Host SequenceSample -> device SequenceBatch (async jnp.asarray)."""
     return SequenceBatch(
@@ -95,11 +115,12 @@ def init_r2d2_state(
     num_actions: int,
     key: chex.PRNGKey,
     frame_shape: Tuple[int, int],
-    channels: int = 1,
+    channels: Optional[int] = None,
 ) -> R2D2TrainState:
+    """channels defaults to cfg.history_length (frame-stacked input)."""
     net = make_r2d2_network(cfg, num_actions)
     k1, k2 = jax.random.split(key)
-    dummy = jnp.zeros((1, 2, *frame_shape, channels), jnp.uint8)
+    dummy = jnp.zeros((1, 2, *frame_shape, channels or cfg.history_length), jnp.uint8)
     params = net.init(
         {"params": k1, "noise": k2}, dummy, net.initial_state(1)
     )["params"]
@@ -155,8 +176,21 @@ def build_r2d2_learn_step(
     burn, n, gamma = cfg.r2d2_burn_in, cfg.multi_step, cfg.gamma
     eta, eps_h = cfg.r2d2_eta, cfg.value_rescale_eps
 
+    history = cfg.history_length
+    if history > 1 and burn < history - 1:
+        raise ValueError(
+            f"r2d2_burn_in ({burn}) must be >= history_length-1 "
+            f"({history - 1}): on-device frame stacking zero-pads the first "
+            "history-1 steps of each sequence, which must fall inside the "
+            "burn-in region or the loss trains on observations the actor "
+            "never saw"
+        )
+
     def learn_step(state: R2D2TrainState, batch: SequenceBatch, key: chex.PRNGKey):
         k_on, k_tgt = jax.random.split(key)
+        if history > 1 and batch.obs.shape[-1] == 1:
+            # single-frame stored sequences -> stacked network input
+            batch = batch.replace(obs=stack_seq_frames(batch.obs, history))
         T = batch.obs.shape[1] - burn  # train slice length
 
         def loss_fn(params):
@@ -244,11 +278,29 @@ def build_r2d2_learn_step(
     return learn_step
 
 
+def as_actor_input(obs, history: int) -> jnp.ndarray:
+    """Normalise actor observations to [B, H, W, C] and enforce that C
+    matches the training channel count (the host FrameStacker supplies the
+    stack when history > 1)."""
+    x = jnp.asarray(obs)
+    if x.ndim == 3:
+        x = x[..., None]
+    if x.shape[-1] != history:
+        raise ValueError(
+            f"actor obs has {x.shape[-1]} channels but history_length is "
+            f"{history}; feed FrameStacker output (or raw [B,H,W] frames "
+            "when history_length == 1)"
+        )
+    return x
+
+
 def build_r2d2_act_step(
     cfg: Config, num_actions: int, use_noise: bool = True
 ) -> Callable:
     """Recurrent acting: (params, obs [B,H,W,C] u8, state, key) ->
-    (action [B], q [B,A], new_state)."""
+    (action [B], q [B,A], new_state).  C must match the training channels
+    (cfg.history_length when frame-stacking; the host FrameStacker supplies
+    it on the actor side)."""
     net = make_r2d2_network(cfg, num_actions, use_noise=use_noise)
 
     def act_step(params, obs, state: LSTMState, key):

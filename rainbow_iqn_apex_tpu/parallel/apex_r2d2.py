@@ -24,11 +24,13 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from rainbow_iqn_apex_tpu.agents.agent import FrameStacker
 from rainbow_iqn_apex_tpu.config import Config
 from rainbow_iqn_apex_tpu.envs import make_vector_env
 from rainbow_iqn_apex_tpu.ops.r2d2 import (
     R2D2TrainState,
     SequenceBatch,
+    as_actor_input,
     build_r2d2_act_step,
     build_r2d2_learn_step,
     init_r2d2_state,
@@ -125,16 +127,15 @@ class R2D2ApexDriver:
         self.actor_params = p
 
     def act(self, obs: np.ndarray) -> Tuple[np.ndarray, Tuple[np.ndarray, np.ndarray]]:
-        """obs [L, H, W] u8 -> (actions [L], pre-step host state (c, h)).
+        """obs [L, H, W] u8 (history 1) or [L, H, W, hist] stacked ->
+        (actions [L], pre-step host state (c, h)).
 
         The pre-step state snapshot is what the sequence replay stores."""
         pre_c = np.asarray(self.lstm_state[0])
         pre_h = np.asarray(self.lstm_state[1])
+        x = as_actor_input(obs, self.cfg.history_length)
         a, _q, self.lstm_state = self._act(
-            self.actor_params,
-            jnp.asarray(obs)[..., None],
-            self.lstm_state,
-            self._next_key(),
+            self.actor_params, x, self.lstm_state, self._next_key()
         )
         return np.asarray(a), (pre_c, pre_h)
 
@@ -186,6 +187,7 @@ def train_apex_r2d2(cfg: Config, max_frames: Optional[int] = None) -> Dict[str, 
     ckpt = Checkpointer(os.path.join(cfg.checkpoint_dir, cfg.run_id))
 
     obs = env.reset()
+    stacker = FrameStacker(lanes, env.frame_shape, cfg.history_length)
     returns: collections.deque = collections.deque(maxlen=100)
     frames = 0
     last_pub = 0
@@ -195,11 +197,12 @@ def train_apex_r2d2(cfg: Config, max_frames: Optional[int] = None) -> Dict[str, 
 
     try:
         while frames < total_frames:
-            actions, (pre_c, pre_h) = driver.act(obs)
+            actions, (pre_c, pre_h) = driver.act(stacker.push(obs))
             new_obs, rewards, terminals, truncs, ep_returns = env.step(actions)
             cuts = terminals | truncs
             memory.append_batch(obs, actions, rewards, cuts, pre_c, pre_h)
             driver.reset_lanes(cuts)
+            stacker.reset_lanes(cuts)
             obs = new_obs
             frames += lanes
             for r in ep_returns[~np.isnan(ep_returns)]:

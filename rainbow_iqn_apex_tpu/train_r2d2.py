@@ -16,9 +16,11 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from rainbow_iqn_apex_tpu.agents.agent import FrameStacker
 from rainbow_iqn_apex_tpu.config import Config
 from rainbow_iqn_apex_tpu.envs import make_env, make_vector_env
 from rainbow_iqn_apex_tpu.ops.r2d2 import (
+    as_actor_input,
     build_r2d2_act_step,
     build_r2d2_learn_step,
     init_r2d2_state,
@@ -58,14 +60,11 @@ class R2D2Agent:
         return (z, z)
 
     def act(self, obs, lstm_state, eval_mode=False):
-        """obs [B, H, W] u8 -> (actions [B], new_state); channel dim added."""
+        """obs [B, H, W] u8 (history 1) or [B, H, W, hist] stacked ->
+        (actions [B], new_state)."""
         fn = self._act_eval if eval_mode else self._act
-        a, q, new_state = fn(
-            self.state.params,
-            jnp.asarray(obs)[..., None],
-            lstm_state,
-            self._next_key(),
-        )
+        x = as_actor_input(obs, self.cfg.history_length)
+        a, q, new_state = fn(self.state.params, x, lstm_state, self._next_key())
         return np.asarray(a), new_state
 
     def learn(self, sample) -> Dict[str, Any]:
@@ -94,9 +93,10 @@ def evaluate_r2d2(cfg: Config, agent: R2D2Agent, episodes: Optional[int] = None,
     for _ in range(episodes):
         frame = env.reset()
         state = agent.initial_lstm_state(1)
+        stacker = FrameStacker(1, env.frame_shape, cfg.history_length)
         ep_ret = 0.0
         for _ in range(max_steps):
-            a, state = agent.act(frame[None], state, eval_mode=True)
+            a, state = agent.act(stacker.push(frame[None]), state, eval_mode=True)
             ts = env.step(int(a[0]))
             frame = ts.obs
             ep_ret += ts.reward
@@ -142,17 +142,21 @@ def train_r2d2(cfg: Config, max_frames: Optional[int] = None) -> Dict[str, Any]:
 
     obs = env.reset()
     lstm_state = agent.initial_lstm_state(lanes)
+    stacker = FrameStacker(lanes, env.frame_shape, cfg.history_length)
     returns: collections.deque = collections.deque(maxlen=100)
     frames = 0
     learn_start_seqs = max(cfg.learn_start // seq_total, 8)
 
     while frames < total_frames:
         state_c, state_h = np.asarray(lstm_state[0]), np.asarray(lstm_state[1])
-        actions, lstm_state = agent.act(obs, lstm_state)
+        stacked = stacker.push(obs)  # actor sees the frame-stacked input
+        actions, lstm_state = agent.act(stacked, lstm_state)
         new_obs, rewards, terminals, truncs, ep_returns = env.step(actions)
         cuts = terminals | truncs  # truncation ends the sequence window too
+        # the replay stores SINGLE frames; the learn step re-stacks on device
         memory.append_batch(obs, actions, rewards, cuts, state_c, state_h)
         lstm_state = _mask_reset(lstm_state, cuts)
+        stacker.reset_lanes(cuts)
         obs = new_obs
         frames += lanes
         for r in ep_returns[~np.isnan(ep_returns)]:

@@ -169,6 +169,56 @@ def test_sequence_priority_update():
     assert hits > 80  # dominates sampling
 
 
+# ---------------------------------------------------------- frame stacking
+def test_stack_seq_frames_semantics():
+    from rainbow_iqn_apex_tpu.ops.r2d2 import stack_seq_frames
+    import jax.numpy as jnp
+
+    # frames with value == timestep: [1, 5, 1, 1, 1]
+    obs = jnp.arange(1, 6, dtype=jnp.uint8).reshape(1, 5, 1, 1, 1)
+    out = stack_seq_frames(obs, 3)
+    assert out.shape == (1, 5, 1, 1, 3)
+    # at t=4: channels = [t-2, t-1, t] = [3, 4, 5]
+    assert [int(x) for x in out[0, 4, 0, 0]] == [3, 4, 5]
+    # at t=0: zero-padded history
+    assert [int(x) for x in out[0, 0, 0, 0]] == [0, 0, 1]
+    # history=1 is the identity
+    assert stack_seq_frames(obs, 1) is obs
+
+
+def test_r2d2_learn_with_frame_stacking(tmp_path):
+    """history_length=4: the learn step stacks on device, the act path uses
+    the host FrameStacker; shapes agree end-to-end."""
+    cfg = CFG.replace(history_length=4)
+    state = init_r2d2_state(cfg, A, jax.random.PRNGKey(0), FRAME)
+    step = jax.jit(build_r2d2_learn_step(cfg, A))
+    ks = jax.random.split(jax.random.PRNGKey(1), 3)
+    batch = SequenceBatch(
+        obs=jax.random.randint(ks[0], (2, L, *FRAME, 1), 0, 255).astype(jnp.uint8),
+        action=jax.random.randint(ks[1], (2, L), 0, A).astype(jnp.int32),
+        reward=jax.random.normal(ks[2], (2, L)),
+        done=jnp.zeros((2, L), bool),
+        valid=jnp.ones((2, L), bool),
+        init_c=jnp.zeros((2, 32)),
+        init_h=jnp.zeros((2, 32)),
+        weight=jnp.ones((2,)),
+    )
+    new_state, info = step(state, batch, jax.random.PRNGKey(2))
+    assert np.isfinite(float(info["loss"]))
+
+    # act path with the host stacker produces matching channel count
+    from rainbow_iqn_apex_tpu.agents.agent import FrameStacker
+    from rainbow_iqn_apex_tpu.ops.r2d2 import build_r2d2_act_step, make_r2d2_network
+
+    act = jax.jit(build_r2d2_act_step(cfg, A))
+    stacker = FrameStacker(2, FRAME, 4)
+    stacked = stacker.push(np.zeros((2, *FRAME), np.uint8))
+    net = make_r2d2_network(cfg, A)
+    a, q, st = act(new_state.params, jnp.asarray(stacked), net.initial_state(2),
+                   jax.random.PRNGKey(3))
+    assert a.shape == (2,) and q.shape == (2, A)
+
+
 # -------------------------------------------------------------- learn step
 def _seq_batch(key, b=4):
     ks = jax.random.split(key, 3)

@@ -17,6 +17,7 @@ from rainbow_iqn_apex_tpu.parallel.mesh import learner_mesh
 
 CFG = Config(
     compute_dtype="float32",
+    history_length=1,
     hidden_size=32,
     lstm_size=32,
     r2d2_burn_in=2,
