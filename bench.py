@@ -77,7 +77,9 @@ def measure() -> None:
         state, info, key = step(state, host_batch(), key)
     jax.block_until_ready(info["loss"])
 
-    iters = 300 if platform != "cpu" else 30
+    # CPU fallback exists to always give the driver a labelled row, not to
+    # stress the host: keep it short enough to fit inside the watchdog.
+    iters = 300 if platform != "cpu" else 8
     batches = [host_batch() for _ in range(8)]
     t0 = time.perf_counter()
     for i in range(iters):
