@@ -29,12 +29,12 @@ def pytest_configure(config):
         except Exception:
             pass
 
-    env = dict(os.environ)
-    env.pop("PALLAS_AXON_POOL_IPS", None)
-    env["JAX_PLATFORMS"] = "cpu"
-    flags = env.get("XLA_FLAGS", "")
-    if "xla_force_host_platform_device_count" not in flags:
-        env["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
+    # Single source of truth for the sanitised env (shared with the driver's
+    # multichip dryrun; the module is jax-free so this import cannot hang).
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    from __graft_entry__ import _sanitized_env
+
+    env = _sanitized_env(8)
 
     # The pre-exec interpreter may have opened a connection to the TPU relay
     # (sitecustomize registration). Sockets survive execve unless CLOEXEC —
