@@ -98,10 +98,11 @@ class VectorEnv:
         an episode ended).
 
         Both terminal and truncation auto-reset the lane and MUST cut the
-        replay's stack/n-step/sequence windows; only `terminal` should stop
-        value bootstrapping.  (The frame-replay currently treats both as
-        episode ends — the reference's behaviour for the SABER cap; see
-        docs/DESIGN.md "known deviations".)
+        replay's stack/n-step/sequence windows; only `terminal` stops value
+        bootstrapping.  Both replays honour this two-channel contract: the
+        frame replay stores cuts separately from terminals
+        (replay/buffer.py) and the sequence replay flushes on either channel
+        while recording done only for true terminals (replay/sequence.py).
         """
         L = len(self.envs)
         obs = np.empty((L, *self.frame_shape), np.uint8)

@@ -226,12 +226,22 @@ def build_r2d2_learn_step(
                 [jnp.ones_like(alive_prefix[..., :1]), alive_prefix], axis=-1
             )
             rn = (r_win * alive_prefix * gammas).sum(axis=-1)  # [B, Tn]
-            no_done = 1.0 - jnp.clip(d_win.sum(axis=-1), 0.0, 1.0)
+            done_win = jnp.clip(d_win.sum(axis=-1), 0.0, 1.0)
+            no_done = 1.0 - done_win
             y = value_rescale(
                 rn + (gamma**n) * no_done * q_boot[:, n:], eps_h
             )
             td = jax.lax.stop_gradient(y) - q_taken[:, :Tn]
-            mask = v[:, :Tn]
+            # A step's target is usable iff its n-step window ends inside the
+            # episode: either a true terminal falls within the window (reward
+            # sum truncates there, no bootstrap) or the bootstrap step t+n is
+            # itself valid. A time-limit TRUNCATION ends the valid region
+            # with done=False (two-channel cuts, replay/sequence.py), so
+            # windows that cross it have neither — they are masked out rather
+            # than bootstrapping from padding (which would teach V=0 at the
+            # cut, the time-limit bias the frame replay also avoids).
+            target_ok = jnp.clip(done_win + v[:, n:], 0.0, 1.0)
+            mask = v[:, :Tn] * target_ok
             td = td * mask
 
             per_seq_loss = (huber(td, 1.0).sum(axis=1)) / jnp.maximum(

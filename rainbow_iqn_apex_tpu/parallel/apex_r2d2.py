@@ -200,7 +200,9 @@ def train_apex_r2d2(cfg: Config, max_frames: Optional[int] = None) -> Dict[str, 
             actions, (pre_c, pre_h) = driver.act(stacker.push(obs))
             new_obs, rewards, terminals, truncs, ep_returns = env.step(actions)
             cuts = terminals | truncs
-            memory.append_batch(obs, actions, rewards, cuts, pre_c, pre_h)
+            memory.append_batch(
+                obs, actions, rewards, terminals, pre_c, pre_h, truncations=truncs
+            )
             driver.reset_lanes(cuts)
             stacker.reset_lanes(cuts)
             obs = new_obs

@@ -9,8 +9,13 @@ burn-in — not sequence-parallel compute).  Design per Kapturowski et al.:
 - each sequence records the actor's LSTM state at its first step (the
   "stored state" that seeds burn-in at training time) — exact for overlapped
   windows too, via a per-step state history;
-- sequences never mix episodes: a terminal inside the window ends the valid
-  region and the remainder is zero-padded with valid=False;
+- sequences never mix episodes: a terminal OR truncation inside the window
+  ends the valid region and the remainder is zero-padded with valid=False.
+  Two-channel cut semantics (mirroring the frame replay,
+  replay/buffer.py): both channels cut the stream, but only true terminals
+  are stored in `done` — a time-limit truncation leaves done=False, and the
+  learn step (ops/r2d2.py) masks out steps whose bootstrap would need data
+  beyond the cut instead of teaching V=0 there;
 - a sum-tree prioritizes whole sequences (max-priority on insert, eta-mix
   write-back from the learner).
 
@@ -102,18 +107,29 @@ class SequenceReplay:
         frames: np.ndarray,  # [lanes, H, W] uint8 — frame the action saw
         actions: np.ndarray,
         rewards: np.ndarray,
-        terminals: np.ndarray,
+        terminals: np.ndarray,  # [lanes] bool — TRUE env terminals only
         lstm_c: np.ndarray,  # [lanes, lstm] actor state BEFORE this step
         lstm_h: np.ndarray,
+        truncations: Optional[np.ndarray] = None,  # [lanes] bool — time-limit cuts
     ) -> int:
         """Push one lockstep tick; emits completed sequences. Returns the
-        number of sequences emitted this tick."""
+        number of sequences emitted this tick.
+
+        Both terminals and truncations flush the lane's builder (the episode
+        stream breaks there), but only terminals are stored in the sequence's
+        `done` channel — the learn step bootstraps through a truncation from
+        whatever valid data exists before it, never teaching V=0 at the cut.
+        """
         with self._lock:
             return self._append_locked(
-                frames, actions, rewards, terminals, lstm_c, lstm_h
+                frames, actions, rewards, terminals, lstm_c, lstm_h, truncations
             )
 
-    def _append_locked(self, frames, actions, rewards, terminals, lstm_c, lstm_h):
+    def _append_locked(
+        self, frames, actions, rewards, terminals, lstm_c, lstm_h, truncations
+    ):
+        if truncations is None:
+            truncations = np.zeros(self.lanes, bool)
         emitted = 0
         for i in range(self.lanes):
             k = int(self._buf_len[i])
@@ -125,8 +141,9 @@ class SequenceReplay:
             self._buf_h[i, k] = lstm_h[i]
             self._buf_len[i] = k + 1
 
-            if terminals[i] or self._buf_len[i] == self.L:
-                emitted += self._emit(i, flush=bool(terminals[i]))
+            cut = bool(terminals[i] or truncations[i])
+            if cut or self._buf_len[i] == self.L:
+                emitted += self._emit(i, flush=cut)
         return emitted
 
     def _emit(self, lane: int, flush: bool) -> int:

@@ -154,7 +154,9 @@ def train_r2d2(cfg: Config, max_frames: Optional[int] = None) -> Dict[str, Any]:
         new_obs, rewards, terminals, truncs, ep_returns = env.step(actions)
         cuts = terminals | truncs  # truncation ends the sequence window too
         # the replay stores SINGLE frames; the learn step re-stacks on device
-        memory.append_batch(obs, actions, rewards, cuts, state_c, state_h)
+        memory.append_batch(
+            obs, actions, rewards, terminals, state_c, state_h, truncations=truncs
+        )
         lstm_state = _mask_reset(lstm_state, cuts)
         stacker.reset_lanes(cuts)
         obs = new_obs

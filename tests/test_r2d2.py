@@ -110,7 +110,7 @@ def _seq_mem(lanes=1, **kw):
     return SequenceReplay(32, 8, (4, 4), lstm_size=6, lanes=lanes, **kw)
 
 
-def _tick(mem, t, lane_vals=None, terminal=False, lanes=1):
+def _tick(mem, t, lane_vals=None, terminal=False, lanes=1, truncated=False):
     f = np.full((lanes, 4, 4), t % 256, np.uint8)
     mem.append_batch(
         f,
@@ -119,6 +119,7 @@ def _tick(mem, t, lane_vals=None, terminal=False, lanes=1):
         np.full(lanes, terminal, bool),
         np.full((lanes, 6), 10.0 * t, np.float32),
         np.full((lanes, 6), -10.0 * t, np.float32),
+        truncations=np.full(lanes, truncated, bool),
     )
 
 
@@ -149,6 +150,26 @@ def test_sequence_terminal_flush_pads():
     assert s.valid[0, :5].all() and not s.valid[0, 5:].any()
     assert s.done[0, 4] and not s.done[0, :4].any()
     # next episode starts a fresh window (no carry across terminal)
+    for t in range(8):
+        _tick(mem, 100 + t)
+    assert len(mem) == 2
+    s2 = mem.sample(8, beta=1.0)
+    i1 = np.flatnonzero(s2.idx == 1)[0]
+    np.testing.assert_array_equal(s2.action[i1], np.arange(100, 108))
+
+
+def test_sequence_truncation_flushes_without_done():
+    """Two-channel cuts: a time-limit truncation ends the sequence (and the
+    builder window) exactly like a terminal, but the stored done channel
+    stays False — only true terminals stop value bootstrapping."""
+    mem = _seq_mem()
+    for t in range(5):
+        _tick(mem, t, truncated=(t == 4))
+    assert len(mem) == 1
+    s = mem.sample(4, beta=1.0)
+    assert s.valid[0, :5].all() and not s.valid[0, 5:].any()
+    assert not s.done[0].any()  # truncation is NOT a terminal
+    # the next episode starts a fresh window (no carry across the cut)
     for t in range(8):
         _tick(mem, 100 + t)
     assert len(mem) == 2
@@ -277,6 +298,48 @@ def test_r2d2_invalid_steps_do_not_contribute(r2d2_setup):
     _, info = step(s, all_invalid, jax.random.PRNGKey(4))
     np.testing.assert_allclose(float(info["loss"]), 0.0, atol=1e-7)
     np.testing.assert_allclose(np.asarray(info["priorities"]), 0.0, atol=1e-7)
+
+
+def test_r2d2_truncation_never_teaches_v0(r2d2_setup):
+    """A sequence cut by a time limit (valid region ends with done=False)
+    must not train any step whose n-step bootstrap would cross the cut —
+    otherwise the zero-padding would act as V=0 at the cut, the exact
+    time-limit bias the two-channel replay design removes.
+
+    Construction (burn=4, T=8, n=2): valid through global step 5, i.e. two
+    valid train-slice steps (4, 5), both of whose bootstrap steps (6, 7)
+    fall beyond the cut.  Truncation => zero loss/priority contribution.
+    The SAME valid region ended by a true terminal at step 5 => nonzero
+    loss (windows containing the terminal form valid no-bootstrap targets).
+    """
+    state, step = r2d2_setup
+    b = _seq_batch(jax.random.PRNGKey(11))
+    valid = jnp.zeros((4, L), bool).at[:, :6].set(True)
+
+    truncated = b.replace(valid=valid)  # done stays all-False
+    s = jax.tree.map(jnp.copy, state)
+    _, info = step(s, truncated, jax.random.PRNGKey(12))
+    np.testing.assert_allclose(float(info["loss"]), 0.0, atol=1e-7)
+    np.testing.assert_allclose(np.asarray(info["priorities"]), 0.0, atol=1e-7)
+
+    terminal = b.replace(valid=valid, done=jnp.zeros((4, L), bool).at[:, 5].set(True))
+    s = jax.tree.map(jnp.copy, state)
+    _, info = step(s, terminal, jax.random.PRNGKey(12))
+    assert float(info["loss"]) > 0.0
+    assert float(np.asarray(info["priorities"]).max()) > 0.0
+
+
+def test_r2d2_truncation_trains_steps_inside_cut(r2d2_setup):
+    """Steps whose full n-step window ends inside the valid region still
+    train when the sequence was truncated later."""
+    state, step = r2d2_setup
+    b = _seq_batch(jax.random.PRNGKey(13))
+    # valid through global step 6: train-slice step 0 (global 4) bootstraps
+    # at global 6 (valid); steps 1-2 would bootstrap at 7-8 (cut) -> masked.
+    valid = jnp.zeros((4, L), bool).at[:, :7].set(True)
+    s = jax.tree.map(jnp.copy, state)
+    _, info = step(s, b.replace(valid=valid), jax.random.PRNGKey(14))
+    assert float(info["loss"]) > 0.0
 
 
 @pytest.mark.slow
