@@ -94,10 +94,22 @@ class PrioritizedReplay:
         self.cuts = np.zeros(capacity, dtype=bool)
 
         self.tree: SumTree
+        self._core = None  # v2 fused C++ append/assemble (replay/native)
         if use_native:
-            from rainbow_iqn_apex_tpu.replay.native import NativeSumTree, native_available
+            from rainbow_iqn_apex_tpu.replay.native import (
+                NativeSumTree,
+                ReplayCore,
+                native_available,
+            )
 
-            self.tree = NativeSumTree(capacity) if native_available() else SumTree(capacity)
+            if native_available():
+                self.tree = NativeSumTree(capacity)
+                # rb_assemble's per-window scratch is sized for history<=16
+                # (any sane stack depth); deeper stacks use the NumPy path
+                if history <= 16:
+                    self._core = ReplayCore(self)
+            else:
+                self.tree = SumTree(capacity)
         else:
             self.tree = SumTree(capacity)
 
@@ -136,6 +148,15 @@ class PrioritizedReplay:
             )
 
     def _append_locked(self, frames, actions, rewards, terminals, priorities, truncations):
+        if self._core is not None:
+            # v2: ring writes + every tree update in one native call
+            self.max_priority = self._core.append_tick(
+                frames, actions, rewards, terminals, priorities, truncations
+            )
+            slots = self._lane_base + self.pos
+            self.pos = (self.pos + 1) % self.seg
+            self.filled = min(self.filled + 1, self.seg)
+            return slots
         slots = self._lane_base + self.pos
         self.frames[slots] = frames
         self.actions[slots] = actions
@@ -241,6 +262,24 @@ class PrioritizedReplay:
     def _sample_locked(self, batch_size: int, beta: float) -> SampledBatch:
         idx, prob = self.tree.sample_stratified(batch_size, self.rng)
         prob = np.maximum(prob, 1e-12)  # fp edge-fall can land on a zero leaf
+        if self._core is not None:
+            # v2: n-step scan + both stack gathers in one native call
+            obs, next_obs, action, reward, discount = self._core.assemble(
+                idx, batch_size
+            )
+            n = len(self)
+            weights = (n * prob) ** (-beta)
+            weights = (weights / weights.max()).astype(np.float32)
+            return SampledBatch(
+                idx=idx,
+                obs=obs,
+                action=action,
+                reward=reward,
+                next_obs=next_obs,
+                discount=discount,
+                weight=weights,
+                prob=prob,
+            )
         lane = idx // self.seg
         off = idx % self.seg
 
@@ -287,7 +326,9 @@ class PrioritizedReplay:
             self._snapshot_locked(path)
 
     def _snapshot_locked(self, path: str) -> None:
-        np.savez_compressed(
+        from rainbow_iqn_apex_tpu.replay import snapshot_io
+
+        snapshot_io.atomic_savez(
             path,
             frames=self.frames,
             actions=self.actions,
@@ -301,9 +342,9 @@ class PrioritizedReplay:
         )
 
     def restore(self, path: str) -> None:
-        if not path.endswith(".npz"):
-            path += ".npz"  # np.savez auto-appends on save; mirror it here
-        z = np.load(path)
+        from rainbow_iqn_apex_tpu.replay import snapshot_io
+
+        z = snapshot_io.load(path)
         if z["frames"].shape != self.frames.shape:
             raise ValueError(
                 f"snapshot shape {z['frames'].shape} != buffer {self.frames.shape}"

@@ -1,8 +1,10 @@
-"""ctypes binding for the C++ sum-tree core (replay/native/sumtree.cc).
+"""ctypes binding for the C++ replay core (replay/native/*.cc).
 
-Builds the shared library on first use with g++ (toolchain is baked into the
-image; no pip/pybind11 needed) and caches it next to the source.  Falls back
-silently to the NumPy implementation when no compiler is available —
+v1: sum-tree set/find hot loops (sumtree.cc).  v2 adds the fused per-tick
+append and per-batch assembly paths (replay_core.cc).  Builds one shared
+library on first use with g++ (toolchain is baked into the image; no
+pip/pybind11 needed) and caches it next to the sources.  Falls back silently
+to the NumPy implementation when no compiler is available —
 ``native_available()`` is the gate.
 """
 
@@ -19,7 +21,10 @@ import numpy as np
 from rainbow_iqn_apex_tpu.replay.sumtree import SumTree
 
 _HERE = os.path.dirname(os.path.abspath(__file__))
-_SRC = os.path.join(_HERE, "native", "sumtree.cc")
+_SRCS = (
+    os.path.join(_HERE, "native", "sumtree.cc"),
+    os.path.join(_HERE, "native", "replay_core.cc"),
+)
 
 
 def _so_path() -> str:
@@ -28,9 +33,11 @@ def _so_path() -> str:
     fresh checkout gets its own artifact name and triggers a rebuild."""
     import hashlib
 
-    with open(_SRC, "rb") as f:
-        h = hashlib.sha256(f.read()).hexdigest()[:16]
-    return os.path.join(_HERE, "native", f"_sumtree_{h}.so")
+    h = hashlib.sha256()
+    for src in _SRCS:
+        with open(src, "rb") as f:
+            h.update(f.read())
+    return os.path.join(_HERE, "native", f"_replay_{h.hexdigest()[:16]}.so")
 
 
 _SO = _so_path()
@@ -41,6 +48,9 @@ _tried = False
 
 _i64p = np.ctypeslib.ndpointer(np.int64, flags="C_CONTIGUOUS")
 _f64p = np.ctypeslib.ndpointer(np.float64, flags="C_CONTIGUOUS")
+_f32p = np.ctypeslib.ndpointer(np.float32, flags="C_CONTIGUOUS")
+_i32p = np.ctypeslib.ndpointer(np.int32, flags="C_CONTIGUOUS")
+_u8p = np.ctypeslib.ndpointer(np.uint8, flags="C_CONTIGUOUS")
 
 
 def _build_and_load() -> Optional[ctypes.CDLL]:
@@ -52,7 +62,8 @@ def _build_and_load() -> Optional[ctypes.CDLL]:
         try:
             if not os.path.exists(_SO):  # name is content-hashed: exists == fresh
                 subprocess.run(
-                    ["g++", "-O3", "-march=native", "-shared", "-fPIC", _SRC, "-o", _SO],
+                    ["g++", "-O3", "-march=native", "-shared", "-fPIC", *_SRCS,
+                     "-o", _SO],
                     check=True,
                     capture_output=True,
                     timeout=120,
@@ -69,6 +80,25 @@ def _build_and_load() -> Optional[ctypes.CDLL]:
                 ctypes.c_int64,
             ]
             lib.st_sample.restype = None
+            i64 = ctypes.c_int64
+            lib.rb_append_tick.argtypes = [
+                _u8p, _i32p, _f32p, _u8p, _u8p,  # frames/actions/rewards/term/cuts
+                _f64p, i64,  # tree, span
+                i64, i64, i64, i64, i64, i64, i64,  # lanes seg pos filled hist n fb
+                _u8p, _i32p, _f32p, _u8p,  # new frame/action/reward/terminal
+                ctypes.c_void_p, ctypes.c_void_p,  # truncs?, priorities?
+                ctypes.c_double, ctypes.c_double,  # eps, omega
+                ctypes.POINTER(ctypes.c_double),  # max_priority (inout)
+            ]
+            lib.rb_append_tick.restype = None
+            lib.rb_assemble.argtypes = [
+                _u8p, _i32p, _f32p, _u8p, _u8p,
+                i64, i64, i64, i64, i64,  # seg filled hist n fb
+                _f32p,  # gammas
+                _i64p, i64,  # idx, batch
+                _u8p, _u8p, _i32p, _f32p, _f32p,  # outputs
+            ]
+            lib.rb_assemble.restype = None
             _lib = lib
         except Exception:
             _lib = None
@@ -124,3 +154,71 @@ class NativeSumTree(SumTree):
         pri = np.empty(batch_size, np.float64)
         self._lib.st_sample(self.tree, self.span, self.capacity, mass, idx, pri, batch_size)
         return idx, pri / total
+
+
+class ReplayCore:
+    """v2 fused append/assemble over a PrioritizedReplay's own arrays.
+
+    One ctypes call per actor tick (ring writes + every tree update,
+    including the truncation-eligibility rule) and one per sampled batch
+    (n-step scan + both stack gathers straight into the [B, H, W, hist]
+    device layout).  The buffer's NumPy arrays are the single source of
+    truth; this object holds no state beyond the library handle.
+    """
+
+    def __init__(self, buf):
+        self._lib = _build_and_load()
+        if self._lib is None:
+            raise RuntimeError("native replay core unavailable (no compiler?)")
+        self._b = buf
+        self._fb = buf.frames.shape[1] * buf.frames.shape[2]
+
+    def append_tick(self, frames, actions, rewards, terminals, priorities,
+                    truncations) -> float:
+        b = self._b
+        mp = ctypes.c_double(b.max_priority)
+        trunc = (
+            None
+            if truncations is None
+            else np.ascontiguousarray(np.asarray(truncations, bool)).view(np.uint8)
+        )
+        pri = (
+            None
+            if priorities is None
+            else np.ascontiguousarray(np.asarray(priorities, np.float64))
+        )
+        self._lib.rb_append_tick(
+            b.frames.reshape(b.frames.shape[0], -1),
+            b.actions, b.rewards,
+            b.terminals.view(np.uint8), b.cuts.view(np.uint8),
+            b.tree.tree, b.tree.span,
+            b.lanes, b.seg, b.pos, b.filled, b.history, b.n_step, self._fb,
+            np.ascontiguousarray(frames, np.uint8).reshape(len(frames), -1),
+            np.ascontiguousarray(actions, np.int32),
+            np.ascontiguousarray(rewards, np.float32),
+            np.ascontiguousarray(np.asarray(terminals, bool)).view(np.uint8),
+            None if trunc is None else trunc.ctypes.data_as(ctypes.c_void_p),
+            None if pri is None else pri.ctypes.data_as(ctypes.c_void_p),
+            b.eps, b.omega, ctypes.byref(mp),
+        )
+        return mp.value
+
+    def assemble(self, idx: np.ndarray, batch_size: int):
+        b = self._b
+        h, w = b.frames.shape[1], b.frames.shape[2]
+        obs = np.empty((batch_size, h, w, b.history), np.uint8)
+        next_obs = np.empty_like(obs)
+        action = np.empty(batch_size, np.int32)
+        reward = np.empty(batch_size, np.float32)
+        discount = np.empty(batch_size, np.float32)
+        self._lib.rb_assemble(
+            b.frames.reshape(b.frames.shape[0], -1),
+            b.actions, b.rewards,
+            b.terminals.view(np.uint8), b.cuts.view(np.uint8),
+            b.seg, b.filled, b.history, b.n_step, self._fb,
+            b._gammas,
+            np.ascontiguousarray(idx, np.int64), batch_size,
+            obs.reshape(batch_size, -1), next_obs.reshape(batch_size, -1),
+            action, reward, discount,
+        )
+        return obs, next_obs, action, reward, discount

@@ -222,3 +222,57 @@ class SequenceReplay:
             pri = (np.asarray(td_mix, np.float64) + self.eps) ** self.omega
             self.max_priority = max(self.max_priority, float(pri.max()))
             self.tree.set(idx, pri)
+
+    # -------------------------------------------------------------- snapshot
+    def snapshot(self, path: str) -> None:
+        """Persist sequences AND the per-lane builder windows (so a resumed
+        run continues mid-episode without losing the partial window)."""
+        from rainbow_iqn_apex_tpu.replay import snapshot_io
+
+        with self._lock:
+            snapshot_io.atomic_savez(
+                path,
+                frames=self.frames,
+                actions=self.actions,
+                rewards=self.rewards,
+                dones=self.dones,
+                valids=self.valids,
+                init_c=self.init_c,
+                init_h=self.init_h,
+                tree=self.tree.tree,
+                pos=self.pos,
+                filled=self.filled,
+                max_priority=self.max_priority,
+                buf_frames=self._buf_frames,
+                buf_actions=self._buf_actions,
+                buf_rewards=self._buf_rewards,
+                buf_dones=self._buf_dones,
+                buf_c=self._buf_c,
+                buf_h=self._buf_h,
+                buf_len=self._buf_len,
+            )
+
+    def restore(self, path: str) -> None:
+        from rainbow_iqn_apex_tpu.replay import snapshot_io
+
+        z = snapshot_io.load(path)
+        if z["frames"].shape != self.frames.shape:
+            raise ValueError(
+                f"snapshot shape {z['frames'].shape} != buffer {self.frames.shape}"
+            )
+        with self._lock:
+            for name, arr in (
+                ("frames", self.frames), ("actions", self.actions),
+                ("rewards", self.rewards), ("dones", self.dones),
+                ("valids", self.valids), ("init_c", self.init_c),
+                ("init_h", self.init_h), ("buf_frames", self._buf_frames),
+                ("buf_actions", self._buf_actions),
+                ("buf_rewards", self._buf_rewards),
+                ("buf_dones", self._buf_dones), ("buf_c", self._buf_c),
+                ("buf_h", self._buf_h), ("buf_len", self._buf_len),
+            ):
+                arr[:] = z[name]
+            self.tree.tree[:] = z["tree"]
+            self.pos = int(z["pos"])
+            self.filled = int(z["filled"])
+            self.max_priority = float(z["max_priority"])

@@ -1,0 +1,41 @@
+"""Atomic npz snapshot IO shared by the replay implementations.
+
+A snapshot exists to survive kills (resume support), so the write itself
+must survive kills: np.savez straight onto the destination truncates the
+previous good snapshot before the new one is complete, and a SIGKILL
+mid-write leaves nothing restorable.  Writes here go to a temp file in the
+same directory followed by os.replace (atomic on POSIX), so the destination
+always holds either the old snapshot or the new one — never a torn file.
+"""
+
+from __future__ import annotations
+
+import os
+import zipfile
+
+import numpy as np
+
+# Exceptions that mean "no usable snapshot here" (missing or torn file from
+# a pre-atomic-write kill), as opposed to caller errors like shape mismatch.
+MISSING = (FileNotFoundError, zipfile.BadZipFile, EOFError)
+
+
+def npz_path(path: str) -> str:
+    """np.savez auto-appends .npz when given a filename; mirror that so
+    save and load agree on the real destination."""
+    return path if path.endswith(".npz") else path + ".npz"
+
+
+def atomic_savez(path: str, **arrays) -> None:
+    """Uncompressed atomic write (uint8 frames are near-incompressible and
+    zlib would multiply the time any caller-held lock is taken)."""
+    dest = npz_path(path)
+    tmp = dest + ".tmp"
+    with open(tmp, "wb") as f:
+        np.savez(f, **arrays)
+    os.replace(tmp, dest)
+
+
+def load(path: str):
+    """np.load of a snapshot; raises one of MISSING when absent/torn."""
+    return np.load(npz_path(path))
