@@ -61,7 +61,9 @@ ATARI57 = sorted(ATARI57_BASELINES)
 # thesis is that "superhuman" agents reach only a small fraction of these).
 # PARTIAL table [RECON — re-verify against the SABER appendix]: entries are
 # included only where training-data recall is reasonably confident; the
-# aggregation skips games without a record entry and reports coverage.
+# aggregation skips games without a record entry, reports coverage, and by
+# default EXCLUDES unverified (RECON) entries from the headline number —
+# load a vetted table with ``load_record_table`` to mark entries verified.
 HUMAN_WORLD_RECORDS: Dict[str, float] = {
     "Asteroids": 10_004_100.0,
     "Atlantis": 10_604_840.0,
@@ -75,6 +77,39 @@ HUMAN_WORLD_RECORDS: Dict[str, float] = {
     "SpaceInvaders": 621_535.0,
     "VideoPinball": 89_218_328.0,
 }
+
+# Provenance per record entry: "recon" (training-data recall, unverified) or
+# "verified" (injected from a vetted JSON table).  Nothing ships verified —
+# the sandbox has no egress to check a source.
+RECORD_PROVENANCE: Dict[str, str] = {g: "recon" for g in HUMAN_WORLD_RECORDS}
+
+
+def load_record_table(path: str, verified: bool = True) -> int:
+    """Merge a JSON world-record table into the in-process one.
+
+    Accepts either ``{"Pong": 21.0, ...}`` or
+    ``{"Pong": {"record": 21.0, "verified": true}, ...}``.  Entries loaded
+    with ``verified`` (the default, overridable per entry) count toward the
+    headline SABER aggregate; returns the number of entries merged.
+    """
+    with open(path) as f:
+        table = json.load(f)
+    n = 0
+    for game, entry in table.items():
+        if isinstance(entry, dict):
+            value = float(entry["record"])
+            is_verified = bool(entry.get("verified", verified))
+        else:
+            value = float(entry)
+            is_verified = verified
+        HUMAN_WORLD_RECORDS[game] = value
+        RECORD_PROVENANCE[game] = "verified" if is_verified else "recon"
+        n += 1
+    return n
+
+
+def record_is_verified(game: str) -> bool:
+    return RECORD_PROVENANCE.get(game) == "verified"
 
 
 def world_record_normalized(game: str, raw: float) -> Optional[float]:
@@ -96,8 +131,16 @@ def human_normalized_score(game: str, raw: float) -> Optional[float]:
 from statistics import median as _median  # noqa: E402
 
 
-def aggregate(per_game_raw: Dict[str, float]) -> Dict[str, float]:
-    """Median/mean human- and world-record-normalized over evaluated games."""
+def aggregate(
+    per_game_raw: Dict[str, float], include_recon_records: bool = False
+) -> Dict[str, float]:
+    """Median/mean human- and world-record-normalized over evaluated games.
+
+    The headline ``median_world_record_normalized`` uses only VERIFIED record
+    entries unless ``include_recon_records=True``; the RECON-inclusive value
+    is always reported separately (suffix ``_recon``) with both coverage
+    counts, so unvetted constants can never silently become the headline.
+    """
     hns = [
         hn
         for g, s in per_game_raw.items()
@@ -110,14 +153,19 @@ def aggregate(per_game_raw: Dict[str, float]) -> Dict[str, float]:
         "median_human_normalized": _median(hns),
         "mean_human_normalized": sum(hns) / len(hns),
     }
-    wrs = [
-        wr
+    wrs_all: Dict[str, float] = {
+        g: wr
         for g, s in per_game_raw.items()
         if (wr := world_record_normalized(g, s)) is not None
-    ]
-    if wrs:  # SABER metric over the covered subset
-        out["median_world_record_normalized"] = _median(wrs)
-        out["world_record_coverage"] = len(wrs)
+    }
+    wrs_verified = {g: wr for g, wr in wrs_all.items() if record_is_verified(g)}
+    headline = wrs_all if include_recon_records else wrs_verified
+    if headline:  # SABER metric over the covered subset
+        out["median_world_record_normalized"] = _median(headline.values())
+    if wrs_all:
+        out["median_world_record_normalized_recon"] = _median(wrs_all.values())
+    out["world_record_coverage_verified"] = len(wrs_verified)
+    out["world_record_coverage_recon"] = len(wrs_all) - len(wrs_verified)
     return out
 
 
@@ -132,15 +180,21 @@ def write_results_csv(path: str, rows: List[Dict]) -> None:
 
 
 def run_sweep(base_args: List[str], games: Optional[List[str]] = None,
-              results_dir: str = "results/atari57") -> Dict[str, float]:
+              results_dir: str = "results/atari57",
+              record_table: Optional[str] = None,
+              include_recon_records: bool = False) -> Dict[str, float]:
     """Sequentially train+eval each game via the training CLI.
 
     One game at a time on one host's slice; pod-scale sweeps launch one game
-    per slice with scripts/launch_apex.sh.  Returns the aggregate.
+    per slice with scripts/launch_apex.sh.  ``record_table`` loads a vetted
+    world-record JSON before aggregating (see ``load_record_table``).
+    Returns the aggregate, including verified/recon coverage counts.
     """
     import subprocess
     import sys
 
+    if record_table:
+        load_record_table(record_table)
     games = games or ATARI57
     per_game: Dict[str, float] = {}
     rows = []
@@ -166,10 +220,11 @@ def run_sweep(base_args: List[str], games: Optional[List[str]] = None,
                 "score_mean": raw,
                 "human_normalized": human_normalized_score(game, raw),
                 "world_record_normalized": world_record_normalized(game, raw),
+                "record_provenance": RECORD_PROVENANCE.get(game, "none"),
                 **{k: v for k, v in summary.items() if k.startswith("eval_")},
             })
     write_results_csv(os.path.join(results_dir, "per_game.csv"), rows)
-    agg = aggregate(per_game)
+    agg = aggregate(per_game, include_recon_records=include_recon_records)
     with open(os.path.join(results_dir, "aggregate.json"), "w") as f:
         json.dump(agg, f, indent=2)
     return agg

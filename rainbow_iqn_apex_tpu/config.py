@@ -29,6 +29,9 @@ class Config:
     checkpoint_interval: int = 100_000  # learner steps between Orbax saves
     metrics_interval: int = 1_000  # learner steps between JSONL metric rows
     resume: bool = False
+    snapshot_replay: bool = False  # persist replay contents next to checkpoints
+    # (parity: the reference's replay survives restarts via Redis persistence;
+    # off by default — Atari-scale buffers are ~7GB/host on disk)
 
     # ---- environment (SURVEY §2 row 2) -------------------------------------------
     env_id: str = "toy:catch"  # "toy:catch", "toy:chain", or "atari:<Game>"
@@ -103,6 +106,10 @@ class Config:
     mesh_shape: str = ""  # e.g. "dp=8" or "dp=4,actor=4"; "" = all devices dp
     learner_devices: int = 0  # 0 = all devices are learner devices
     bf16_weight_sync: bool = True  # cast params to bf16 for the actor broadcast
+    # ---- multi-host (jax.distributed over DCN; replaces remote Redis actors) ------
+    process_count: int = 1  # pod hosts running this SPMD program
+    process_id: int = 0  # this host's index in [0, process_count)
+    coordinator_address: str = ""  # host:port of process 0 (the Redis-host flag's heir)
 
     # ---- evaluation (SURVEY §2 row 9) ---------------------------------------------
     eval_episodes: int = 10

@@ -45,7 +45,11 @@ from rainbow_iqn_apex_tpu.parallel.mesh import (
 )
 from rainbow_iqn_apex_tpu.replay.sequence import SequenceReplay, SequenceSample
 from rainbow_iqn_apex_tpu.train import priority_beta
-from rainbow_iqn_apex_tpu.utils.checkpoint import Checkpointer
+from rainbow_iqn_apex_tpu.utils.checkpoint import (
+    Checkpointer,
+    maybe_restore_replay,
+    save_replay_snapshot,
+)
 from rainbow_iqn_apex_tpu.utils.logging import MetricsLogger
 from rainbow_iqn_apex_tpu.utils.prefetch import BatchPrefetcher
 
@@ -126,6 +130,14 @@ class R2D2ApexDriver:
             p = jax.device_put(p, self._rep_a)
         self.actor_params = p
 
+    def restore(self, ckpt) -> Dict[str, Any]:
+        """Load the latest checkpoint into the learner mesh and re-publish
+        actor weights; returns the checkpoint's extra metadata."""
+        state, extra = ckpt.restore(self.state)
+        self.state = jax.device_put(state, replicated(self.lmesh))
+        self.publish_weights()
+        return extra
+
     def act(self, obs: np.ndarray) -> Tuple[np.ndarray, Tuple[np.ndarray, np.ndarray]]:
         """obs [L, H, W] u8 (history 1) or [L, H, W, hist] stacked ->
         (actions [L], pre-step host state (c, h)).
@@ -186,11 +198,18 @@ def train_apex_r2d2(cfg: Config, max_frames: Optional[int] = None) -> Dict[str, 
     metrics = MetricsLogger(os.path.join(run_dir, "metrics.jsonl"), cfg.run_id)
     ckpt = Checkpointer(os.path.join(cfg.checkpoint_dir, cfg.run_id))
 
+    frames = 0
+    last_pub = 0
+    if cfg.resume and ckpt.latest_step() is not None:
+        extra = driver.restore(ckpt)
+        frames = int(extra.get("frames", 0))
+        last_pub = driver.step
+        maybe_restore_replay(cfg, memory)
+        metrics.log("resume", step=driver.step, frames=frames)
+
     obs = env.reset()
     stacker = FrameStacker(lanes, env.frame_shape, cfg.history_length)
     returns: collections.deque = collections.deque(maxlen=100)
-    frames = 0
-    last_pub = 0
     prefetcher: Optional[BatchPrefetcher] = None
     learn_start_seqs = max(cfg.learn_start // seq_total, 8)
     frames_per_step = cfg.replay_ratio * cfg.r2d2_seq_len
@@ -253,6 +272,7 @@ def train_apex_r2d2(cfg: Config, max_frames: Optional[int] = None) -> Dict[str, 
                         )
                     if cfg.checkpoint_interval and step % cfg.checkpoint_interval == 0:
                         ckpt.save(step, driver.state, {"frames": frames})
+                        save_replay_snapshot(cfg, memory)
     finally:
         if prefetcher is not None:
             prefetcher.close()
@@ -260,6 +280,7 @@ def train_apex_r2d2(cfg: Config, max_frames: Optional[int] = None) -> Dict[str, 
     final_eval = _eval_r2d2_learner(cfg, env, driver)
     metrics.log("eval", step=driver.step, **final_eval)
     ckpt.save(driver.step, driver.state, {"frames": frames})
+    save_replay_snapshot(cfg, memory)
     ckpt.wait()
     metrics.close()
     return {

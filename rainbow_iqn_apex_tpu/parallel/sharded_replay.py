@@ -136,6 +136,27 @@ class ShardedReplay:
             prob=prob,
         )
 
+    # -------------------------------------------------------------- snapshot
+    def snapshot(self, path_prefix: str) -> None:
+        """One npz per shard (the per-host persistence unit in the pod
+        picture, mirroring per-redis-instance RDB files)."""
+        for k, shard in enumerate(self.shards):
+            shard.snapshot(f"{path_prefix}_shard{k}")
+
+    def restore(self, path_prefix: str) -> None:
+        import os
+
+        from rainbow_iqn_apex_tpu.replay import snapshot_io
+
+        # check the whole shard set up front so a kill that landed between
+        # shard writes reads as "no snapshot" instead of a half-restored mix
+        paths = [f"{path_prefix}_shard{k}" for k in range(len(self.shards))]
+        for p in paths:
+            if not os.path.exists(snapshot_io.npz_path(p)):
+                raise FileNotFoundError(snapshot_io.npz_path(p))
+        for shard, p in zip(self.shards, paths):
+            shard.restore(p)
+
     # -------------------------------------------------------------- priorities
     def update_priorities(self, idx: np.ndarray, td_abs: np.ndarray) -> None:
         shard_of = idx // self.shard_capacity

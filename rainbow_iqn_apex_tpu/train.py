@@ -22,7 +22,11 @@ from rainbow_iqn_apex_tpu.config import Config
 from rainbow_iqn_apex_tpu.envs import make_vector_env
 from rainbow_iqn_apex_tpu.eval import evaluate
 from rainbow_iqn_apex_tpu.replay.buffer import PrioritizedReplay
-from rainbow_iqn_apex_tpu.utils.checkpoint import Checkpointer
+from rainbow_iqn_apex_tpu.utils.checkpoint import (
+    Checkpointer,
+    maybe_restore_replay,
+    save_replay_snapshot,
+)
 from rainbow_iqn_apex_tpu.utils.logging import MetricsLogger
 
 
@@ -65,6 +69,7 @@ def train(cfg: Config, max_frames: Optional[int] = None) -> Dict[str, Any]:
     if cfg.resume and ckpt.latest_step() is not None:
         agent.state, extra = ckpt.restore(agent.state)
         frames = int(extra.get("frames", 0))
+        maybe_restore_replay(cfg, memory)
         metrics.log("resume", step=agent.step, frames=frames)
 
     stacker = FrameStacker(lanes, env.frame_shape, cfg.history_length)
@@ -125,12 +130,14 @@ def train(cfg: Config, max_frames: Optional[int] = None) -> Dict[str, Any]:
                         metrics.log("eval", step=step, **last_eval)
                     if cfg.checkpoint_interval and step % cfg.checkpoint_interval == 0:
                         ckpt.save(step, agent.state, {"frames": frames})
+                        save_replay_snapshot(cfg, memory)
     finally:
         if prefetcher is not None:
             prefetcher.close()
     final_eval = evaluate(cfg, agent, seed=cfg.seed + 977)
     metrics.log("eval", step=agent.step, **final_eval)
     ckpt.save(agent.step, agent.state, {"frames": frames})
+    save_replay_snapshot(cfg, memory)
     ckpt.wait()
     metrics.close()
     return {

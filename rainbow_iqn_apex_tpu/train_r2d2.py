@@ -28,7 +28,11 @@ from rainbow_iqn_apex_tpu.ops.r2d2 import (
 )
 from rainbow_iqn_apex_tpu.replay.sequence import SequenceReplay
 from rainbow_iqn_apex_tpu.train import priority_beta
-from rainbow_iqn_apex_tpu.utils.checkpoint import Checkpointer
+from rainbow_iqn_apex_tpu.utils.checkpoint import (
+    Checkpointer,
+    maybe_restore_replay,
+    save_replay_snapshot,
+)
 from rainbow_iqn_apex_tpu.utils.logging import MetricsLogger
 
 
@@ -140,11 +144,17 @@ def train_r2d2(cfg: Config, max_frames: Optional[int] = None) -> Dict[str, Any]:
     metrics = MetricsLogger(os.path.join(run_dir, "metrics.jsonl"), cfg.run_id)
     ckpt = Checkpointer(os.path.join(cfg.checkpoint_dir, cfg.run_id))
 
+    frames = 0
+    if cfg.resume and ckpt.latest_step() is not None:
+        agent.state, extra = ckpt.restore(agent.state)
+        frames = int(extra.get("frames", 0))
+        maybe_restore_replay(cfg, memory)
+        metrics.log("resume", step=agent.step, frames=frames)
+
     obs = env.reset()
     lstm_state = agent.initial_lstm_state(lanes)
     stacker = FrameStacker(lanes, env.frame_shape, cfg.history_length)
     returns: collections.deque = collections.deque(maxlen=100)
-    frames = 0
     learn_start_seqs = max(cfg.learn_start // seq_total, 8)
 
     while frames < total_frames:
@@ -190,10 +200,12 @@ def train_r2d2(cfg: Config, max_frames: Optional[int] = None) -> Dict[str, Any]:
                     )
                 if cfg.checkpoint_interval and step % cfg.checkpoint_interval == 0:
                     ckpt.save(step, agent.state, {"frames": frames})
+                    save_replay_snapshot(cfg, memory)
 
     final_eval = evaluate_r2d2(cfg, agent, seed=cfg.seed + 977)
     metrics.log("eval", step=agent.step, **final_eval)
     ckpt.save(agent.step, agent.state, {"frames": frames})
+    save_replay_snapshot(cfg, memory)
     ckpt.wait()
     metrics.close()
     return {

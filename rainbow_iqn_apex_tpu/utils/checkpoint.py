@@ -64,3 +64,41 @@ class Checkpointer:
 
     def close(self) -> None:
         self._mngr.close()
+
+
+# ---------------------------------------------------------------- replay I/O
+def replay_snapshot_path(cfg) -> str:
+    """Replay snapshots live NEXT TO the Orbax dir, never inside it (the
+    manager owns its directory's step layout).  Multi-host runs write one
+    file set per host (shard-per-host topology; a shared filesystem sees
+    distinct names)."""
+    suffix = f"_h{cfg.process_id}" if cfg.process_count > 1 else ""
+    return os.path.join(
+        cfg.checkpoint_dir, cfg.run_id + "_replay", "replay" + suffix
+    )
+
+
+def save_replay_snapshot(cfg, memory) -> None:
+    """Persist replay contents when cfg.snapshot_replay is set (works for
+    PrioritizedReplay, ShardedReplay and SequenceReplay — all expose
+    snapshot(path))."""
+    if not cfg.snapshot_replay:
+        return
+    path = replay_snapshot_path(cfg)
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    memory.snapshot(path)
+
+
+def maybe_restore_replay(cfg, memory) -> bool:
+    """Restore a replay snapshot if a usable one exists; returns whether it
+    did.  Missing or torn files (kill mid-write, pre-atomic era) degrade to
+    a cold replay; genuine mismatches (wrong shapes) still raise."""
+    from rainbow_iqn_apex_tpu.replay import snapshot_io
+
+    if not cfg.snapshot_replay:
+        return False
+    try:
+        memory.restore(replay_snapshot_path(cfg))
+        return True
+    except snapshot_io.MISSING:
+        return False

@@ -83,6 +83,41 @@ def test_learn_and_publish(driver):
         np.testing.assert_allclose(np.asarray(lp), np.asarray(ap), rtol=2e-2, atol=1e-2)
 
 
+def test_apex_r2d2_kill_and_resume(tmp_path):
+    """Resumed mesh R2D2 continues step/frame counters from the checkpoint
+    and restores the sequence-replay snapshot (builder windows included)."""
+    import json
+
+    cfg = CFG.replace(
+        env_id="toy:catch",
+        learn_start=256,
+        replay_ratio=4,
+        memory_capacity=8192,
+        metrics_interval=20,
+        checkpoint_interval=10,
+        eval_interval=0,
+        eval_episodes=2,
+        resume=True,
+        snapshot_replay=True,
+        results_dir=str(tmp_path / "results"),
+        checkpoint_dir=str(tmp_path / "ckpt"),
+    )
+    first = train_apex_r2d2(cfg, max_frames=1_000)
+    assert first["learn_steps"] > 0
+
+    second = train_apex_r2d2(cfg, max_frames=1_800)
+    assert second["frames"] == 1_800
+    assert second["learn_steps"] > first["learn_steps"]
+    assert second["sequences"] >= first["sequences"]  # snapshot restored
+    rows = [
+        json.loads(line)
+        for line in open(tmp_path / "results" / cfg.run_id / "metrics.jsonl")
+    ]
+    resumes = [r for r in rows if r.get("kind") == "resume"]
+    assert resumes and resumes[-1]["step"] == first["learn_steps"]
+    assert resumes[-1]["frames"] == first["frames"]
+
+
 @pytest.mark.slow
 def test_apex_r2d2_end_to_end_short(tmp_path):
     cfg = CFG.replace(

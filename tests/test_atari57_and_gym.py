@@ -49,8 +49,44 @@ def test_world_record_normalized_saber_metric():
     assert world_record_normalized("Alien", 100.0) is None  # no record entry
 
     agg = aggregate({"Pong": 21.0, "Breakout": 400.0, "Alien": 1000.0})
-    assert agg["world_record_coverage"] == 2
-    assert 0.4 < agg["median_world_record_normalized"] < 1.0
+    # nothing ships verified: the headline is withheld, the RECON-inclusive
+    # value is reported separately with explicit coverage counts
+    assert "median_world_record_normalized" not in agg
+    assert agg["world_record_coverage_verified"] == 0
+    assert agg["world_record_coverage_recon"] == 2
+    assert 0.4 < agg["median_world_record_normalized_recon"] < 1.0
+    # explicit opt-in promotes the RECON values to the headline
+    agg_in = aggregate(
+        {"Pong": 21.0, "Breakout": 400.0}, include_recon_records=True
+    )
+    assert 0.4 < agg_in["median_world_record_normalized"] < 1.0
+
+
+def test_record_table_loading_marks_verified(tmp_path):
+    import json as _json
+
+    from rainbow_iqn_apex_tpu import atari57
+
+    p = tmp_path / "records.json"
+    p.write_text(_json.dumps({
+        "Pong": 21.0,
+        "Breakout": {"record": 864.0, "verified": True},
+        "Alien": {"record": 251_916.0, "verified": False},
+    }))
+    before = dict(atari57.RECORD_PROVENANCE)
+    try:
+        assert atari57.load_record_table(str(p)) == 3
+        assert atari57.record_is_verified("Pong")
+        assert atari57.record_is_verified("Breakout")
+        assert not atari57.record_is_verified("Alien")
+        agg = aggregate({"Pong": 21.0, "Breakout": 400.0, "Alien": 1000.0})
+        assert agg["world_record_coverage_verified"] == 2
+        assert agg["world_record_coverage_recon"] == 1
+        assert 0.4 < agg["median_world_record_normalized"] < 1.0
+    finally:  # restore module state for other tests
+        atari57.RECORD_PROVENANCE.clear()
+        atari57.RECORD_PROVENANCE.update(before)
+        atari57.HUMAN_WORLD_RECORDS.pop("Alien", None)
 
 
 def test_results_csv(tmp_path):
