@@ -54,8 +54,20 @@ from rainbow_iqn_apex_tpu.parallel.mesh import (
     split_devices,
 )
 from rainbow_iqn_apex_tpu.parallel.sharded_replay import ShardedReplay
-from rainbow_iqn_apex_tpu.utils.checkpoint import Checkpointer
+from rainbow_iqn_apex_tpu.utils.checkpoint import (
+    Checkpointer,
+    maybe_restore_replay,
+    save_replay_snapshot,
+)
 from rainbow_iqn_apex_tpu.utils.logging import MetricsLogger
+
+
+def _local_rows(arr: jax.Array) -> np.ndarray:
+    """This process's rows of a leading-axis-sharded global array, in global
+    row order (= the order of the local data this process contributed via
+    ``make_array_from_process_local_data``)."""
+    shards = sorted(arr.addressable_shards, key=lambda s: s.index[0].start or 0)
+    return np.concatenate([np.asarray(s.data) for s in shards])
 
 
 class ActorPriorityEstimator:
@@ -124,13 +136,15 @@ class ApexDriver:
 
         # learner step: batch split over dp, state replicated; XLA inserts the
         # gradient all-reduce (psum over "dp") from the sharding alone.
+        self._batch_sh = batch_sharding(self.lmesh, "dp")
         self._learn = jax.jit(
             build_learn_step(cfg, num_actions),
-            in_shardings=(rep_l, batch_sharding(self.lmesh, "dp"), rep_l),
+            in_shardings=(rep_l, self._batch_sh, rep_l),
             donate_argnums=0,
         )
         # actor step: lanes split over the actor mesh, params replicated.
         lane_sh = batch_sharding(self.amesh, "actor")
+        self._lane_sh = lane_sh
         self._act = jax.jit(
             build_act_step(cfg, num_actions, use_noise=True),
             in_shardings=(rep_a, lane_sh, rep_a),
@@ -144,6 +158,14 @@ class ApexDriver:
                 lambda p: jax.tree.map(lambda x: x.astype(jnp.float32), p),
                 out_shardings=rep_a,
             )
+        # multi-host: (N q)^-beta max-normalized over the GLOBAL batch
+        self._global_is_weights = jax.jit(
+            lambda q, n, beta: (lambda w: (w / w.max()).astype(jnp.float32))(
+                (n * jnp.maximum(q, 1e-12)) ** (-beta)
+            ),
+            in_shardings=(self._batch_sh, None, None),
+            out_shardings=self._batch_sh,
+        )
         self.actor_params = None
         self.publish_weights()  # initial broadcast
 
@@ -156,6 +178,15 @@ class ApexDriver:
         else:
             p = jax.device_put(p, replicated(self.amesh))
         self.actor_params = p
+
+    # ---------------------------------------------------------------- resume
+    def restore(self, ckpt) -> Dict[str, Any]:
+        """Load the latest checkpoint into the learner mesh and re-publish
+        actor weights; returns the checkpoint's extra metadata."""
+        state, extra = ckpt.restore(self.state)
+        self.state = jax.device_put(state, replicated(self.lmesh))
+        self.publish_weights()
+        return extra
 
     # ----------------------------------------------------------------- compute
     def _next_key(self):
@@ -178,6 +209,63 @@ class ApexDriver:
         self.state, info = self._learn(self.state, batch, self._next_key())
         return info
 
+    # ------------------------------------------------------------- multi-host
+    # Every pod host runs this same program (SPMD): each host contributes its
+    # LOCAL sub-batch / env lanes, jax assembles the global arrays over the
+    # process-spanning mesh, and the only cross-host traffic is the gradient
+    # all-reduce XLA inserts (the Redis TCP fabric replaced by ICI/DCN
+    # collectives — SURVEY §2 rows 6-7, §5 backend mapping).
+    def learn_local(
+        self,
+        sample,
+        global_size: Optional[int] = None,
+        beta: Optional[float] = None,
+    ) -> Dict[str, Any]:
+        """Learn step fed from this host's local sub-batch (B/hosts rows).
+        Returns info with ``priorities`` as the LOCAL rows only, in the same
+        order as the input — ready for local shard write-back.
+
+        IS weights: each host's replay normalizes weights over its OWN
+        sub-batch, which is inconsistent across hosts (each host's max row
+        gets 1.0 regardless of its true global weight).  When
+        ``global_size``/``beta`` are given, weights are re-derived in-graph
+        over the assembled GLOBAL batch from the per-row sample
+        probabilities: q(i) = prob_local(i) / n_hosts (the fixed per-host
+        quota makes the scheme a uniform mixture over hosts), w = (N q)^-b
+        max-normalized across all hosts — the cross-host max is one tiny
+        XLA collective.
+        """
+        put = lambda x, dt: jax.make_array_from_process_local_data(  # noqa: E731
+            self._batch_sh, np.ascontiguousarray(x, dt)
+        )
+        if global_size is not None and sample.prob is not None:
+            nproc = jax.process_count()
+            q = put(np.asarray(sample.prob) / nproc, np.float32)
+            weight = self._global_is_weights(
+                q, jnp.float32(global_size), jnp.float32(beta)
+            )
+        else:
+            weight = put(sample.weight, np.float32)
+        batch = Batch(
+            obs=put(sample.obs, np.uint8),
+            action=put(sample.action, np.int32),
+            reward=put(sample.reward, np.float32),
+            next_obs=put(sample.next_obs, np.uint8),
+            discount=put(sample.discount, np.float32),
+            weight=weight,
+        )
+        info = self.learn_batch(batch)
+        pri = _local_rows(info["priorities"])
+        return {**info, "priorities": pri}
+
+    def act_local(self, stacked_obs: np.ndarray) -> Tuple[np.ndarray, np.ndarray]:
+        """Lane-sharded inference fed from this host's local lanes."""
+        obs = jax.make_array_from_process_local_data(
+            self._lane_sh, np.ascontiguousarray(stacked_obs)
+        )
+        a, q = self._act(self.actor_params, obs, self._next_key())
+        return _local_rows(a), _local_rows(q)
+
     @property
     def step(self) -> int:
         return int(self.state.step)
@@ -196,27 +284,77 @@ def _eval_learner(cfg: Config, env, driver: "ApexDriver") -> Dict[str, Any]:
         train=False,
         state_shape=(*env.frame_shape, cfg.history_length),
     )
-    eval_agent.state = jax.device_put(driver.state, jax.devices()[0])
+    state = driver.state
+    leaf = jax.tree.leaves(state)[0]
+    if hasattr(leaf, "is_fully_addressable") and not leaf.is_fully_addressable:
+        # multi-host: the replicated global array can't be device_put
+        # directly; every leaf is locally replicated, so hop via host NumPy
+        state = jax.tree.map(np.asarray, state)
+    eval_agent.state = jax.device_put(state, jax.local_devices()[0])
     return evaluate(cfg, eval_agent, seed=cfg.seed + 977)
 
 
 def train_apex(cfg: Config, max_frames: Optional[int] = None) -> Dict[str, Any]:
-    """The full Ape-X loop on one host's slice (SURVEY §3.1 + §3.2 fused)."""
+    """The full Ape-X loop on one host's slice (SURVEY §3.1 + §3.2 fused).
+
+    Multi-host (cfg.process_count > 1): every pod host runs this SAME loop in
+    lockstep over a process-spanning mesh — each host steps its slice of the
+    env lanes, appends to its LOCAL replay shard, and contributes its local
+    sub-batch to the dp-sharded learn step; the gradient all-reduce XLA
+    inserts over ICI/DCN is the only cross-host traffic (SURVEY §2 rows 6-7:
+    the reference's remote Redis actors, re-shaped).  Requires
+    learner_devices == 0 (both roles on every chip) so the weight publish
+    stays host-local.
+    """
     total_frames = max_frames or cfg.t_max
-    lanes = cfg.num_actors * cfg.num_envs_per_actor
-    env = make_vector_env(cfg.env_id, lanes, seed=cfg.seed)
+    lanes_total = cfg.num_actors * cfg.num_envs_per_actor
+    nproc = max(cfg.process_count, 1)
+    multihost = nproc > 1
+    if multihost:
+        from rainbow_iqn_apex_tpu.parallel.multihost import HostTopology
+
+        topo = HostTopology.current()
+        if topo.process_count != nproc:
+            raise RuntimeError(
+                f"jax.distributed reports {topo.process_count} processes but "
+                f"config says {nproc}; call multihost.initialize first"
+            )
+        if cfg.learner_devices:
+            raise ValueError(
+                "multi-host apex needs learner_devices=0 (every chip plays "
+                "both roles) so the weight publish stays host-local"
+            )
+        if lanes_total % nproc or cfg.batch_size % nproc:
+            raise ValueError(
+                f"lanes ({lanes_total}) and batch_size ({cfg.batch_size}) "
+                f"must divide over {nproc} hosts"
+            )
+        lane_lo, lane_hi = topo.host_lanes(lanes_total)
+        lanes = lane_hi - lane_lo  # this host's env lanes
+        is_main = topo.process_id == 0
+        local_batch = cfg.batch_size // nproc
+    else:
+        lanes = lanes_total
+        lane_lo = 0
+        is_main = True
+        local_batch = cfg.batch_size
+
+    # per-lane seeds are carved from the GLOBAL lane space so hosts never
+    # duplicate env streams
+    env = make_vector_env(cfg.env_id, lanes, seed=cfg.seed + lane_lo)
     driver = ApexDriver(
         cfg, env.num_actions, state_shape=(*env.frame_shape, cfg.history_length)
     )
-    if lanes % driver.n_actor_devices:
+    if lanes_total % driver.n_actor_devices:
         raise ValueError(
-            f"total lanes {lanes} must divide across {driver.n_actor_devices} "
-            "actor devices"
+            f"total lanes {lanes_total} must divide across "
+            f"{driver.n_actor_devices} actor devices"
         )
 
+    shards = cfg.replay_shards // nproc if multihost else cfg.replay_shards
     memory = ShardedReplay.build(
-        cfg.replay_shards,
-        cfg.memory_capacity,
+        max(shards, 1),
+        cfg.memory_capacity // nproc,
         lanes,
         frame_shape=env.frame_shape,
         history=cfg.history_length,
@@ -224,16 +362,30 @@ def train_apex(cfg: Config, max_frames: Optional[int] = None) -> Dict[str, Any]:
         gamma=cfg.gamma,
         priority_exponent=cfg.priority_exponent,
         priority_eps=cfg.priority_eps,
-        seed=cfg.seed,
+        seed=cfg.seed + lane_lo,
         use_native=cfg.use_native_sumtree,
     )
+    learn_start = cfg.learn_start // nproc  # local transitions before learning
     import os
 
     from rainbow_iqn_apex_tpu.train import priority_beta
 
     run_dir = os.path.join(cfg.results_dir, cfg.run_id)
-    metrics = MetricsLogger(os.path.join(run_dir, "metrics.jsonl"), cfg.run_id)
+    metrics = MetricsLogger(
+        os.path.join(run_dir, "metrics.jsonl") if is_main else None,
+        cfg.run_id,
+        echo=is_main,
+    )
     ckpt = Checkpointer(os.path.join(cfg.checkpoint_dir, cfg.run_id))
+
+    frames = 0
+    last_pub = 0
+    if cfg.resume and ckpt.latest_step() is not None:
+        extra = driver.restore(ckpt)
+        frames = int(extra.get("frames", 0))
+        last_pub = driver.step
+        maybe_restore_replay(cfg, memory)
+        metrics.log("resume", step=driver.step, frames=frames)
 
     estimator = (
         ActorPriorityEstimator(lanes, cfg.multi_step, cfg.gamma)
@@ -243,16 +395,18 @@ def train_apex(cfg: Config, max_frames: Optional[int] = None) -> Dict[str, Any]:
     stacker = FrameStacker(lanes, env.frame_shape, cfg.history_length)
     obs = env.reset()
     returns: collections.deque = collections.deque(maxlen=100)
-    frames = 0
-    last_pub = 0
     prefetcher: Optional[BatchPrefetcher] = None
 
+    if multihost and cfg.pipelined_actor:
+        raise ValueError("pipelined_actor is single-host only (for now)")
     pending = None  # pipelined: device (actions, q) dispatched last tick
     held = None  # pipelined: completed transition awaiting its Q for append
     try:
         while frames < total_frames:
             stacked = stacker.push(obs)
-            if cfg.pipelined_actor:
+            if multihost:
+                actions, q = driver.act_local(stacked)
+            elif cfg.pipelined_actor:
                 # Overlap: dispatch inference for THIS obs; execute the action
                 # computed from the PREVIOUS obs (one-tick behaviour lag; the
                 # first tick primes the pipe synchronously).
@@ -287,12 +441,18 @@ def train_apex(cfg: Config, max_frames: Optional[int] = None) -> Dict[str, Any]:
                 memory.append_batch(obs, actions, rewards, terminals, pri, truncations=truncs)
             stacker.reset_lanes(cuts)
             obs = new_obs
-            frames += lanes
+            frames += lanes_total  # global frames: all hosts tick in lockstep
             for r in ep_returns[~np.isnan(ep_returns)]:
                 returns.append(float(r))
 
-            if len(memory) >= cfg.learn_start and memory.sampleable:
-                if cfg.prefetch_depth > 0 and prefetcher is None:
+            # multi-host: the learn trigger must be DETERMINISTIC and
+            # identical on every host (divergent control flow around a
+            # collective deadlocks the pod) — `len` advances in lockstep;
+            # `sampleable` is a local predicate, so it only gates
+            # single-host runs (a truly empty shard then raises, which
+            # beats a silent pod hang).
+            if len(memory) >= learn_start and (multihost or memory.sampleable):
+                if cfg.prefetch_depth > 0 and prefetcher is None and not multihost:
                     prefetcher = make_replay_prefetcher(
                         memory, cfg, lambda: priority_beta(cfg, frames)
                     )
@@ -301,8 +461,20 @@ def train_apex(cfg: Config, max_frames: Optional[int] = None) -> Dict[str, Any]:
                     if prefetcher is not None:
                         idx, batch = prefetcher.get()
                         info = driver.learn_batch(batch)
+                    elif multihost:
+                        # local sub-batch in, local priority rows out; the
+                        # global batch assembles across hosts inside, and IS
+                        # weights are re-derived globally (lockstep appends
+                        # make every host's local len identical)
+                        sample = memory.sample(local_batch, priority_beta(cfg, frames))
+                        idx = sample.idx
+                        info = driver.learn_local(
+                            sample,
+                            global_size=len(memory) * nproc,
+                            beta=priority_beta(cfg, frames),
+                        )
                     else:
-                        sample = memory.sample(cfg.batch_size, priority_beta(cfg, frames))
+                        sample = memory.sample(local_batch, priority_beta(cfg, frames))
                         idx = sample.idx
                         info = driver.learn(sample)
                     memory.update_priorities(idx, np.asarray(info["priorities"]))
@@ -321,25 +493,42 @@ def train_apex(cfg: Config, max_frames: Optional[int] = None) -> Dict[str, Any]:
                             mean_return=float(np.mean(returns)) if returns else float("nan"),
                             staleness=step - last_pub,
                         )
-                    if cfg.eval_interval and step % cfg.eval_interval == 0:
+                    if is_main and cfg.eval_interval and step % cfg.eval_interval == 0:
                         metrics.log(
                             "eval", step=step, **_eval_learner(cfg, env, driver)
                         )
                     if cfg.checkpoint_interval and step % cfg.checkpoint_interval == 0:
-                        ckpt.save(step, driver.state, {"frames": frames})
+                        # every host calls save — Orbax treats it as a
+                        # collective under jax.distributed (primary host
+                        # writes, the rest join its barrier); a p0-only call
+                        # would hang the pod at the next sync point
+                        ckpt.save(step, _host_state(driver, multihost),
+                                  {"frames": frames})
+                        save_replay_snapshot(cfg, memory)  # per-host shard
 
     finally:
         if prefetcher is not None:
             prefetcher.close()
-    final_eval = _eval_learner(cfg, env, driver)
-    metrics.log("eval", step=driver.step, **final_eval)
-    ckpt.save(driver.step, driver.state, {"frames": frames})
+    final_eval = _eval_learner(cfg, env, driver) if is_main else {}
+    if is_main:
+        metrics.log("eval", step=driver.step, **final_eval)
+    ckpt.save(driver.step, _host_state(driver, multihost), {"frames": frames})
+    save_replay_snapshot(cfg, memory)
     ckpt.wait()
     metrics.close()
     return {
         "frames": frames,
         "learn_steps": driver.step,
-        "lanes": lanes,
+        "lanes": lanes_total,
         "train_return_mean": float(np.mean(returns)) if returns else float("nan"),
         **{f"eval_{k}": v for k, v in final_eval.items()},
     }
+
+
+def _host_state(driver: "ApexDriver", multihost: bool):
+    """State tree for checkpointing: in multi-host mode pull the (fully
+    replicated) leaves to host NumPy so the save is process-local — Orbax
+    must not be asked to gather non-addressable shards."""
+    if not multihost:
+        return driver.state
+    return jax.tree.map(np.asarray, driver.state)

@@ -17,10 +17,12 @@ topology but swaps the transport:
   weight mailbox over TCP         params already replicated by the mesh
 
 `initialize()` wraps jax.distributed.initialize; `host_lanes`/`host_shard`
-carve the global lane/shard space by process index so apex.train_apex can be
-driven per host with purely local replay.  This module is exercised on a
-single host (process_count == 1) in CI; multi-host execution needs a real
-multi-host slice, which this sandbox does not provide (SURVEY.md §7).
+carve the global lane/shard space by process index.  apex.train_apex runs
+this topology end-to-end when cfg.process_count > 1 (every host executes the
+same loop; see docs/RUNBOOK.md "Multi-host Ape-X").  CI exercises it with
+two REAL processes over a CPU Gloo fabric (tests/test_multihost.py): learn
+numerics are asserted identical to a single-process run, and a toy train
+runs end-to-end.  Real pods swap the fabric for ICI/DCN with no code change.
 """
 
 from __future__ import annotations

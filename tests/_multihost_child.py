@@ -1,0 +1,105 @@
+"""Child program for the 2-process jax.distributed tests (test_multihost.py).
+
+Run as:  python _multihost_child.py <mode> <process_id> <port>
+Modes:
+  learn  — 3 dp-sharded learn steps fed from this host's local half of a
+           FIXED global batch; process 0 prints a JSON line with the losses,
+           local priorities and a param checksum (compared against a
+           single-process run of the same batch by the parent test).
+  train  — short end-to-end multi-host train_apex on toy:catch; process 0
+           prints the summary JSON line.
+"""
+
+import json
+import sys
+
+import numpy as np
+
+
+def fixed_global_batch(cfg, num_actions, B):
+    from rainbow_iqn_apex_tpu.replay.buffer import SampledBatch
+
+    rng = np.random.default_rng(0)
+    return SampledBatch(
+        idx=np.arange(B),
+        obs=rng.integers(0, 255, (B, *cfg.state_shape), dtype=np.uint8),
+        action=rng.integers(0, num_actions, B).astype(np.int32),
+        reward=rng.normal(size=B).astype(np.float32),
+        next_obs=rng.integers(0, 255, (B, *cfg.state_shape), dtype=np.uint8),
+        discount=np.full(B, 0.9, np.float32),
+        weight=np.ones(B, np.float32),
+        # non-uniform so the global IS-weight renormalization is exercised
+        prob=(rng.random(B) + 0.1).astype(np.float64),
+    )
+
+
+def slice_batch(s, lo, hi):
+    import dataclasses
+
+    return dataclasses.replace(
+        s, **{f.name: getattr(s, f.name)[lo:hi] for f in dataclasses.fields(s)}
+    )
+
+
+def main():
+    mode, pid, port = sys.argv[1], int(sys.argv[2]), sys.argv[3]
+    import jax
+
+    jax.distributed.initialize(
+        f"127.0.0.1:{port}", num_processes=2, process_id=pid
+    )
+    from rainbow_iqn_apex_tpu.config import Config
+
+    if mode == "learn":
+        from rainbow_iqn_apex_tpu.parallel.apex import ApexDriver
+
+        cfg = Config(
+            compute_dtype="float32", frame_height=44, frame_width=44,
+            history_length=2, hidden_size=32, num_cosines=8,
+            num_tau_samples=4, num_tau_prime_samples=4,
+            num_quantile_samples=2, batch_size=8, learner_devices=0,
+            process_count=2, process_id=pid,
+        )
+        A, B = 4, cfg.batch_size
+        driver = ApexDriver(cfg, A)
+        full = fixed_global_batch(cfg, A, B)
+        local = slice_batch(full, pid * (B // 2), (pid + 1) * (B // 2))
+        losses, pris = [], None
+        for _ in range(3):
+            info = driver.learn_local(local, global_size=100, beta=0.6)
+            losses.append(float(info["loss"]))
+            pris = np.asarray(info["priorities"])
+        checksum = float(
+            sum(float(np.abs(np.asarray(p)).sum())
+                for p in jax.tree.leaves(driver.state.params))
+        )
+        if pid == 0:
+            print(json.dumps({
+                "losses": losses,
+                "local_priorities": pris.tolist(),
+                "checksum": checksum,
+            }))
+    elif mode == "train":
+        from rainbow_iqn_apex_tpu.parallel.apex import train_apex
+
+        cfg = Config(
+            env_id="toy:catch", compute_dtype="float32",
+            frame_height=80, frame_width=80, history_length=2,
+            hidden_size=32, num_cosines=8, num_tau_samples=4,
+            num_tau_prime_samples=4, num_quantile_samples=2,
+            batch_size=16, learner_devices=0, num_actors=1,
+            num_envs_per_actor=8, learn_start=256, replay_ratio=8,
+            memory_capacity=4096, metrics_interval=50,
+            checkpoint_interval=0, eval_interval=0, eval_episodes=2,
+            prefetch_depth=0, process_count=2, process_id=pid,
+            results_dir=sys.argv[4], checkpoint_dir=sys.argv[4] + "/ckpt",
+        )
+        summary = train_apex(cfg, max_frames=800)
+        if pid == 0:
+            print(json.dumps(summary))
+    else:
+        raise SystemExit(f"unknown mode {mode}")
+
+
+if __name__ == "__main__":
+    main()

@@ -221,6 +221,49 @@ def test_pipelined_actor_short_run(tmp_path):
     assert np.isfinite(summary["eval_score_mean"])
 
 
+def test_apex_kill_and_resume(tmp_path):
+    """Kill-and-resume: a second train_apex run with resume=True continues
+    the step/frame counters exactly from the last checkpoint and restores
+    the replay snapshot (SURVEY §5 checkpoint/resume; the reference resumes
+    from torch.save weights + Redis-persisted replay)."""
+    import json
+
+    cfg = CFG.replace(
+        env_id="toy:catch",
+        frame_height=80,
+        frame_width=80,
+        learn_start=256,
+        replay_ratio=8,
+        memory_capacity=4096,
+        metrics_interval=50,
+        checkpoint_interval=20,
+        eval_interval=0,
+        eval_episodes=2,
+        resume=True,
+        snapshot_replay=True,
+        results_dir=str(tmp_path / "results"),
+        checkpoint_dir=str(tmp_path / "ckpt"),
+    )
+    first = train_apex(cfg, max_frames=1_000)
+    assert first["learn_steps"] > 0
+
+    second = train_apex(cfg, max_frames=2_000)
+    # counters continue exactly: the resumed run adds exactly the extra frames
+    assert second["frames"] == 2_000
+    assert second["learn_steps"] > first["learn_steps"]
+    # the metrics log records the resume point at the first run's final state
+    rows = [
+        json.loads(line)
+        for line in open(tmp_path / "results" / cfg.run_id / "metrics.jsonl")
+    ]
+    resumes = [r for r in rows if r.get("kind") == "resume"]
+    assert resumes, "no resume row logged"
+    assert resumes[-1]["step"] == first["learn_steps"]
+    assert resumes[-1]["frames"] == first["frames"]
+    # replay snapshot shards were written next to the Orbax dir
+    assert (tmp_path / "ckpt" / (cfg.run_id + "_replay")).exists()
+
+
 @pytest.mark.slow
 def test_apex_end_to_end_short(tmp_path):
     cfg = CFG.replace(

@@ -11,7 +11,10 @@ Roles (--role):
 The reference selects learner/actor roles per *process* and couples them
 through Redis; here the coupling is XLA collectives + host shared memory, so
 both roles live in one SPMD program (SURVEY.md §5 "Distributed communication
-backend" mapping).
+backend" mapping).  Pod scale: run the same `--role apex` command on every
+host with `--process-count N --process-id i --coordinator-address host0:port`
+(docs/RUNBOOK.md "Multi-host Ape-X") — jax.distributed replaces the
+reference's remote-actor Redis fabric.
 """
 
 import json
@@ -22,6 +25,15 @@ from rainbow_iqn_apex_tpu.config import parse_config
 
 def main(argv=None) -> int:
     cfg = parse_config(argv)
+    if cfg.process_count > 1:
+        # Pod mode: every host runs this same program (--process-id differs);
+        # jax.distributed couples them the way Redis coupled the reference's
+        # remote actor processes. Must run BEFORE any jax backend touch.
+        from rainbow_iqn_apex_tpu.parallel.multihost import initialize
+
+        initialize(
+            cfg.coordinator_address or None, cfg.process_count, cfg.process_id
+        )
     if cfg.architecture not in ("iqn", "r2d2"):
         print(
             f"unknown --architecture '{cfg.architecture}' (want 'iqn' or 'r2d2')",
