@@ -62,12 +62,12 @@ from rainbow_iqn_apex_tpu.utils.checkpoint import (
 from rainbow_iqn_apex_tpu.utils.logging import MetricsLogger
 
 
-def _local_rows(arr: jax.Array) -> np.ndarray:
-    """This process's rows of a leading-axis-sharded global array, in global
-    row order (= the order of the local data this process contributed via
-    ``make_array_from_process_local_data``)."""
-    shards = sorted(arr.addressable_shards, key=lambda s: s.index[0].start or 0)
-    return np.concatenate([np.asarray(s.data) for s in shards])
+from rainbow_iqn_apex_tpu.parallel.multihost import (  # noqa: E402
+    global_is_nq,
+    host_state,
+    local_rows as _local_rows,
+    make_global_is_weights,
+)
 
 
 class ActorPriorityEstimator:
@@ -158,14 +158,9 @@ class ApexDriver:
                 lambda p: jax.tree.map(lambda x: x.astype(jnp.float32), p),
                 out_shardings=rep_a,
             )
-        # multi-host: (N q)^-beta max-normalized over the GLOBAL batch
-        self._global_is_weights = jax.jit(
-            lambda q, n, beta: (lambda w: (w / w.max()).astype(jnp.float32))(
-                (n * jnp.maximum(q, 1e-12)) ** (-beta)
-            ),
-            in_shardings=(self._batch_sh, None, None),
-            out_shardings=self._batch_sh,
-        )
+        # multi-host: global IS-weight renormalization (shared helper so the
+        # two apex drivers can't drift)
+        self._global_is_weights = make_global_is_weights(self._batch_sh)
         self.actor_params = None
         self.publish_weights()  # initial broadcast
 
@@ -239,11 +234,8 @@ class ApexDriver:
             self._batch_sh, np.ascontiguousarray(x, dt)
         )
         if global_size is not None and sample.prob is not None:
-            nproc = jax.process_count()
-            q = put(np.asarray(sample.prob) / nproc, np.float32)
-            weight = self._global_is_weights(
-                q, jnp.float32(global_size), jnp.float32(beta)
-            )
+            nq = put(global_is_nq(sample.prob, global_size), np.float32)
+            weight = self._global_is_weights(nq, jnp.float32(beta))
         else:
             weight = put(sample.weight, np.float32)
         batch = Batch(
@@ -284,13 +276,7 @@ def _eval_learner(cfg: Config, env, driver: "ApexDriver") -> Dict[str, Any]:
         train=False,
         state_shape=(*env.frame_shape, cfg.history_length),
     )
-    state = driver.state
-    leaf = jax.tree.leaves(state)[0]
-    if hasattr(leaf, "is_fully_addressable") and not leaf.is_fully_addressable:
-        # multi-host: the replicated global array can't be device_put
-        # directly; every leaf is locally replicated, so hop via host NumPy
-        state = jax.tree.map(np.asarray, state)
-    eval_agent.state = jax.device_put(state, jax.local_devices()[0])
+    eval_agent.state = jax.device_put(host_state(driver.state), jax.local_devices()[0])
     return evaluate(cfg, eval_agent, seed=cfg.seed + 977)
 
 
@@ -399,6 +385,13 @@ def train_apex(cfg: Config, max_frames: Optional[int] = None) -> Dict[str, Any]:
 
     if multihost and cfg.pipelined_actor:
         raise ValueError("pipelined_actor is single-host only (for now)")
+    # multi-host learn trigger: DETERMINISTIC and identical on every host
+    # (divergent control flow around a collective deadlocks the pod).  It
+    # therefore counts only fresh post-(re)start frames — len(memory) can
+    # diverge across hosts when a resume restores replay on some hosts but
+    # degrades to cold on one (torn snapshot) — at the cost of re-warming
+    # for learn_start frames after every resume.
+    frames_at_start = frames
     pending = None  # pipelined: device (actions, q) dispatched last tick
     held = None  # pipelined: completed transition awaiting its Q for append
     try:
@@ -445,13 +438,12 @@ def train_apex(cfg: Config, max_frames: Optional[int] = None) -> Dict[str, Any]:
             for r in ep_returns[~np.isnan(ep_returns)]:
                 returns.append(float(r))
 
-            # multi-host: the learn trigger must be DETERMINISTIC and
-            # identical on every host (divergent control flow around a
-            # collective deadlocks the pod) — `len` advances in lockstep;
-            # `sampleable` is a local predicate, so it only gates
-            # single-host runs (a truly empty shard then raises, which
-            # beats a silent pod hang).
-            if len(memory) >= learn_start and (multihost or memory.sampleable):
+            warm = (
+                frames - frames_at_start >= cfg.learn_start
+                if multihost
+                else len(memory) >= learn_start and memory.sampleable
+            )
+            if warm:
                 if cfg.prefetch_depth > 0 and prefetcher is None and not multihost:
                     prefetcher = make_replay_prefetcher(
                         memory, cfg, lambda: priority_beta(cfg, frames)
@@ -502,7 +494,7 @@ def train_apex(cfg: Config, max_frames: Optional[int] = None) -> Dict[str, Any]:
                         # collective under jax.distributed (primary host
                         # writes, the rest join its barrier); a p0-only call
                         # would hang the pod at the next sync point
-                        ckpt.save(step, _host_state(driver, multihost),
+                        ckpt.save(step, host_state(driver.state),
                                   {"frames": frames})
                         save_replay_snapshot(cfg, memory)  # per-host shard
 
@@ -512,7 +504,7 @@ def train_apex(cfg: Config, max_frames: Optional[int] = None) -> Dict[str, Any]:
     final_eval = _eval_learner(cfg, env, driver) if is_main else {}
     if is_main:
         metrics.log("eval", step=driver.step, **final_eval)
-    ckpt.save(driver.step, _host_state(driver, multihost), {"frames": frames})
+    ckpt.save(driver.step, host_state(driver.state), {"frames": frames})
     save_replay_snapshot(cfg, memory)
     ckpt.wait()
     metrics.close()
@@ -524,11 +516,3 @@ def train_apex(cfg: Config, max_frames: Optional[int] = None) -> Dict[str, Any]:
         **{f"eval_{k}": v for k, v in final_eval.items()},
     }
 
-
-def _host_state(driver: "ApexDriver", multihost: bool):
-    """State tree for checkpointing: in multi-host mode pull the (fully
-    replicated) leaves to host NumPy so the save is process-local — Orbax
-    must not be asked to gather non-addressable shards."""
-    if not multihost:
-        return driver.state
-    return jax.tree.map(np.asarray, driver.state)

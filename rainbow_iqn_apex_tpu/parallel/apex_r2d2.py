@@ -43,6 +43,12 @@ from rainbow_iqn_apex_tpu.parallel.mesh import (
     replicated,
     split_devices,
 )
+from rainbow_iqn_apex_tpu.parallel.multihost import (
+    global_is_nq,
+    host_state,
+    local_rows as _local_rows,
+    make_global_is_weights,
+)
 from rainbow_iqn_apex_tpu.replay.sequence import SequenceReplay, SequenceSample
 from rainbow_iqn_apex_tpu.train import priority_beta
 from rainbow_iqn_apex_tpu.utils.checkpoint import (
@@ -74,6 +80,12 @@ class R2D2ApexDriver:
             )
         rep_l, rep_a = replicated(self.lmesh), replicated(self.amesh)
         lane_sh = batch_sharding(self.amesh, "actor")
+        self._multihost = jax.process_count() > 1
+        if self._multihost and cfg.learner_devices:
+            raise ValueError(
+                "multi-host R2D2 apex needs learner_devices=0 (every chip "
+                "plays both roles) so the weight publish stays host-local"
+            )
 
         self.key = jax.random.PRNGKey(cfg.seed)
         self.key, k_init = jax.random.split(self.key)
@@ -81,11 +93,16 @@ class R2D2ApexDriver:
             init_r2d2_state(cfg, num_actions, k_init, frame_shape), rep_l
         )
 
+        self._batch_sh = batch_sharding(self.lmesh, "dp")
         self._learn = jax.jit(
             build_r2d2_learn_step(cfg, num_actions),
-            in_shardings=(rep_l, batch_sharding(self.lmesh, "dp"), rep_l),
+            in_shardings=(rep_l, self._batch_sh, rep_l),
             donate_argnums=0,
         )
+        # multi-host: global IS-weight renormalization (shared helper —
+        # sequence counts are not lockstep across hosts, so each row's N is
+        # its own host's estimate, folded into nq per row)
+        self._global_is_weights = make_global_is_weights(self._batch_sh)
         # act: obs + (c, h) lane-sharded; params replicated on the actor mesh
         self._act = jax.jit(
             build_r2d2_act_step(cfg, num_actions, use_noise=True),
@@ -109,12 +126,14 @@ class R2D2ApexDriver:
         self._rep_a = rep_a
         self._lane_sh = lane_sh
         self.actor_params = None
-        self.lstm_state = jax.device_put(
-            (
-                jnp.zeros((lanes, cfg.lstm_size), jnp.float32),
-                jnp.zeros((lanes, cfg.lstm_size), jnp.float32),
-            ),
-            lane_sh,  # applied to both (c, h) leaves
+        # lanes is the GLOBAL lane count; each host materialises only its
+        # local rows (make_array == device_put when single-process)
+        local_zeros = np.zeros(
+            (lanes // jax.process_count(), cfg.lstm_size), np.float32
+        )
+        self.lstm_state = (
+            jax.make_array_from_process_local_data(lane_sh, local_zeros),
+            jax.make_array_from_process_local_data(lane_sh, local_zeros),
         )
         self.publish_weights()
 
@@ -139,10 +158,24 @@ class R2D2ApexDriver:
         return extra
 
     def act(self, obs: np.ndarray) -> Tuple[np.ndarray, Tuple[np.ndarray, np.ndarray]]:
-        """obs [L, H, W] u8 (history 1) or [L, H, W, hist] stacked ->
-        (actions [L], pre-step host state (c, h)).
+        """obs [L_local, H, W] u8 (history 1) or [L_local, H, W, hist]
+        stacked -> (actions [L_local], pre-step host state (c, h)).
 
-        The pre-step state snapshot is what the sequence replay stores."""
+        The pre-step state snapshot is what the sequence replay stores.
+        Multi-host: this host feeds/reads only its local lane rows; the
+        carried LSTM state stays device-resident and lane-sharded over the
+        global actor mesh."""
+        if self._multihost:
+            pre_c = _local_rows(self.lstm_state[0])
+            pre_h = _local_rows(self.lstm_state[1])
+            x = jax.make_array_from_process_local_data(
+                self._lane_sh,
+                np.asarray(as_actor_input(obs, self.cfg.history_length)),
+            )
+            a, _q, self.lstm_state = self._act(
+                self.actor_params, x, self.lstm_state, self._next_key()
+            )
+            return _local_rows(a), (pre_c, pre_h)
         pre_c = np.asarray(self.lstm_state[0])
         pre_h = np.asarray(self.lstm_state[1])
         x = as_actor_input(obs, self.cfg.history_length)
@@ -152,12 +185,41 @@ class R2D2ApexDriver:
         return np.asarray(a), (pre_c, pre_h)
 
     def reset_lanes(self, cuts: np.ndarray) -> None:
-        keep = jnp.asarray(1.0 - cuts.astype(np.float32))
+        keep_np = (1.0 - cuts.astype(np.float32))
+        if self._multihost:
+            keep = jax.make_array_from_process_local_data(self._lane_sh, keep_np)
+        else:
+            keep = jnp.asarray(keep_np)
         self.lstm_state = self._mask_state(self.lstm_state, keep)
 
     def learn_batch(self, batch: SequenceBatch) -> Dict[str, Any]:
         self.state, info = self._learn(self.state, batch, self._next_key())
         return info
+
+    def learn_local(
+        self, sample, global_size: int, beta: float
+    ) -> Dict[str, Any]:
+        """Sequence learn step fed from this host's local sub-batch; IS
+        weights re-derived over the assembled GLOBAL batch exactly as in
+        ApexDriver.learn_local (fixed per-host quota => uniform host
+        mixture: q(i) = prob_local(i) / n_hosts)."""
+        put = lambda x, dt: jax.make_array_from_process_local_data(  # noqa: E731
+            self._batch_sh, np.ascontiguousarray(x, dt)
+        )
+        nq = put(global_is_nq(sample.prob, global_size), np.float32)
+        weight = self._global_is_weights(nq, jnp.float32(beta))
+        batch = SequenceBatch(
+            obs=put(sample.obs, np.uint8),
+            action=put(sample.action, np.int32),
+            reward=put(sample.reward, np.float32),
+            done=put(sample.done, bool),
+            valid=put(sample.valid, bool),
+            init_c=put(sample.init_c, np.float32),
+            init_h=put(sample.init_h, np.float32),
+            weight=weight,
+        )
+        info = self.learn_batch(batch)
+        return {**info, "priorities": _local_rows(info["priorities"])}
 
     @property
     def step(self) -> int:
@@ -172,19 +234,53 @@ def _eval_r2d2_learner(cfg: Config, env, driver: "R2D2ApexDriver") -> Dict[str, 
         cfg, env.num_actions, env.frame_shape, jax.random.PRNGKey(cfg.seed + 1),
         train=False,
     )
-    eval_agent.state = jax.device_put(driver.state, jax.devices()[0])
+    eval_agent.state = jax.device_put(host_state(driver.state), jax.local_devices()[0])
     return evaluate_r2d2(cfg, eval_agent, seed=cfg.seed + 977)
 
 
 def train_apex_r2d2(cfg: Config, max_frames: Optional[int] = None) -> Dict[str, Any]:
-    total_frames = max_frames or cfg.t_max
-    lanes = cfg.num_actors * cfg.num_envs_per_actor
-    env = make_vector_env(cfg.env_id, lanes, seed=cfg.seed)
-    driver = R2D2ApexDriver(cfg, env.num_actions, env.frame_shape, lanes)
+    """Mesh-parallel R2D2 Ape-X; multi-host exactly like apex.train_apex
+    (same SPMD shape: local lanes/replay/sub-batches, global collectives).
 
+    One recurrent-specific wrinkle: sequence EMISSION times depend on
+    episode ends, so ``len(memory)`` is NOT lockstep-deterministic across
+    hosts — the multi-host learn trigger therefore uses only the global
+    frame counter (after enough ticks every lane has emitted at least one
+    full window deterministically)."""
+    total_frames = max_frames or cfg.t_max
+    lanes_total = cfg.num_actors * cfg.num_envs_per_actor
+    nproc = max(cfg.process_count, 1)
+    multihost = nproc > 1
     seq_total = cfg.r2d2_burn_in + cfg.r2d2_seq_len
+    if multihost:
+        from rainbow_iqn_apex_tpu.parallel.multihost import HostTopology
+
+        topo = HostTopology.current()
+        if topo.process_count != nproc:
+            raise RuntimeError(
+                f"jax.distributed reports {topo.process_count} processes but "
+                f"config says {nproc}; call multihost.initialize first"
+            )
+        if lanes_total % nproc or cfg.batch_size % nproc:
+            raise ValueError(
+                f"lanes ({lanes_total}) and batch_size ({cfg.batch_size}) "
+                f"must divide over {nproc} hosts"
+            )
+        lane_lo, lane_hi = topo.host_lanes(lanes_total)
+        lanes = lane_hi - lane_lo
+        is_main = topo.process_id == 0
+        local_batch = cfg.batch_size // nproc
+    else:
+        lanes = lanes_total
+        lane_lo = 0
+        is_main = True
+        local_batch = cfg.batch_size
+
+    env = make_vector_env(cfg.env_id, lanes, seed=cfg.seed + lane_lo)
+    driver = R2D2ApexDriver(cfg, env.num_actions, env.frame_shape, lanes_total)
+
     memory = SequenceReplay(
-        capacity=max(cfg.memory_capacity // seq_total, 64),
+        capacity=max(cfg.memory_capacity // (seq_total * nproc), 64),
         seq_len=seq_total,
         frame_shape=env.frame_shape,
         lstm_size=cfg.lstm_size,
@@ -192,10 +288,14 @@ def train_apex_r2d2(cfg: Config, max_frames: Optional[int] = None) -> Dict[str, 
         stride=max(seq_total - cfg.r2d2_overlap, 1),
         priority_exponent=cfg.priority_exponent,
         priority_eps=cfg.priority_eps,
-        seed=cfg.seed,
+        seed=cfg.seed + lane_lo,
     )
     run_dir = os.path.join(cfg.results_dir, cfg.run_id)
-    metrics = MetricsLogger(os.path.join(run_dir, "metrics.jsonl"), cfg.run_id)
+    metrics = MetricsLogger(
+        os.path.join(run_dir, "metrics.jsonl") if is_main else None,
+        cfg.run_id,
+        echo=is_main,
+    )
     ckpt = Checkpointer(os.path.join(cfg.checkpoint_dir, cfg.run_id))
 
     frames = 0
@@ -211,8 +311,14 @@ def train_apex_r2d2(cfg: Config, max_frames: Optional[int] = None) -> Dict[str, 
     stacker = FrameStacker(lanes, env.frame_shape, cfg.history_length)
     returns: collections.deque = collections.deque(maxlen=100)
     prefetcher: Optional[BatchPrefetcher] = None
-    learn_start_seqs = max(cfg.learn_start // seq_total, 8)
+    learn_start_seqs = max(cfg.learn_start // (seq_total * nproc), 8)
     frames_per_step = cfg.replay_ratio * cfg.r2d2_seq_len
+    # multi-host learn trigger: frames-only (lockstep-deterministic), and
+    # counted from THIS (re)start so a resume with a cold/torn replay
+    # snapshot re-warms instead of sampling an empty buffer; by this many
+    # fresh global frames every lane has emitted >= 1 full window
+    frames_warm = max(cfg.learn_start, (seq_total + 1) * lanes_total)
+    frames_at_start = frames
 
     try:
         while frames < total_frames:
@@ -225,12 +331,17 @@ def train_apex_r2d2(cfg: Config, max_frames: Optional[int] = None) -> Dict[str, 
             driver.reset_lanes(cuts)
             stacker.reset_lanes(cuts)
             obs = new_obs
-            frames += lanes
+            frames += lanes_total  # global frames: hosts tick in lockstep
             for r in ep_returns[~np.isnan(ep_returns)]:
                 returns.append(float(r))
 
-            if len(memory) >= learn_start_seqs:
-                if cfg.prefetch_depth > 0 and prefetcher is None:
+            warm = (
+                frames - frames_at_start >= frames_warm
+                if multihost
+                else len(memory) >= learn_start_seqs
+            )
+            if warm:
+                if cfg.prefetch_depth > 0 and prefetcher is None and not multihost:
                     prefetcher = BatchPrefetcher(
                         lambda: (
                             (s := memory.sample(
@@ -245,10 +356,19 @@ def train_apex_r2d2(cfg: Config, max_frames: Optional[int] = None) -> Dict[str, 
                 for _ in range(max(steps_due, 0)):
                     if prefetcher is not None:
                         idx, batch = prefetcher.get()
+                        info = driver.learn_batch(batch)
+                    elif multihost:
+                        s = memory.sample(local_batch, priority_beta(cfg, frames))
+                        idx = s.idx
+                        info = driver.learn_local(
+                            s,
+                            global_size=len(memory) * nproc,
+                            beta=priority_beta(cfg, frames),
+                        )
                     else:
-                        s = memory.sample(cfg.batch_size, priority_beta(cfg, frames))
+                        s = memory.sample(local_batch, priority_beta(cfg, frames))
                         idx, batch = s.idx, to_device_seq_batch(s)
-                    info = driver.learn_batch(batch)
+                        info = driver.learn_batch(batch)
                     memory.update_priorities(idx, np.asarray(info["priorities"]))
                     step = driver.step
                     if step - last_pub >= cfg.weight_publish_interval:
@@ -266,27 +386,31 @@ def train_apex_r2d2(cfg: Config, max_frames: Optional[int] = None) -> Dict[str, 
                             sequences=len(memory),
                             staleness=step - last_pub,
                         )
-                    if cfg.eval_interval and step % cfg.eval_interval == 0:
+                    if is_main and cfg.eval_interval and step % cfg.eval_interval == 0:
                         metrics.log(
                             "eval", step=step, **_eval_r2d2_learner(cfg, env, driver)
                         )
                     if cfg.checkpoint_interval and step % cfg.checkpoint_interval == 0:
-                        ckpt.save(step, driver.state, {"frames": frames})
+                        # collective under jax.distributed: every host joins,
+                        # the primary writes (a p0-only call would hang)
+                        ckpt.save(step, host_state(driver.state),
+                                  {"frames": frames})
                         save_replay_snapshot(cfg, memory)
     finally:
         if prefetcher is not None:
             prefetcher.close()
 
-    final_eval = _eval_r2d2_learner(cfg, env, driver)
-    metrics.log("eval", step=driver.step, **final_eval)
-    ckpt.save(driver.step, driver.state, {"frames": frames})
+    final_eval = _eval_r2d2_learner(cfg, env, driver) if is_main else {}
+    if is_main:
+        metrics.log("eval", step=driver.step, **final_eval)
+    ckpt.save(driver.step, host_state(driver.state), {"frames": frames})
     save_replay_snapshot(cfg, memory)
     ckpt.wait()
     metrics.close()
     return {
         "frames": frames,
         "learn_steps": driver.step,
-        "lanes": lanes,
+        "lanes": lanes_total,
         "sequences": len(memory),
         "train_return_mean": float(np.mean(returns)) if returns else float("nan"),
         **{f"eval_{k}": v for k, v in final_eval.items()},

@@ -31,6 +31,8 @@ import dataclasses
 from typing import Optional, Tuple
 
 import jax
+import jax.numpy as jnp
+import numpy as np
 
 
 def initialize(
@@ -88,3 +90,46 @@ class HostTopology:
                 f"{num_shards} shards do not divide over {self.process_count} hosts"
             )
         return self.process_id * (num_shards // self.process_count)
+
+
+# --------------------------------------------------------- shared SPMD helpers
+# Used by BOTH apex drivers (feedforward and recurrent) so the multi-host
+# semantics can never drift between them.
+def local_rows(arr: jax.Array) -> np.ndarray:
+    """This process's rows of a leading-axis-sharded global array, in global
+    row order (= the order of the local data this process contributed via
+    ``make_array_from_process_local_data``)."""
+    shards = sorted(arr.addressable_shards, key=lambda s: s.index[0].start or 0)
+    return np.concatenate([np.asarray(s.data) for s in shards])
+
+
+def host_state(tree):
+    """A checkpoint-safe view of a (replicated) train-state tree: multi-host
+    global arrays are pulled to host NumPy (every process holds a replica)
+    so Orbax is never asked to gather non-addressable shards; anything fully
+    addressable passes through untouched."""
+    leaf = jax.tree.leaves(tree)[0]
+    if hasattr(leaf, "is_fully_addressable") and not leaf.is_fully_addressable:
+        return jax.tree.map(np.asarray, tree)
+    return tree
+
+
+def make_global_is_weights(batch_sh):
+    """jit: w = (N q)^-beta max-normalized over the GLOBAL dp-sharded batch
+    (the cross-host max is one tiny collective).  The N*q product arrives
+    pre-multiplied per row — see ``global_is_nq`` — so no host-varying
+    scalar is ever passed as a replicated operand."""
+    return jax.jit(
+        lambda nq, beta: (lambda w: (w / w.max()).astype(jnp.float32))(
+            jnp.maximum(nq, 1e-12) ** (-beta)
+        ),
+        in_shardings=(batch_sh, None),
+        out_shardings=batch_sh,
+    )
+
+
+def global_is_nq(prob: np.ndarray, global_size: float) -> np.ndarray:
+    """Per-row N*q for ``make_global_is_weights``: the fixed per-host batch
+    quota makes the sampling scheme a uniform mixture over hosts, so the
+    global sample probability of a local row is prob_local / n_hosts."""
+    return global_size * np.asarray(prob) / jax.process_count()

@@ -47,6 +47,8 @@ class SequenceSample:
     init_c: np.ndarray  # [B, lstm] f32
     init_h: np.ndarray  # [B, lstm] f32
     weight: np.ndarray  # [B] f32
+    prob: np.ndarray = None  # [B] f64 — local sample probability (for the
+    # multi-host global IS-weight derivation, mirroring SampledBatch.prob)
 
 
 class SequenceReplay:
@@ -215,6 +217,7 @@ class SequenceReplay:
             init_c=self.init_c[idx],
             init_h=self.init_h[idx],
             weight=weights,
+            prob=prob,
         )
 
     def update_priorities(self, idx: np.ndarray, td_mix: np.ndarray) -> None:

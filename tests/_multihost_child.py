@@ -79,6 +79,67 @@ def main():
                 "local_priorities": pris.tolist(),
                 "checksum": checksum,
             }))
+    elif mode == "r2d2-learn":
+        import jax as _jax
+
+        from rainbow_iqn_apex_tpu.ops.r2d2 import SequenceBatch  # noqa: F401
+        from rainbow_iqn_apex_tpu.parallel.apex_r2d2 import R2D2ApexDriver
+        from rainbow_iqn_apex_tpu.replay.sequence import SequenceSample
+
+        cfg = Config(
+            compute_dtype="float32", history_length=1, hidden_size=32,
+            lstm_size=32, r2d2_burn_in=2, r2d2_seq_len=6, r2d2_overlap=2,
+            multi_step=2, gamma=0.9, batch_size=8, learner_devices=0,
+            process_count=2, process_id=pid,
+        )
+        A, B, FRAME = 3, cfg.batch_size, (44, 44)
+        L = cfg.r2d2_burn_in + cfg.r2d2_seq_len
+        driver = R2D2ApexDriver(cfg, A, FRAME, lanes=8)
+        rng = np.random.default_rng(0)
+        full = SequenceSample(
+            idx=np.arange(B),
+            obs=rng.integers(0, 255, (B, L, *FRAME, 1), dtype=np.uint8),
+            action=rng.integers(0, A, (B, L)).astype(np.int32),
+            reward=rng.normal(size=(B, L)).astype(np.float32),
+            done=np.zeros((B, L), bool),
+            valid=np.ones((B, L), bool),
+            init_c=np.zeros((B, 32), np.float32),
+            init_h=np.zeros((B, 32), np.float32),
+            weight=np.ones(B, np.float32),
+            prob=(rng.random(B) + 0.1).astype(np.float64),
+        )
+        local = slice_batch(full, pid * (B // 2), (pid + 1) * (B // 2))
+        losses, pris = [], None
+        for _ in range(3):
+            info = driver.learn_local(local, global_size=50, beta=0.6)
+            losses.append(float(info["loss"]))
+            pris = np.asarray(info["priorities"])
+        checksum = float(
+            sum(float(np.abs(np.asarray(p)).sum())
+                for p in _jax.tree.leaves(driver.state.params))
+        )
+        if pid == 0:
+            print(json.dumps({
+                "losses": losses,
+                "local_priorities": pris.tolist(),
+                "checksum": checksum,
+            }))
+    elif mode == "r2d2-train":
+        from rainbow_iqn_apex_tpu.parallel.apex_r2d2 import train_apex_r2d2
+
+        cfg = Config(
+            env_id="toy:catch", compute_dtype="float32", history_length=1,
+            hidden_size=32, lstm_size=32, r2d2_burn_in=2, r2d2_seq_len=6,
+            r2d2_overlap=2, multi_step=2, batch_size=16, learner_devices=0,
+            num_actors=1, num_envs_per_actor=8, learn_start=256,
+            replay_ratio=4, memory_capacity=8192, metrics_interval=20,
+            checkpoint_interval=0, eval_interval=0, eval_episodes=2,
+            prefetch_depth=0, process_count=2, process_id=pid,
+            results_dir=sys.argv[4], checkpoint_dir=sys.argv[4] + "/ckpt",
+        )
+        summary = train_apex_r2d2(cfg, max_frames=800)
+        if pid == 0:
+            print(json.dumps(summary))
     elif mode == "train":
         from rainbow_iqn_apex_tpu.parallel.apex import train_apex
 

@@ -29,28 +29,52 @@ def _free_port() -> int:
 
 
 def _spawn_pair(mode: str, *extra: str, timeout: float = 420.0):
+    """Run the child program as 2 coupled jax.distributed processes.
+
+    Children write to temp FILES, not pipes — a chatty child blocked on a
+    full pipe buffer would stall the shared collective and hang both.  On
+    timeout BOTH children are killed (a wedged pair must not leak past the
+    test holding its port)."""
+    import tempfile
+
     port = str(_free_port())
     env = dict(os.environ)
     env.pop("PALLAS_AXON_POOL_IPS", None)
     env["JAX_PLATFORMS"] = "cpu"
     env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
     env["PYTHONPATH"] = _REPO + os.pathsep + env.get("PYTHONPATH", "")
-    procs = [
-        subprocess.Popen(
-            [sys.executable, _CHILD, mode, str(pid), port, *extra],
-            env=env, stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
-        )
-        for pid in (0, 1)
-    ]
-    outs = [p.communicate(timeout=timeout) for p in procs]
-    for p, (out, err) in zip(procs, outs):
-        assert p.returncode == 0, f"child rc={p.returncode}\n{out}\n{err}"
-    for line in reversed(outs[0][0].strip().splitlines()):
+    with tempfile.TemporaryDirectory() as td:
+        files = [open(os.path.join(td, f"out{pid}.log"), "w+") for pid in (0, 1)]
+        procs = [
+            subprocess.Popen(
+                [sys.executable, _CHILD, mode, str(pid), port, *extra],
+                env=env, stdout=files[pid], stderr=subprocess.STDOUT, text=True,
+            )
+            for pid in (0, 1)
+        ]
+        try:
+            deadline = __import__("time").monotonic() + timeout
+            for p in procs:
+                p.wait(timeout=max(deadline - __import__("time").monotonic(), 1))
+        except subprocess.TimeoutExpired:
+            for p in procs:
+                p.kill()
+            for p in procs:
+                p.wait()
+            raise
+        outs = []
+        for f in files:
+            f.seek(0)
+            outs.append(f.read())
+            f.close()
+    for p, out in zip(procs, outs):
+        assert p.returncode == 0, f"child rc={p.returncode}\n{out[-4000:]}"
+    for line in reversed(outs[0].strip().splitlines()):
         try:
             return json.loads(line)
         except (ValueError, json.JSONDecodeError):
             continue
-    raise AssertionError(f"no JSON from process 0:\n{outs[0][0]}\n{outs[0][1]}")
+    raise AssertionError(f"no JSON from process 0:\n{outs[0][-4000:]}")
 
 
 def test_two_process_learn_matches_single_process():
@@ -98,9 +122,76 @@ def test_two_process_learn_matches_single_process():
     np.testing.assert_allclose(result["checksum"], checksum, rtol=1e-5)
 
 
+def test_two_process_r2d2_learn_matches_single_process():
+    """The recurrent learn step under the same 2-process topology: losses,
+    local priority rows and the param checksum must match a single-process
+    run of the same global sequence batch."""
+    result = _spawn_pair("r2d2-learn")
+
+    import dataclasses
+
+    import jax
+
+    from rainbow_iqn_apex_tpu.config import Config
+    from rainbow_iqn_apex_tpu.ops.r2d2 import to_device_seq_batch
+    from rainbow_iqn_apex_tpu.parallel.apex_r2d2 import R2D2ApexDriver
+    from tests._multihost_child import main as _  # noqa: F401 (import check)
+    from rainbow_iqn_apex_tpu.replay.sequence import SequenceSample  # noqa: F401
+
+    cfg = Config(
+        compute_dtype="float32", history_length=1, hidden_size=32,
+        lstm_size=32, r2d2_burn_in=2, r2d2_seq_len=6, r2d2_overlap=2,
+        multi_step=2, gamma=0.9, batch_size=8, learner_devices=0,
+    )
+    A, B, FRAME = 3, cfg.batch_size, (44, 44)
+    L = cfg.r2d2_burn_in + cfg.r2d2_seq_len
+    driver = R2D2ApexDriver(cfg, A, FRAME, lanes=8)
+    rng = np.random.default_rng(0)
+    full = SequenceSample(
+        idx=np.arange(B),
+        obs=rng.integers(0, 255, (B, L, *FRAME, 1), dtype=np.uint8),
+        action=rng.integers(0, A, (B, L)).astype(np.int32),
+        reward=rng.normal(size=(B, L)).astype(np.float32),
+        done=np.zeros((B, L), bool),
+        valid=np.ones((B, L), bool),
+        init_c=np.zeros((B, 32), np.float32),
+        init_h=np.zeros((B, 32), np.float32),
+        weight=np.ones(B, np.float32),
+        prob=(rng.random(B) + 0.1).astype(np.float64),
+    )
+    # the multi-host global IS-weight derivation, replicated exactly
+    q = np.asarray(full.prob) / 2
+    w = (50 * np.maximum(q, 1e-12)) ** (-0.6)
+    full = dataclasses.replace(full, weight=(w / w.max()).astype(np.float32))
+    losses, pri = [], None
+    for _ in range(3):
+        info = driver.learn_batch(to_device_seq_batch(full))
+        losses.append(float(info["loss"]))
+        pri = np.asarray(info["priorities"])
+
+    np.testing.assert_allclose(result["losses"], losses, rtol=2e-4, atol=2e-5)
+    np.testing.assert_allclose(
+        result["local_priorities"], pri[: B // 2], rtol=2e-3, atol=2e-4
+    )
+    checksum = float(
+        sum(float(np.abs(np.asarray(p)).sum())
+            for p in jax.tree.leaves(driver.state.params))
+    )
+    np.testing.assert_allclose(result["checksum"], checksum, rtol=1e-5)
+
+
 @pytest.mark.slow
 def test_two_process_train_apex_end_to_end(tmp_path):
     summary = _spawn_pair("train", str(tmp_path))
+    assert summary["frames"] == 800
+    assert summary["learn_steps"] > 0
+    assert summary["lanes"] == 8
+    assert np.isfinite(summary["eval_score_mean"])
+
+
+@pytest.mark.slow
+def test_two_process_r2d2_train_end_to_end(tmp_path):
+    summary = _spawn_pair("r2d2-train", str(tmp_path))
     assert summary["frames"] == 800
     assert summary["learn_steps"] > 0
     assert summary["lanes"] == 8
