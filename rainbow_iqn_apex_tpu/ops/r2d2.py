@@ -288,11 +288,16 @@ def build_r2d2_learn_step(
     return learn_step
 
 
-def as_actor_input(obs, history: int) -> jnp.ndarray:
+def as_actor_input(obs, history: int):
     """Normalise actor observations to [B, H, W, C] and enforce that C
     matches the training channel count (the host FrameStacker supplies the
-    stack when history > 1)."""
-    x = jnp.asarray(obs)
+    stack when history > 1).  Stays host NumPy — the caller decides how the
+    array reaches the device (jit argument upload, or
+    make_array_from_process_local_data on a multi-host mesh) so no extra
+    host->device->host round trip sneaks into the actor tick."""
+    import numpy as np
+
+    x = np.asarray(obs)
     if x.ndim == 3:
         x = x[..., None]
     if x.shape[-1] != history:

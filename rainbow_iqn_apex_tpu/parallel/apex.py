@@ -67,6 +67,7 @@ from rainbow_iqn_apex_tpu.parallel.multihost import (  # noqa: E402
     host_state,
     local_rows as _local_rows,
     make_global_is_weights,
+    plan_hosts,
 )
 
 
@@ -294,36 +295,10 @@ def train_apex(cfg: Config, max_frames: Optional[int] = None) -> Dict[str, Any]:
     """
     total_frames = max_frames or cfg.t_max
     lanes_total = cfg.num_actors * cfg.num_envs_per_actor
-    nproc = max(cfg.process_count, 1)
-    multihost = nproc > 1
-    if multihost:
-        from rainbow_iqn_apex_tpu.parallel.multihost import HostTopology
-
-        topo = HostTopology.current()
-        if topo.process_count != nproc:
-            raise RuntimeError(
-                f"jax.distributed reports {topo.process_count} processes but "
-                f"config says {nproc}; call multihost.initialize first"
-            )
-        if cfg.learner_devices:
-            raise ValueError(
-                "multi-host apex needs learner_devices=0 (every chip plays "
-                "both roles) so the weight publish stays host-local"
-            )
-        if lanes_total % nproc or cfg.batch_size % nproc:
-            raise ValueError(
-                f"lanes ({lanes_total}) and batch_size ({cfg.batch_size}) "
-                f"must divide over {nproc} hosts"
-            )
-        lane_lo, lane_hi = topo.host_lanes(lanes_total)
-        lanes = lane_hi - lane_lo  # this host's env lanes
-        is_main = topo.process_id == 0
-        local_batch = cfg.batch_size // nproc
-    else:
-        lanes = lanes_total
-        lane_lo = 0
-        is_main = True
-        local_batch = cfg.batch_size
+    plan = plan_hosts(cfg, lanes_total)
+    multihost, nproc = plan.multihost, plan.nproc
+    lanes, lane_lo = plan.lanes, plan.lane_lo
+    is_main, local_batch = plan.is_main, plan.local_batch
 
     # per-lane seeds are carved from the GLOBAL lane space so hosts never
     # duplicate env streams
@@ -444,27 +419,44 @@ def train_apex(cfg: Config, max_frames: Optional[int] = None) -> Dict[str, Any]:
                 else len(memory) >= learn_start and memory.sampleable
             )
             if warm:
-                if cfg.prefetch_depth > 0 and prefetcher is None and not multihost:
-                    prefetcher = make_replay_prefetcher(
-                        memory, cfg, lambda: priority_beta(cfg, frames)
-                    )
+                if cfg.prefetch_depth > 0 and prefetcher is None:
+                    if multihost:
+                        # overlap the host-side local sample/assembly with
+                        # the device step; the collective-bearing
+                        # learn_local stays on the main thread
+                        prefetcher = BatchPrefetcher(
+                            lambda: (
+                                (s := memory.sample(
+                                    local_batch, priority_beta(cfg, frames)
+                                )).idx,
+                                s,
+                            ),
+                            depth=cfg.prefetch_depth,
+                            device_put=False,
+                        )
+                    else:
+                        prefetcher = make_replay_prefetcher(
+                            memory, cfg, lambda: priority_beta(cfg, frames)
+                        )
                 steps_due = frames // cfg.replay_ratio - driver.step
                 for _ in range(max(steps_due, 0)):
-                    if prefetcher is not None:
-                        idx, batch = prefetcher.get()
-                        info = driver.learn_batch(batch)
-                    elif multihost:
+                    if multihost:
                         # local sub-batch in, local priority rows out; the
                         # global batch assembles across hosts inside, and IS
-                        # weights are re-derived globally (lockstep appends
-                        # make every host's local len identical)
-                        sample = memory.sample(local_batch, priority_beta(cfg, frames))
-                        idx = sample.idx
+                        # weights are re-derived globally
+                        if prefetcher is not None:
+                            idx, sample = prefetcher.get()
+                        else:
+                            sample = memory.sample(local_batch, priority_beta(cfg, frames))
+                            idx = sample.idx
                         info = driver.learn_local(
                             sample,
                             global_size=len(memory) * nproc,
                             beta=priority_beta(cfg, frames),
                         )
+                    elif prefetcher is not None:
+                        idx, batch = prefetcher.get()
+                        info = driver.learn_batch(batch)
                     else:
                         sample = memory.sample(local_batch, priority_beta(cfg, frames))
                         idx = sample.idx

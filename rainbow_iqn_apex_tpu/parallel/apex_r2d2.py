@@ -48,6 +48,7 @@ from rainbow_iqn_apex_tpu.parallel.multihost import (
     host_state,
     local_rows as _local_rows,
     make_global_is_weights,
+    plan_hosts,
 )
 from rainbow_iqn_apex_tpu.replay.sequence import SequenceReplay, SequenceSample
 from rainbow_iqn_apex_tpu.train import priority_beta
@@ -170,7 +171,7 @@ class R2D2ApexDriver:
             pre_h = _local_rows(self.lstm_state[1])
             x = jax.make_array_from_process_local_data(
                 self._lane_sh,
-                np.asarray(as_actor_input(obs, self.cfg.history_length)),
+                np.ascontiguousarray(as_actor_input(obs, self.cfg.history_length)),
             )
             a, _q, self.lstm_state = self._act(
                 self.actor_params, x, self.lstm_state, self._next_key()
@@ -249,32 +250,11 @@ def train_apex_r2d2(cfg: Config, max_frames: Optional[int] = None) -> Dict[str, 
     full window deterministically)."""
     total_frames = max_frames or cfg.t_max
     lanes_total = cfg.num_actors * cfg.num_envs_per_actor
-    nproc = max(cfg.process_count, 1)
-    multihost = nproc > 1
     seq_total = cfg.r2d2_burn_in + cfg.r2d2_seq_len
-    if multihost:
-        from rainbow_iqn_apex_tpu.parallel.multihost import HostTopology
-
-        topo = HostTopology.current()
-        if topo.process_count != nproc:
-            raise RuntimeError(
-                f"jax.distributed reports {topo.process_count} processes but "
-                f"config says {nproc}; call multihost.initialize first"
-            )
-        if lanes_total % nproc or cfg.batch_size % nproc:
-            raise ValueError(
-                f"lanes ({lanes_total}) and batch_size ({cfg.batch_size}) "
-                f"must divide over {nproc} hosts"
-            )
-        lane_lo, lane_hi = topo.host_lanes(lanes_total)
-        lanes = lane_hi - lane_lo
-        is_main = topo.process_id == 0
-        local_batch = cfg.batch_size // nproc
-    else:
-        lanes = lanes_total
-        lane_lo = 0
-        is_main = True
-        local_batch = cfg.batch_size
+    plan = plan_hosts(cfg, lanes_total)
+    multihost, nproc = plan.multihost, plan.nproc
+    lanes, lane_lo = plan.lanes, plan.lane_lo
+    is_main, local_batch = plan.is_main, plan.local_batch
 
     env = make_vector_env(cfg.env_id, lanes, seed=cfg.seed + lane_lo)
     driver = R2D2ApexDriver(cfg, env.num_actions, env.frame_shape, lanes_total)
@@ -311,7 +291,7 @@ def train_apex_r2d2(cfg: Config, max_frames: Optional[int] = None) -> Dict[str, 
     stacker = FrameStacker(lanes, env.frame_shape, cfg.history_length)
     returns: collections.deque = collections.deque(maxlen=100)
     prefetcher: Optional[BatchPrefetcher] = None
-    learn_start_seqs = max(cfg.learn_start // (seq_total * nproc), 8)
+    learn_start_seqs = max(cfg.learn_start // seq_total, 8)  # single-host gate
     frames_per_step = cfg.replay_ratio * cfg.r2d2_seq_len
     # multi-host learn trigger: frames-only (lockstep-deterministic), and
     # counted from THIS (re)start so a resume with a cold/torn replay
@@ -341,30 +321,47 @@ def train_apex_r2d2(cfg: Config, max_frames: Optional[int] = None) -> Dict[str, 
                 else len(memory) >= learn_start_seqs
             )
             if warm:
-                if cfg.prefetch_depth > 0 and prefetcher is None and not multihost:
-                    prefetcher = BatchPrefetcher(
-                        lambda: (
-                            (s := memory.sample(
-                                cfg.batch_size, priority_beta(cfg, frames)
-                            )).idx,
-                            to_device_seq_batch(s),
-                        ),
-                        depth=cfg.prefetch_depth,
-                        device_put=False,
-                    )
+                if cfg.prefetch_depth > 0 and prefetcher is None:
+                    if multihost:
+                        # host-side local sample only; the collective-bearing
+                        # learn_local stays on the main thread
+                        prefetcher = BatchPrefetcher(
+                            lambda: (
+                                (s := memory.sample(
+                                    local_batch, priority_beta(cfg, frames)
+                                )).idx,
+                                s,
+                            ),
+                            depth=cfg.prefetch_depth,
+                            device_put=False,
+                        )
+                    else:
+                        prefetcher = BatchPrefetcher(
+                            lambda: (
+                                (s := memory.sample(
+                                    cfg.batch_size, priority_beta(cfg, frames)
+                                )).idx,
+                                to_device_seq_batch(s),
+                            ),
+                            depth=cfg.prefetch_depth,
+                            device_put=False,
+                        )
                 steps_due = frames // frames_per_step - driver.step
                 for _ in range(max(steps_due, 0)):
-                    if prefetcher is not None:
-                        idx, batch = prefetcher.get()
-                        info = driver.learn_batch(batch)
-                    elif multihost:
-                        s = memory.sample(local_batch, priority_beta(cfg, frames))
-                        idx = s.idx
+                    if multihost:
+                        if prefetcher is not None:
+                            idx, s = prefetcher.get()
+                        else:
+                            s = memory.sample(local_batch, priority_beta(cfg, frames))
+                            idx = s.idx
                         info = driver.learn_local(
                             s,
                             global_size=len(memory) * nproc,
                             beta=priority_beta(cfg, frames),
                         )
+                    elif prefetcher is not None:
+                        idx, batch = prefetcher.get()
+                        info = driver.learn_batch(batch)
                     else:
                         s = memory.sample(local_batch, priority_beta(cfg, frames))
                         idx, batch = s.idx, to_device_seq_batch(s)

@@ -92,6 +92,52 @@ class HostTopology:
         return self.process_id * (num_shards // self.process_count)
 
 
+@dataclasses.dataclass(frozen=True)
+class HostPlan:
+    """One host's carve of an apex run (shared by both apex trainers)."""
+
+    multihost: bool
+    nproc: int
+    lanes: int  # this host's env lanes
+    lane_lo: int  # global index of this host's first lane (seed offset)
+    is_main: bool  # process 0: metrics/eval owner
+    local_batch: int  # rows this host feeds into the dp-sharded learn step
+
+
+def plan_hosts(cfg, lanes_total: int) -> HostPlan:
+    """Validate the multi-host topology and carve this host's share.
+
+    Single-process configs pass through untouched.  Multi-host requires
+    jax.distributed to be initialized (process counts must agree),
+    learner_devices == 0 (every chip plays both roles so the weight publish
+    stays host-local), and lanes/batch divisible over the hosts.
+    """
+    nproc = max(cfg.process_count, 1)
+    if nproc == 1:
+        return HostPlan(False, 1, lanes_total, 0, True, cfg.batch_size)
+    topo = HostTopology.current()
+    if topo.process_count != nproc:
+        raise RuntimeError(
+            f"jax.distributed reports {topo.process_count} processes but "
+            f"config says {nproc}; call multihost.initialize first"
+        )
+    if cfg.learner_devices:
+        raise ValueError(
+            "multi-host apex needs learner_devices=0 (every chip plays "
+            "both roles) so the weight publish stays host-local"
+        )
+    if lanes_total % nproc or cfg.batch_size % nproc:
+        raise ValueError(
+            f"lanes ({lanes_total}) and batch_size ({cfg.batch_size}) "
+            f"must divide over {nproc} hosts"
+        )
+    lane_lo, lane_hi = topo.host_lanes(lanes_total)
+    return HostPlan(
+        True, nproc, lane_hi - lane_lo, lane_lo,
+        topo.process_id == 0, cfg.batch_size // nproc,
+    )
+
+
 # --------------------------------------------------------- shared SPMD helpers
 # Used by BOTH apex drivers (feedforward and recurrent) so the multi-host
 # semantics can never drift between them.

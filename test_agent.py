@@ -18,13 +18,23 @@ import os
 def main(argv=None) -> int:
     cfg = parse_config(argv)
     env = make_env(cfg.env_id, seed=cfg.seed)
-    agent = Agent(
-        cfg,
-        env.num_actions,
-        jax.random.PRNGKey(cfg.seed),
-        train=False,
-        state_shape=(*env.frame_shape, cfg.history_length),
-    )
+    if cfg.architecture == "r2d2":
+        from rainbow_iqn_apex_tpu.train_r2d2 import R2D2Agent, evaluate_r2d2
+
+        agent = R2D2Agent(
+            cfg, env.num_actions, env.frame_shape,
+            jax.random.PRNGKey(cfg.seed), train=False,
+        )
+        eval_fn = lambda: evaluate_r2d2(cfg, agent, seed=cfg.seed + 977)  # noqa: E731
+    else:
+        agent = Agent(
+            cfg,
+            env.num_actions,
+            jax.random.PRNGKey(cfg.seed),
+            train=False,
+            state_shape=(*env.frame_shape, cfg.history_length),
+        )
+        eval_fn = lambda: evaluate(cfg, agent, seed=cfg.seed + 977)  # noqa: E731
 
     ckpt_dir = os.path.join(cfg.checkpoint_dir, cfg.run_id)
     ckpt = Checkpointer(ckpt_dir)
@@ -33,7 +43,7 @@ def main(argv=None) -> int:
     else:
         print(f"warning: no checkpoint in {ckpt_dir}; evaluating a fresh net")
 
-    out = evaluate(cfg, agent, seed=cfg.seed + 977)
+    out = eval_fn()
     out["checkpoint_step"] = ckpt.latest_step()
     print(json.dumps(out))
     return 0

@@ -82,7 +82,6 @@ def main():
     elif mode == "r2d2-learn":
         import jax as _jax
 
-        from rainbow_iqn_apex_tpu.ops.r2d2 import SequenceBatch  # noqa: F401
         from rainbow_iqn_apex_tpu.parallel.apex_r2d2 import R2D2ApexDriver
         from rainbow_iqn_apex_tpu.replay.sequence import SequenceSample
 
@@ -134,7 +133,7 @@ def main():
             num_actors=1, num_envs_per_actor=8, learn_start=256,
             replay_ratio=4, memory_capacity=8192, metrics_interval=20,
             checkpoint_interval=0, eval_interval=0, eval_episodes=2,
-            prefetch_depth=0, process_count=2, process_id=pid,
+            prefetch_depth=2, process_count=2, process_id=pid,
             results_dir=sys.argv[4], checkpoint_dir=sys.argv[4] + "/ckpt",
         )
         summary = train_apex_r2d2(cfg, max_frames=800)
@@ -152,7 +151,7 @@ def main():
             num_envs_per_actor=8, learn_start=256, replay_ratio=8,
             memory_capacity=4096, metrics_interval=50,
             checkpoint_interval=0, eval_interval=0, eval_episodes=2,
-            prefetch_depth=0, process_count=2, process_id=pid,
+            prefetch_depth=2, process_count=2, process_id=pid,
             results_dir=sys.argv[4], checkpoint_dir=sys.argv[4] + "/ckpt",
         )
         summary = train_apex(cfg, max_frames=800)

@@ -81,3 +81,56 @@ def test_short_run_checkpoint_resume(tmp_path):
     state, extra = ckpt.restore(agent.state)
     assert int(state.step) == s1["learn_steps"]
     assert extra["frames"] == s1["frames"]
+
+
+def test_eval_cli_roundtrips_both_architectures(tmp_path, capsys):
+    """test_agent.py (the reference's eval entry point) must load and
+    evaluate checkpoints from BOTH model families."""
+    import json
+
+    import test_agent as eval_cli
+    from rainbow_iqn_apex_tpu.train_r2d2 import train_r2d2
+
+    # IQN: short train writes a checkpoint; the eval CLI loads it
+    cfg = _cfg(tmp_path, learn_start=128, eval_episodes=2)
+    s1 = train(cfg, max_frames=600)
+    argv = [
+        "--env-id", "toy:catch", "--compute-dtype", "float32",
+        "--frame-height", "80", "--frame-width", "80",
+        "--history-length", "2", "--hidden-size", "128",
+        "--num-cosines", "32", "--num-tau-samples", "8",
+        "--num-tau-prime-samples", "8", "--num-quantile-samples", "8",
+        "--eval-episodes", "2", "--seed", "7",
+        "--checkpoint-dir", str(tmp_path / "ckpt"),
+        "--results-dir", str(tmp_path / "results"),
+    ]
+    assert eval_cli.main(argv) == 0
+    out = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert out["checkpoint_step"] == s1["learn_steps"]
+    assert np.isfinite(out["score_mean"])
+
+    # R2D2: same round-trip through the recurrent family
+    rcfg = Config(
+        env_id="toy:catch", architecture="r2d2", compute_dtype="float32",
+        history_length=1, hidden_size=32, lstm_size=32, r2d2_burn_in=2,
+        r2d2_seq_len=6, r2d2_overlap=2, multi_step=2, batch_size=8,
+        learn_start=256, memory_capacity=4096, num_envs_per_actor=4,
+        eval_interval=0, checkpoint_interval=0, eval_episodes=2,
+        metrics_interval=50, run_id="r2",
+        results_dir=str(tmp_path / "results"),
+        checkpoint_dir=str(tmp_path / "ckpt"), seed=7,
+    )
+    s2 = train_r2d2(rcfg, max_frames=1_200)
+    argv_r = [
+        "--env-id", "toy:catch", "--architecture", "r2d2",
+        "--compute-dtype", "float32", "--history-length", "1",
+        "--hidden-size", "32", "--lstm-size", "32", "--r2d2-burn-in", "2",
+        "--r2d2-seq-len", "6", "--r2d2-overlap", "2", "--multi-step", "2",
+        "--eval-episodes", "2", "--seed", "7", "--run-id", "r2",
+        "--checkpoint-dir", str(tmp_path / "ckpt"),
+        "--results-dir", str(tmp_path / "results"),
+    ]
+    assert eval_cli.main(argv_r) == 0
+    out_r = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert out_r["checkpoint_step"] == s2["learn_steps"]
+    assert np.isfinite(out_r["score_mean"])
