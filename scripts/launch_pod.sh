@@ -1,0 +1,33 @@
+#!/usr/bin/env bash
+# Multi-host Ape-X launch (parity: the reference's multi-machine story is
+# "start redis-server(s), point remote actor processes at them" — SURVEY.md
+# §2 rows 6-7. Here every pod host runs the SAME SPMD command and
+# jax.distributed is the fabric; see docs/RUNBOOK.md "Multi-host Ape-X").
+#
+# Usage, once per host (same command, different HOST_INDEX):
+#   HOST_INDEX=0 HOST_COUNT=4 COORDINATOR=host0:12355 \
+#     scripts/launch_pod.sh Pong run0 [extra flags...]
+#
+# On TPU pods launched through the pod runtime, COORDINATOR/HOST_* can be
+# omitted and jax.distributed infers them; this script targets manual
+# clusters (the direct heir of the reference's redis host/port flags).
+set -euo pipefail
+
+GAME="${1:-Pong}"
+RUN_ID="${2:-pod_$(date +%s)}"
+: "${HOST_INDEX:?set HOST_INDEX (this host's id in [0, HOST_COUNT))}"
+: "${HOST_COUNT:?set HOST_COUNT (number of pod hosts)}"
+: "${COORDINATOR:?set COORDINATOR (host0:port of process 0)}"
+
+exec python train_agent_apex.py \
+  --role apex \
+  --env-id "atari:${GAME}" \
+  --run-id "${RUN_ID}" \
+  --process-count "${HOST_COUNT}" \
+  --process-id "${HOST_INDEX}" \
+  --coordinator-address "${COORDINATOR}" \
+  --learner-devices 0 \
+  --num-actors 4 --num-envs-per-actor 16 \
+  --replay-shards "${HOST_COUNT}" \
+  --t-max 200000000 \
+  "${@:3}"
