@@ -1,0 +1,90 @@
+#!/usr/bin/env python
+"""On-chip Pallas quantile-Huber tuning harness (VERDICT r1 item 7).
+
+Runs the FULL learn step at the reference Atari shape with the jnp loss
+vs the Pallas kernel across BLOCK_B candidates, and prints one JSON line
+per configuration.  Designed to be turnkey the moment a real TPU is
+reachable:
+
+    python scripts/bench_pallas.py            # device as-is (axon/TPU)
+    BENCH_ITERS=50 python scripts/bench_pallas.py
+
+On CPU the kernel runs in interpret mode (orders of magnitude slow) —
+the script detects that, trims iterations, and labels the rows so nobody
+mistakes them for a TPU result.  Keep the winner only if it beats the
+jnp path; record both numbers in docs/STATUS.md.
+"""
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main() -> None:
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from rainbow_iqn_apex_tpu.config import Config
+    from rainbow_iqn_apex_tpu.ops.pallas import quantile_huber as qh
+    from rainbow_iqn_apex_tpu.ops.learn import Batch, build_learn_step, init_train_state
+
+    platform = jax.devices()[0].platform
+    # same gate ops/learn.py uses to pick interpret mode — anything else
+    # (cpu, gpu) runs the kernel INTERPRETED and must be trimmed + labelled
+    compiled = jax.default_backend() in ("tpu", "axon")
+    iters = int(os.environ.get("BENCH_ITERS", "100" if compiled else "3"))
+    num_actions = 18
+    rng = np.random.default_rng(0)
+
+    def run(use_pallas: bool, block_b: int) -> dict:
+        qh.BLOCK_B = block_b
+        cfg = Config(use_pallas_loss=use_pallas)
+        state = init_train_state(cfg, num_actions, jax.random.PRNGKey(0))
+        learn = jax.jit(build_learn_step(cfg, num_actions), donate_argnums=0)
+        b = cfg.batch_size
+        batch = Batch(
+            obs=jnp.asarray(rng.integers(0, 255, (b, *cfg.state_shape), dtype=np.uint8)),
+            action=jnp.asarray(rng.integers(0, num_actions, b).astype(np.int32)),
+            reward=jnp.asarray(rng.normal(size=b).astype(np.float32)),
+            next_obs=jnp.asarray(rng.integers(0, 255, (b, *cfg.state_shape), dtype=np.uint8)),
+            discount=jnp.full((b,), 0.99**3, jnp.float32),
+            weight=jnp.ones((b,), jnp.float32),
+        )
+        key = jax.random.PRNGKey(1)
+        for _ in range(2):  # compile + warm
+            key, k = jax.random.split(key)
+            state, info = learn(state, batch, k)
+        jax.block_until_ready(info["loss"])
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            key, k = jax.random.split(key)
+            state, info = learn(state, batch, k)
+        jax.block_until_ready(info["loss"])
+        dt = time.perf_counter() - t0
+        return {
+            "loss_impl": "pallas" if use_pallas else "jnp",
+            "block_b": block_b if use_pallas else None,
+            "steps_per_sec": round(iters / dt, 2),
+            "platform": platform + ("" if compiled else " (interpret-mode pallas)"),
+        }
+
+    rows = [run(False, 0)]
+    for bb in (4, 8, 16, 32):
+        try:
+            rows.append(run(True, bb))
+        except Exception as e:  # a bad BLOCK_B must not kill the sweep
+            rows.append({"loss_impl": "pallas", "block_b": bb,
+                         "error": f"{type(e).__name__}: {e}"[:200]})
+    for r in rows:
+        print(json.dumps(r))
+    ok = [r for r in rows if "steps_per_sec" in r]
+    best = max(ok, key=lambda r: r["steps_per_sec"])
+    print(json.dumps({"winner": best}), file=sys.stderr)
+
+
+if __name__ == "__main__":
+    main()

@@ -14,8 +14,11 @@
 set -euo pipefail
 
 GAME="${1:-Pong}"
-RUN_ID="${2:-pod_$(date +%s)}"
-: "${HOST_INDEX:?set HOST_INDEX (this host's id in [0, HOST_COUNT))}"
+# RUN_ID must be IDENTICAL on every host (Orbax saves are collective over a
+# shared checkpoint dir), so a per-host timestamp default would tear the
+# checkpoint — it is required, like the topology vars.
+RUN_ID="${2:?pass a run id (same value on every host)}"
+: "${HOST_INDEX:?set HOST_INDEX (this hosts id in [0, HOST_COUNT))}"
 : "${HOST_COUNT:?set HOST_COUNT (number of pod hosts)}"
 : "${COORDINATOR:?set COORDINATOR (host0:port of process 0)}"
 
