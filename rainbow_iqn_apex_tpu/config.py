@@ -95,6 +95,10 @@ class Config:
     num_envs_per_actor: int = 16  # batched vector-env width per actor loop
     weight_publish_interval: int = 400  # learner steps between weight publishes
     weight_poll_interval: int = 400  # actor frames between weight pulls
+    device_frame_stack: bool = True  # apex actors: keep the frame stack on
+    # device (ship one [L,H,W] frame/tick, shift+reset inside the jitted act
+    # step) instead of host-side FrameStacker shifting — 4x less transfer
+    # and no strided host copy; bit-identical stacks (tested)
     pipelined_actor: bool = False  # overlap device inference with env stepping
     # (one-tick action lag: the action executed at tick t was computed from
     # the observation at t-1 — Podracer/SEED-style; replay stores the action

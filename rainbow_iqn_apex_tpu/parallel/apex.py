@@ -146,11 +146,33 @@ class ApexDriver:
         # actor step: lanes split over the actor mesh, params replicated.
         lane_sh = batch_sharding(self.amesh, "actor")
         self._lane_sh = lane_sh
+        act_fn = build_act_step(cfg, num_actions, use_noise=True)
         self._act = jax.jit(
-            build_act_step(cfg, num_actions, use_noise=True),
+            act_fn,
             in_shardings=(rep_a, lane_sh, rep_a),
             out_shardings=(lane_sh, lane_sh),
         )
+
+        # device-resident frame stacking: the stack never leaves the actor
+        # mesh; the host ships ONE [L, H, W] frame per tick and lanes cut
+        # last tick are zeroed in-graph before the shift — bit-identical to
+        # the host FrameStacker (tests/test_parallel.py), 4x less transfer,
+        # and none of the strided host shifting that was the measured host
+        # bottleneck (~14k frames/s on the build sandbox vs ~130k replay
+        # append).
+        def stack_act(params, stack, frame, keep, key):
+            stack = stack * keep[:, None, None, None].astype(stack.dtype)
+            stack = jnp.concatenate([stack[..., 1:], frame[..., None]], axis=-1)
+            a, q = act_fn(params, stack, key)
+            return a, q, stack
+
+        self._stack_act = jax.jit(
+            stack_act,
+            in_shardings=(rep_a, lane_sh, lane_sh, lane_sh, rep_a),
+            out_shardings=(lane_sh, lane_sh, lane_sh),
+            donate_argnums=1,
+        )
+        self.actor_stack = None  # created lazily at the first act_frames
         if cfg.bf16_weight_sync:
             self._cast = jax.jit(
                 lambda p: jax.tree.map(lambda x: x.astype(jnp.bfloat16), p)
@@ -196,6 +218,36 @@ class ApexDriver:
 
     def act(self, stacked_obs: np.ndarray) -> Tuple[np.ndarray, np.ndarray]:
         a, q = self.act_async(stacked_obs)
+        return np.asarray(a), np.asarray(q)
+
+    def _put_lanes(self, x: np.ndarray):
+        """Host array -> lane-sharded device array (single- or multi-host)."""
+        return jax.make_array_from_process_local_data(
+            self._lane_sh, np.ascontiguousarray(x)
+        )
+
+    def act_frames(
+        self, frames: np.ndarray, prev_cuts: np.ndarray
+    ) -> Tuple[np.ndarray, np.ndarray]:
+        """Device-stacked acting: push this host's newest [L_local, H, W]
+        frames into the device-resident stack (zeroing lanes whose episode
+        was cut LAST tick, matching FrameStacker.reset_lanes ordering) and
+        act on the result."""
+        if self.actor_stack is None:
+            h, w = frames.shape[1], frames.shape[2]
+            self.actor_stack = self._put_lanes(
+                np.zeros((frames.shape[0], h, w, self.cfg.history_length), np.uint8)
+            )
+        keep = self._put_lanes((~np.asarray(prev_cuts, bool)).astype(np.uint8))
+        a, q, self.actor_stack = self._stack_act(
+            self.actor_params,
+            self.actor_stack,
+            self._put_lanes(np.asarray(frames, np.uint8)),
+            keep,
+            self._next_key(),
+        )
+        if jax.process_count() > 1:
+            return _local_rows(a), _local_rows(q)
         return np.asarray(a), np.asarray(q)
 
     def learn(self, sample) -> Dict[str, Any]:
@@ -353,7 +405,6 @@ def train_apex(cfg: Config, max_frames: Optional[int] = None) -> Dict[str, Any]:
         if cfg.initial_priority_from_actor
         else None
     )
-    stacker = FrameStacker(lanes, env.frame_shape, cfg.history_length)
     obs = env.reset()
     returns: collections.deque = collections.deque(maxlen=100)
     prefetcher: Optional[BatchPrefetcher] = None
@@ -367,23 +418,35 @@ def train_apex(cfg: Config, max_frames: Optional[int] = None) -> Dict[str, Any]:
     # degrades to cold on one (torn snapshot) — at the cost of re-warming
     # for learn_start frames after every resume.
     frames_at_start = frames
+    # device-resident stacking replaces the host FrameStacker on the actor
+    # path (pipelined mode keeps the host stacker: its one-tick-lag pipe
+    # would need a second in-flight device stack)
+    use_dstack = cfg.device_frame_stack and not cfg.pipelined_actor
+    stacker = None if use_dstack else FrameStacker(
+        lanes, env.frame_shape, cfg.history_length
+    )
+    prev_cuts = np.zeros(lanes, bool)
     pending = None  # pipelined: device (actions, q) dispatched last tick
     held = None  # pipelined: completed transition awaiting its Q for append
     try:
         while frames < total_frames:
-            stacked = stacker.push(obs)
-            if multihost:
-                actions, q = driver.act_local(stacked)
-            elif cfg.pipelined_actor:
-                # Overlap: dispatch inference for THIS obs; execute the action
-                # computed from the PREVIOUS obs (one-tick behaviour lag; the
-                # first tick primes the pipe synchronously).
-                nxt = driver.act_async(stacked)
-                if pending is None:
-                    pending = nxt
-                actions = np.asarray(pending[0])
+            if use_dstack:
+                actions, q = driver.act_frames(obs, prev_cuts)
             else:
-                actions, q = driver.act(stacked)
+                stacked = stacker.push(obs)
+                if multihost:
+                    actions, q = driver.act_local(stacked)
+                elif cfg.pipelined_actor:
+                    # Overlap: dispatch inference for THIS obs; execute the
+                    # action computed from the PREVIOUS obs (one-tick
+                    # behaviour lag; the first tick primes the pipe
+                    # synchronously).
+                    nxt = driver.act_async(stacked)
+                    if pending is None:
+                        pending = nxt
+                    actions = np.asarray(pending[0])
+                else:
+                    actions, q = driver.act(stacked)
             new_obs, rewards, terminals, truncs, ep_returns = env.step(actions)
             cuts = terminals | truncs  # truncation cuts windows like a terminal
             if cfg.pipelined_actor:
@@ -407,7 +470,9 @@ def train_apex(cfg: Config, max_frames: Optional[int] = None) -> Dict[str, Any]:
             else:
                 pri = estimator.push(q, actions, rewards, cuts) if estimator else None
                 memory.append_batch(obs, actions, rewards, terminals, pri, truncations=truncs)
-            stacker.reset_lanes(cuts)
+            if not use_dstack:
+                stacker.reset_lanes(cuts)
+            prev_cuts = cuts
             obs = new_obs
             frames += lanes_total  # global frames: all hosts tick in lockstep
             for r in ep_returns[~np.isnan(ep_returns)]:

@@ -221,6 +221,56 @@ def test_pipelined_actor_short_run(tmp_path):
     assert np.isfinite(summary["eval_score_mean"])
 
 
+def test_device_frame_stack_matches_host_stacker():
+    """The device-resident actor stack (shift + cut-zeroing inside the jitted
+    act step) must produce bit-identical stacks to the host FrameStacker
+    under a random episode-cut pattern — same actions for the same key."""
+    from rainbow_iqn_apex_tpu.agents.agent import FrameStacker
+
+    cfg = CFG.replace(frame_height=44, frame_width=44, history_length=4)
+    driver = ApexDriver(cfg, A)
+    rng = np.random.default_rng(5)
+    lanes = 8
+    stacker = FrameStacker(lanes, (44, 44), 4)
+    prev_cuts = np.zeros(lanes, bool)
+    for t in range(12):
+        f = rng.integers(0, 255, (lanes, 44, 44), dtype=np.uint8)
+        # host path: push THEN reset on this tick's cuts (loop ordering)
+        host_stack = stacker.push(f).copy()
+        driver.act_frames(f, prev_cuts)  # updates driver.actor_stack
+        np.testing.assert_array_equal(
+            np.asarray(driver.actor_stack), host_stack
+        )
+        cuts = rng.random(lanes) < 0.3
+        stacker.reset_lanes(cuts)
+        prev_cuts = cuts
+
+
+def test_apex_short_run_with_host_stacker(tmp_path):
+    """train_apex with device_frame_stack=False keeps the host FrameStacker
+    fallback path alive end-to-end (the default-True path is covered by
+    every other apex test plus the multihost CI)."""
+    cfg = CFG.replace(
+        env_id="toy:catch",
+        frame_height=80,
+        frame_width=80,
+        device_frame_stack=False,
+        learn_start=512,
+        replay_ratio=8,
+        memory_capacity=4096,
+        metrics_interval=50,
+        checkpoint_interval=0,
+        eval_interval=0,
+        eval_episodes=2,
+        results_dir=str(tmp_path / "results"),
+        checkpoint_dir=str(tmp_path / "ckpt"),
+    )
+    summary = train_apex(cfg, max_frames=1_000)
+    assert summary["frames"] == 1_000
+    assert summary["learn_steps"] > 0
+    assert np.isfinite(summary["eval_score_mean"])
+
+
 def test_apex_kill_and_resume(tmp_path):
     """Kill-and-resume: a second train_apex run with resume=True continues
     the step/frame counters exactly from the last checkpoint and restores
