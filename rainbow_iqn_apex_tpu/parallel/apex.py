@@ -65,9 +65,11 @@ from rainbow_iqn_apex_tpu.utils.logging import MetricsLogger
 from rainbow_iqn_apex_tpu.parallel.multihost import (  # noqa: E402
     global_is_nq,
     host_state,
+    lane_put,
     local_rows as _local_rows,
     make_global_is_weights,
     plan_hosts,
+    shift_stack,
 )
 
 
@@ -161,8 +163,7 @@ class ApexDriver:
         # bottleneck (~14k frames/s on the build sandbox vs ~130k replay
         # append).
         def stack_act(params, stack, frame, keep, key):
-            stack = stack * keep[:, None, None, None].astype(stack.dtype)
-            stack = jnp.concatenate([stack[..., 1:], frame[..., None]], axis=-1)
+            stack = shift_stack(stack, frame, keep)
             a, q = act_fn(params, stack, key)
             return a, q, stack
 
@@ -172,6 +173,7 @@ class ApexDriver:
             out_shardings=(lane_sh, lane_sh, lane_sh),
             donate_argnums=1,
         )
+        self._put_lanes = lane_put(lane_sh)
         self.actor_stack = None  # created lazily at the first act_frames
         if cfg.bf16_weight_sync:
             self._cast = jax.jit(
@@ -219,12 +221,6 @@ class ApexDriver:
     def act(self, stacked_obs: np.ndarray) -> Tuple[np.ndarray, np.ndarray]:
         a, q = self.act_async(stacked_obs)
         return np.asarray(a), np.asarray(q)
-
-    def _put_lanes(self, x: np.ndarray):
-        """Host array -> lane-sharded device array (single- or multi-host)."""
-        return jax.make_array_from_process_local_data(
-            self._lane_sh, np.ascontiguousarray(x)
-        )
 
     def act_frames(
         self, frames: np.ndarray, prev_cuts: np.ndarray
@@ -305,9 +301,7 @@ class ApexDriver:
 
     def act_local(self, stacked_obs: np.ndarray) -> Tuple[np.ndarray, np.ndarray]:
         """Lane-sharded inference fed from this host's local lanes."""
-        obs = jax.make_array_from_process_local_data(
-            self._lane_sh, np.ascontiguousarray(stacked_obs)
-        )
+        obs = self._put_lanes(stacked_obs)
         a, q = self._act(self.actor_params, obs, self._next_key())
         return _local_rows(a), _local_rows(q)
 

@@ -46,9 +46,11 @@ from rainbow_iqn_apex_tpu.parallel.mesh import (
 from rainbow_iqn_apex_tpu.parallel.multihost import (
     global_is_nq,
     host_state,
+    lane_put,
     local_rows as _local_rows,
     make_global_is_weights,
     plan_hosts,
+    shift_stack,
 )
 from rainbow_iqn_apex_tpu.replay.sequence import SequenceReplay, SequenceSample
 from rainbow_iqn_apex_tpu.train import priority_beta
@@ -105,11 +107,29 @@ class R2D2ApexDriver:
         # its own host's estimate, folded into nq per row)
         self._global_is_weights = make_global_is_weights(self._batch_sh)
         # act: obs + (c, h) lane-sharded; params replicated on the actor mesh
+        act_fn = build_r2d2_act_step(cfg, num_actions, use_noise=True)
         self._act = jax.jit(
-            build_r2d2_act_step(cfg, num_actions, use_noise=True),
+            act_fn,
             in_shardings=(rep_a, lane_sh, (lane_sh, lane_sh), rep_a),
             out_shardings=(lane_sh, lane_sh, (lane_sh, lane_sh)),
         )
+        # device-resident frame stacking (shared shift with ApexDriver): the
+        # host ships ONE [L, H, W] frame per tick; cut lanes are zeroed
+        # in-graph before the shift.  Only used when history_length > 1.
+        def stack_act(params, stack, frame, keep, lstm_state, key):
+            stack = shift_stack(stack, frame, keep)
+            a, q, new_state = act_fn(params, stack, lstm_state, key)
+            return a, q, new_state, stack
+
+        self._stack_act = jax.jit(
+            stack_act,
+            in_shardings=(
+                rep_a, lane_sh, lane_sh, lane_sh, (lane_sh, lane_sh), rep_a,
+            ),
+            out_shardings=(lane_sh, lane_sh, (lane_sh, lane_sh), lane_sh),
+            donate_argnums=1,
+        )
+        self.actor_stack = None  # created lazily at the first act_frames
         # device-side episode-cut mask for the carried state
         self._mask_state = jax.jit(
             lambda st, keep: jax.tree.map(lambda x: x * keep[:, None], st),
@@ -126,6 +146,7 @@ class R2D2ApexDriver:
             )
         self._rep_a = rep_a
         self._lane_sh = lane_sh
+        self._put_lanes = lane_put(lane_sh)
         self.actor_params = None
         # lanes is the GLOBAL lane count; each host materialises only its
         # local rows (make_array == device_put when single-process)
@@ -133,8 +154,8 @@ class R2D2ApexDriver:
             (lanes // jax.process_count(), cfg.lstm_size), np.float32
         )
         self.lstm_state = (
-            jax.make_array_from_process_local_data(lane_sh, local_zeros),
-            jax.make_array_from_process_local_data(lane_sh, local_zeros),
+            self._put_lanes(local_zeros),
+            self._put_lanes(local_zeros),
         )
         self.publish_weights()
 
@@ -169,10 +190,7 @@ class R2D2ApexDriver:
         if self._multihost:
             pre_c = _local_rows(self.lstm_state[0])
             pre_h = _local_rows(self.lstm_state[1])
-            x = jax.make_array_from_process_local_data(
-                self._lane_sh,
-                np.ascontiguousarray(as_actor_input(obs, self.cfg.history_length)),
-            )
+            x = self._put_lanes(as_actor_input(obs, self.cfg.history_length))
             a, _q, self.lstm_state = self._act(
                 self.actor_params, x, self.lstm_state, self._next_key()
             )
@@ -186,12 +204,40 @@ class R2D2ApexDriver:
         return np.asarray(a), (pre_c, pre_h)
 
     def reset_lanes(self, cuts: np.ndarray) -> None:
-        keep_np = (1.0 - cuts.astype(np.float32))
-        if self._multihost:
-            keep = jax.make_array_from_process_local_data(self._lane_sh, keep_np)
-        else:
-            keep = jnp.asarray(keep_np)
+        keep = self._put_lanes(1.0 - cuts.astype(np.float32))
         self.lstm_state = self._mask_state(self.lstm_state, keep)
+
+    def act_frames(
+        self, frames: np.ndarray, prev_cuts: np.ndarray
+    ) -> Tuple[np.ndarray, Tuple[np.ndarray, np.ndarray]]:
+        """Device-stacked recurrent acting (history_length > 1): push this
+        host's newest [L_local, H, W] frames into the device-resident stack
+        (zeroing lanes cut LAST tick) and act; returns (actions, pre-step
+        LSTM state snapshot) exactly like act().  The LSTM state itself is
+        reset separately via reset_lanes (the loop's existing contract)."""
+        if self._multihost:
+            pre_c = _local_rows(self.lstm_state[0])
+            pre_h = _local_rows(self.lstm_state[1])
+        else:
+            pre_c = np.asarray(self.lstm_state[0])
+            pre_h = np.asarray(self.lstm_state[1])
+        if self.actor_stack is None:
+            h, w = frames.shape[1], frames.shape[2]
+            self.actor_stack = self._put_lanes(
+                np.zeros((frames.shape[0], h, w, self.cfg.history_length), np.uint8)
+            )
+        keep = self._put_lanes((~np.asarray(prev_cuts, bool)).astype(np.uint8))
+        a, _q, self.lstm_state, self.actor_stack = self._stack_act(
+            self.actor_params,
+            self.actor_stack,
+            self._put_lanes(np.asarray(frames, np.uint8)),
+            keep,
+            self.lstm_state,
+            self._next_key(),
+        )
+        if self._multihost:
+            return _local_rows(a), (pre_c, pre_h)
+        return np.asarray(a), (pre_c, pre_h)
 
     def learn_batch(self, batch: SequenceBatch) -> Dict[str, Any]:
         self.state, info = self._learn(self.state, batch, self._next_key())
@@ -288,7 +334,14 @@ def train_apex_r2d2(cfg: Config, max_frames: Optional[int] = None) -> Dict[str, 
         metrics.log("resume", step=driver.step, frames=frames)
 
     obs = env.reset()
-    stacker = FrameStacker(lanes, env.frame_shape, cfg.history_length)
+    # device-resident stacking replaces the host FrameStacker whenever the
+    # recurrent net takes stacked input (history_length == 1 feeds raw
+    # frames and needs neither)
+    use_dstack = cfg.device_frame_stack and cfg.history_length > 1
+    stacker = None if use_dstack else FrameStacker(
+        lanes, env.frame_shape, cfg.history_length
+    )
+    prev_cuts = np.zeros(lanes, bool)
     returns: collections.deque = collections.deque(maxlen=100)
     prefetcher: Optional[BatchPrefetcher] = None
     learn_start_seqs = max(cfg.learn_start // seq_total, 8)  # single-host gate
@@ -302,14 +355,19 @@ def train_apex_r2d2(cfg: Config, max_frames: Optional[int] = None) -> Dict[str, 
 
     try:
         while frames < total_frames:
-            actions, (pre_c, pre_h) = driver.act(stacker.push(obs))
+            if use_dstack:
+                actions, (pre_c, pre_h) = driver.act_frames(obs, prev_cuts)
+            else:
+                actions, (pre_c, pre_h) = driver.act(stacker.push(obs))
             new_obs, rewards, terminals, truncs, ep_returns = env.step(actions)
             cuts = terminals | truncs
             memory.append_batch(
                 obs, actions, rewards, terminals, pre_c, pre_h, truncations=truncs
             )
             driver.reset_lanes(cuts)
-            stacker.reset_lanes(cuts)
+            if not use_dstack:
+                stacker.reset_lanes(cuts)
+            prev_cuts = cuts
             obs = new_obs
             frames += lanes_total  # global frames: hosts tick in lockstep
             for r in ep_returns[~np.isnan(ep_returns)]:

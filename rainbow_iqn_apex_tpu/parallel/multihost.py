@@ -179,3 +179,24 @@ def global_is_nq(prob: np.ndarray, global_size: float) -> np.ndarray:
     quota makes the sampling scheme a uniform mixture over hosts, so the
     global sample probability of a local row is prob_local / n_hosts."""
     return global_size * np.asarray(prob) / jax.process_count()
+
+
+def lane_put(lane_sh):
+    """host rows -> lane-sharded device array (single- or multi-host; with
+    one process this is just a device_put onto the actor mesh)."""
+
+    def put(x: np.ndarray):
+        return jax.make_array_from_process_local_data(
+            lane_sh, np.ascontiguousarray(x)
+        )
+
+    return put
+
+
+def shift_stack(stack, frame, keep):
+    """Device-resident frame-stack update shared by both apex drivers:
+    zero the stacks of lanes whose episode was cut LAST tick (matching the
+    host FrameStacker's push-then-reset ordering), then shift the newest
+    [L, H, W] frame into the trailing channel."""
+    stack = stack * keep[:, None, None, None].astype(stack.dtype)
+    return jnp.concatenate([stack[..., 1:], frame[..., None]], axis=-1)

@@ -83,6 +83,54 @@ def test_learn_and_publish(driver):
         np.testing.assert_allclose(np.asarray(lp), np.asarray(ap), rtol=2e-2, atol=1e-2)
 
 
+def test_r2d2_device_frame_stack_matches_host_stacker():
+    """Device-resident stacking for the recurrent actor (history>1): stacks
+    must match the host FrameStacker bit-for-bit under random cuts, and the
+    pre-step LSTM snapshots must still be the pre-act values."""
+    from rainbow_iqn_apex_tpu.agents.agent import FrameStacker
+
+    cfg = CFG.replace(history_length=4, r2d2_burn_in=3)
+    driver = R2D2ApexDriver(cfg, A, FRAME, LANES)
+    rng = np.random.default_rng(9)
+    stacker = FrameStacker(LANES, FRAME, 4)
+    prev_cuts = np.zeros(LANES, bool)
+    for t in range(10):
+        f = rng.integers(0, 255, (LANES, *FRAME), dtype=np.uint8)
+        host_stack = stacker.push(f).copy()
+        pre_host = np.asarray(driver.lstm_state[0]).copy()
+        a, (pre_c, _pre_h) = driver.act_frames(f, prev_cuts)
+        np.testing.assert_array_equal(np.asarray(driver.actor_stack), host_stack)
+        np.testing.assert_array_equal(pre_c, pre_host)  # pre-act snapshot
+        assert a.shape == (LANES,)
+        cuts = rng.random(LANES) < 0.3
+        driver.reset_lanes(cuts)
+        stacker.reset_lanes(cuts)
+        prev_cuts = cuts
+
+
+def test_apex_r2d2_short_run_with_device_stack(tmp_path):
+    """Stacked recurrent apex (history 4) end-to-end on the device-stack
+    path (the single-frame history=1 configs never use it)."""
+    cfg = CFG.replace(
+        env_id="toy:catch",
+        history_length=4,
+        r2d2_burn_in=3,
+        learn_start=256,
+        replay_ratio=4,
+        memory_capacity=8192,
+        metrics_interval=20,
+        checkpoint_interval=0,
+        eval_interval=0,
+        eval_episodes=2,
+        results_dir=str(tmp_path / "results"),
+        checkpoint_dir=str(tmp_path / "ckpt"),
+    )
+    summary = train_apex_r2d2(cfg, max_frames=1_000)
+    assert summary["frames"] == 1_000
+    assert summary["learn_steps"] > 0
+    assert np.isfinite(summary["eval_score_mean"])
+
+
 def test_apex_r2d2_kill_and_resume(tmp_path):
     """Resumed mesh R2D2 continues step/frame counters from the checkpoint
     and restores the sequence-replay snapshot (builder windows included)."""

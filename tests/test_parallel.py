@@ -237,10 +237,16 @@ def test_device_frame_stack_matches_host_stacker():
         f = rng.integers(0, 255, (lanes, 44, 44), dtype=np.uint8)
         # host path: push THEN reset on this tick's cuts (loop ordering)
         host_stack = stacker.push(f).copy()
-        driver.act_frames(f, prev_cuts)  # updates driver.actor_stack
+        key_before = driver.key
+        a_dev, q_dev = driver.act_frames(f, prev_cuts)
         np.testing.assert_array_equal(
             np.asarray(driver.actor_stack), host_stack
         )
+        # same stack + same key => identical actions through either path
+        driver.key = key_before
+        a_host, q_host = driver.act(host_stack)
+        np.testing.assert_array_equal(a_dev, a_host)
+        np.testing.assert_allclose(q_dev, q_host, rtol=1e-5, atol=1e-5)
         cuts = rng.random(lanes) < 0.3
         stacker.reset_lanes(cuts)
         prev_cuts = cuts
