@@ -102,6 +102,7 @@ class SequenceReplay:
         self._buf_c = np.zeros((lanes, seq_len, lstm_size), np.float32)
         self._buf_h = np.zeros((lanes, seq_len, lstm_size), np.float32)
         self._buf_len = np.zeros(lanes, np.int64)
+        self._lane_idx = np.arange(lanes)
 
     # -------------------------------------------------------------- building
     def append_batch(
@@ -132,20 +133,23 @@ class SequenceReplay:
     ):
         if truncations is None:
             truncations = np.zeros(self.lanes, bool)
-        emitted = 0
-        for i in range(self.lanes):
-            k = int(self._buf_len[i])
-            self._buf_frames[i, k] = frames[i]
-            self._buf_actions[i, k] = actions[i]
-            self._buf_rewards[i, k] = rewards[i]
-            self._buf_dones[i, k] = terminals[i]
-            self._buf_c[i, k] = lstm_c[i]
-            self._buf_h[i, k] = lstm_h[i]
-            self._buf_len[i] = k + 1
+        # vectorised scatter into each lane's builder row (the per-lane
+        # Python loop only runs for lanes that EMIT this tick — rare)
+        lane = self._lane_idx
+        k = self._buf_len
+        self._buf_frames[lane, k] = frames
+        self._buf_actions[lane, k] = actions
+        self._buf_rewards[lane, k] = rewards
+        self._buf_dones[lane, k] = np.asarray(terminals, bool)
+        self._buf_c[lane, k] = lstm_c
+        self._buf_h[lane, k] = lstm_h
+        self._buf_len += 1
 
-            cut = bool(terminals[i] or truncations[i])
-            if cut or self._buf_len[i] == self.L:
-                emitted += self._emit(i, flush=cut)
+        cut = np.asarray(terminals, bool) | np.asarray(truncations, bool)
+        emit = cut | (self._buf_len == self.L)
+        emitted = 0
+        for i in np.flatnonzero(emit):
+            emitted += self._emit(int(i), flush=bool(cut[i]))
         return emitted
 
     def _emit(self, lane: int, flush: bool) -> int:
