@@ -34,9 +34,9 @@ WATCHDOG_SECS = int(os.environ.get("BENCH_WATCHDOG_SECS", "480"))
 def measure() -> None:
     """Child-process body: measure on whatever device jax gives us."""
     import jax
-    import jax.numpy as jnp
     import numpy as np
 
+    from rainbow_iqn_apex_tpu.agents.agent import to_device_batch
     from rainbow_iqn_apex_tpu.config import Config
     from rainbow_iqn_apex_tpu.ops.learn import (
         Batch,
@@ -67,8 +67,8 @@ def measure() -> None:
     key = jax.random.PRNGKey(1)
 
     def step(state, hb, key):
-        batch = Batch(*(jnp.asarray(getattr(hb, f)) for f in
-                        ("obs", "action", "reward", "next_obs", "discount", "weight")))
+        # the production staging path (flat-byte frame transfers inside)
+        batch = to_device_batch(hb)
         key, k = jax.random.split(key)
         state, info = learn(state, batch, k)
         return state, info, key

@@ -34,13 +34,26 @@ from rainbow_iqn_apex_tpu.ops.learn import (
 from rainbow_iqn_apex_tpu.replay.buffer import SampledBatch
 
 
+def put_frames(x: np.ndarray) -> jnp.ndarray:
+    """Transfer uint8 frame tensors as a flat byte stream, reshape on device.
+
+    Rank>=3 uint8 transfers pay a per-array host/transport (re)tiling cost on
+    some PJRT transports — measured 4-7x slower than the same bytes rank-1
+    through this sandbox's TPU relay (docs/STATUS.md round-2 perf notes).  The
+    flat view is zero-copy on the host and the device-side reshape is layout
+    bookkeeping, so this is never worse than the shaped transfer.
+    """
+    arr = np.ascontiguousarray(x)
+    return jnp.asarray(arr.reshape(-1)).reshape(arr.shape)
+
+
 def to_device_batch(sample: SampledBatch) -> Batch:
     """Host SampledBatch -> device Batch (async transfers via jnp.asarray)."""
     return Batch(
-        obs=jnp.asarray(sample.obs),
+        obs=put_frames(sample.obs),
         action=jnp.asarray(sample.action),
         reward=jnp.asarray(sample.reward),
-        next_obs=jnp.asarray(sample.next_obs),
+        next_obs=put_frames(sample.next_obs),
         discount=jnp.asarray(sample.discount),
         weight=jnp.asarray(sample.weight),
     )
@@ -99,7 +112,7 @@ class Agent:
         """Greedy actions for a [L, H, W, hist] uint8 batch.  Noisy-net noise
         is resampled every call (reference per-step resample, SURVEY §3.2)."""
         fn = self._act_eval if eval_mode else self._act
-        actions, _ = fn(self.state.params, jnp.asarray(stacked_obs), self._next_key())
+        actions, _ = fn(self.state.params, put_frames(stacked_obs), self._next_key())
         return np.asarray(actions)
 
     # ---------------------------------------------------------------- learning

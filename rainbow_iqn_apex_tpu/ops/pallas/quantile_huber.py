@@ -10,6 +10,15 @@ This kernel computes, in one VMEM pass per batch block:
     sampled, targets are stop-gradient)
 so the [B, N, N'] tensor never touches HBM in either direction.
 
+TPU lowering constraints (learned from the first on-chip compile, round 2):
+  - rank-1 blocks may only tile a rank-1 array if the block spans the whole
+    array; the per-sample outputs are therefore carried as rank-2 [B, 1] and
+    squeezed on the way out.
+  - the sublane (second-to-last) block dim must be a multiple of 8 or span
+    the array, so the batch block is 8-aligned with a full-batch fallback.
+  - kappa is a static Python float (a nondiff argnum already), so it is
+    baked into the kernel instead of riding along as an SMEM ref.
+
 Gated by Config.use_pallas_loss; ops/losses.py is the jnp reference the unit
 tests compare against (interpret mode on CPU, compiled on TPU).
 """
@@ -23,62 +32,71 @@ import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
 
-BLOCK_B = 8  # samples per program instance
+BLOCK_B = 8  # samples per program instance (8-aligned; tuned on-chip)
 
 
-def _qh_kernel(online_ref, taus_ref, target_ref, kappa_ref,
-               loss_ref, td_ref, grad_ref):
-    """One batch block: online/taus [TB, N], target [TB, N'] in VMEM."""
-    online = online_ref[:]  # [TB, N]
-    taus = taus_ref[:]
-    target = target_ref[:]  # [TB, N']
-    kappa = kappa_ref[0]
+def _make_kernel(kappa: float):
+    def _qh_kernel(online_ref, taus_ref, target_ref, loss_ref, td_ref, grad_ref):
+        """One batch block: online/taus [TB, N], target [TB, N'] in VMEM."""
+        online = online_ref[:]  # [TB, N]
+        taus = taus_ref[:]
+        target = target_ref[:]  # [TB, N']
 
-    u = target[:, None, :] - online[:, :, None]  # [TB, N, N'] in registers/VMEM
-    abs_u = jnp.abs(u)
-    quad = abs_u <= kappa
-    hub = jnp.where(quad, 0.5 * u * u, kappa * (abs_u - 0.5 * kappa))
-    w = jnp.abs(taus[:, :, None] - (u < 0.0).astype(jnp.float32))
-    rho = w * hub / kappa
+        u = target[:, None, :] - online[:, :, None]  # [TB, N, N'] VMEM-only
+        abs_u = jnp.abs(u)
+        quad = abs_u <= kappa
+        hub = jnp.where(quad, 0.5 * u * u, kappa * (abs_u - 0.5 * kappa))
+        w = jnp.abs(taus[:, :, None] - (u < 0.0).astype(jnp.float32))
+        rho = w * hub / kappa
 
-    npr = u.shape[-1]
-    loss_ref[:] = rho.mean(axis=2).sum(axis=1)
-    td_ref[:] = abs_u.mean(axis=(1, 2))
-    # d rho/d online_i = -w_ij * clip(u, -kappa, kappa)/kappa ; mean over j
-    dhub = jnp.clip(u, -kappa, kappa) / kappa
-    grad_ref[:] = -(w * dhub).sum(axis=2) / npr  # [TB, N]
+        npr = u.shape[-1]
+        loss_ref[:] = rho.mean(axis=2).sum(axis=1)[:, None]  # [TB, 1]
+        td_ref[:] = abs_u.mean(axis=(1, 2))[:, None]  # [TB, 1]
+        # d rho/d online_i = -w_ij * clip(u, -kappa, kappa)/kappa ; mean over j
+        dhub = jnp.clip(u, -kappa, kappa) / kappa
+        grad_ref[:] = -(w * dhub).sum(axis=2) / npr  # [TB, N]
+
+    return _qh_kernel
+
+
+def _block_b(B: int) -> int:
+    """Largest legal batch block: BLOCK_B when it divides B and is 8-aligned
+    (TPU sublane rule), else the whole batch (block == array is always legal).
+    The 8-alignment clause is live: scripts/bench_pallas.py retunes the
+    module-level BLOCK_B at runtime, including non-8-aligned candidates."""
+    if B % BLOCK_B == 0 and (BLOCK_B % 8 == 0 or BLOCK_B == B):
+        return BLOCK_B
+    return B
 
 
 def _run_kernel(online, taus, target, kappa, interpret):
     B, N = online.shape
     NP = target.shape[1]
-    TB = BLOCK_B if B % BLOCK_B == 0 else 1
+    TB = _block_b(B)
     grid = (B // TB,)
-    kappa_arr = jnp.full((1,), kappa, jnp.float32)
     out_shapes = (
-        jax.ShapeDtypeStruct((B,), jnp.float32),  # loss
-        jax.ShapeDtypeStruct((B,), jnp.float32),  # td_abs
+        jax.ShapeDtypeStruct((B, 1), jnp.float32),  # loss
+        jax.ShapeDtypeStruct((B, 1), jnp.float32),  # td_abs
         jax.ShapeDtypeStruct((B, N), jnp.float32),  # grad wrt online
     )
     loss, td, grad = pl.pallas_call(
-        _qh_kernel,
+        _make_kernel(float(kappa)),
         grid=grid,
         in_specs=[
             pl.BlockSpec((TB, N), lambda i: (i, 0)),
             pl.BlockSpec((TB, N), lambda i: (i, 0)),
             pl.BlockSpec((TB, NP), lambda i: (i, 0)),
-            pl.BlockSpec((1,), lambda i: (0,)),
         ],
         out_specs=(
-            pl.BlockSpec((TB,), lambda i: (i,)),
-            pl.BlockSpec((TB,), lambda i: (i,)),
+            pl.BlockSpec((TB, 1), lambda i: (i, 0)),
+            pl.BlockSpec((TB, 1), lambda i: (i, 0)),
             pl.BlockSpec((TB, N), lambda i: (i, 0)),
         ),
         out_shape=out_shapes,
         interpret=interpret,
     )(online.astype(jnp.float32), taus.astype(jnp.float32),
-      target.astype(jnp.float32), kappa_arr)
-    return loss, td, grad
+      target.astype(jnp.float32))
+    return loss[:, 0], td[:, 0], grad
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))

@@ -35,7 +35,11 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from rainbow_iqn_apex_tpu.agents.agent import FrameStacker, to_device_batch
+from rainbow_iqn_apex_tpu.agents.agent import (
+    FrameStacker,
+    put_frames,
+    to_device_batch,
+)
 from rainbow_iqn_apex_tpu.utils.prefetch import BatchPrefetcher, make_replay_prefetcher
 from rainbow_iqn_apex_tpu.config import Config
 from rainbow_iqn_apex_tpu.envs import make_vector_env
@@ -216,7 +220,7 @@ class ApexDriver:
     def act_async(self, stacked_obs: np.ndarray):
         """Dispatch lane-sharded inference; returns DEVICE arrays immediately
         (JAX async dispatch) so the host can overlap env work."""
-        return self._act(self.actor_params, jnp.asarray(stacked_obs), self._next_key())
+        return self._act(self.actor_params, put_frames(stacked_obs), self._next_key())
 
     def act(self, stacked_obs: np.ndarray) -> Tuple[np.ndarray, np.ndarray]:
         a, q = self.act_async(stacked_obs)
