@@ -13,69 +13,98 @@ On CPU the kernel runs in interpret mode (orders of magnitude slow) —
 the script detects that, trims iterations, and labels the rows so nobody
 mistakes them for a TPU result.  Keep the winner only if it beats the
 jnp path; record both numbers in docs/STATUS.md.
+
+`measure_learn` is the sweep's single measurement primitive, shared with
+scripts/tpu_session.py so the two harnesses cannot drift.
 """
 
 import json
 import os
 import sys
 import time
+from typing import Callable, Optional
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
-def main() -> None:
+def measure_learn(
+    use_pallas: bool,
+    block_b: int,
+    iters: int,
+    stop: Optional[Callable[[], bool]] = None,
+) -> dict:
+    """Timed full-learn-step loop at the reference Atari shape.
+
+    Mutates ops.pallas.quantile_huber.BLOCK_B (read at trace time) before
+    compiling.  ``stop`` lets a caller impose a soft wall-clock budget; a
+    run cut short reports the iterations it actually completed, and a run
+    with ZERO timed iterations reports ``skipped`` instead of a rate.
+    """
     import jax
-    import jax.numpy as jnp
     import numpy as np
 
+    from rainbow_iqn_apex_tpu.agents.agent import to_device_batch
     from rainbow_iqn_apex_tpu.config import Config
+    from rainbow_iqn_apex_tpu.ops.learn import build_learn_step, init_train_state
     from rainbow_iqn_apex_tpu.ops.pallas import quantile_huber as qh
-    from rainbow_iqn_apex_tpu.ops.learn import Batch, build_learn_step, init_train_state
+    from rainbow_iqn_apex_tpu.replay.buffer import SampledBatch
 
     platform = jax.devices()[0].platform
     # same gate ops/learn.py uses to pick interpret mode — anything else
-    # (cpu, gpu) runs the kernel INTERPRETED and must be trimmed + labelled
+    # (cpu, gpu) runs the kernel INTERPRETED and must be labelled as such
     compiled = jax.default_backend() in ("tpu", "axon")
-    iters = int(os.environ.get("BENCH_ITERS", "100" if compiled else "3"))
+
+    qh.BLOCK_B = block_b
+    cfg = Config(use_pallas_loss=use_pallas)
     num_actions = 18
     rng = np.random.default_rng(0)
+    state = init_train_state(cfg, num_actions, jax.random.PRNGKey(0))
+    learn = jax.jit(build_learn_step(cfg, num_actions), donate_argnums=0)
+    b = cfg.batch_size
+    batch = to_device_batch(SampledBatch(
+        idx=np.arange(b),
+        obs=rng.integers(0, 255, (b, *cfg.state_shape), dtype=np.uint8),
+        action=rng.integers(0, num_actions, b).astype(np.int32),
+        reward=rng.normal(size=b).astype(np.float32),
+        next_obs=rng.integers(0, 255, (b, *cfg.state_shape), dtype=np.uint8),
+        discount=np.full(b, 0.99**3, np.float32),
+        weight=np.ones(b, np.float32),
+        prob=np.full(b, 1.0 / b),
+    ))
+    key = jax.random.PRNGKey(1)
+    for _ in range(2):  # compile + warm
+        key, k = jax.random.split(key)
+        state, info = learn(state, batch, k)
+    jax.block_until_ready(info["loss"])
+    row = {
+        "loss_impl": "pallas" if use_pallas else "jnp",
+        "block_b": block_b if use_pallas else None,
+        "platform": platform + ("" if compiled else " (interpret-mode pallas)"),
+    }
+    t0 = time.perf_counter()
+    n = 0
+    while n < iters and not (stop is not None and stop()):
+        key, k = jax.random.split(key)
+        state, info = learn(state, batch, k)
+        n += 1
+    jax.block_until_ready(info["loss"])
+    dt = time.perf_counter() - t0
+    if n == 0:
+        return {**row, "skipped": "budget exhausted before any timed iteration"}
+    return {**row, "steps_per_sec": round(n / dt, 2), "iters": n,
+            "loss": float(info["loss"])}
 
-    def run(use_pallas: bool, block_b: int) -> dict:
-        qh.BLOCK_B = block_b
-        cfg = Config(use_pallas_loss=use_pallas)
-        state = init_train_state(cfg, num_actions, jax.random.PRNGKey(0))
-        learn = jax.jit(build_learn_step(cfg, num_actions), donate_argnums=0)
-        b = cfg.batch_size
-        batch = Batch(
-            obs=jnp.asarray(rng.integers(0, 255, (b, *cfg.state_shape), dtype=np.uint8)),
-            action=jnp.asarray(rng.integers(0, num_actions, b).astype(np.int32)),
-            reward=jnp.asarray(rng.normal(size=b).astype(np.float32)),
-            next_obs=jnp.asarray(rng.integers(0, 255, (b, *cfg.state_shape), dtype=np.uint8)),
-            discount=jnp.full((b,), 0.99**3, jnp.float32),
-            weight=jnp.ones((b,), jnp.float32),
-        )
-        key = jax.random.PRNGKey(1)
-        for _ in range(2):  # compile + warm
-            key, k = jax.random.split(key)
-            state, info = learn(state, batch, k)
-        jax.block_until_ready(info["loss"])
-        t0 = time.perf_counter()
-        for _ in range(iters):
-            key, k = jax.random.split(key)
-            state, info = learn(state, batch, k)
-        jax.block_until_ready(info["loss"])
-        dt = time.perf_counter() - t0
-        return {
-            "loss_impl": "pallas" if use_pallas else "jnp",
-            "block_b": block_b if use_pallas else None,
-            "steps_per_sec": round(iters / dt, 2),
-            "platform": platform + ("" if compiled else " (interpret-mode pallas)"),
-        }
 
-    rows = [run(False, 0)]
+def main() -> None:
+    import jax
+
+    compiled = jax.default_backend() in ("tpu", "axon")
+    iters = int(os.environ.get("BENCH_ITERS", "100" if compiled else "3"))
+
+    rows = [measure_learn(False, 8, iters)]
     for bb in (4, 8, 16, 32):
         try:
-            rows.append(run(True, bb))
+            rows.append(measure_learn(True, bb, iters))
         except Exception as e:  # a bad BLOCK_B must not kill the sweep
             rows.append({"loss_impl": "pallas", "block_b": bb,
                          "error": f"{type(e).__name__}: {e}"[:200]})

@@ -1,0 +1,170 @@
+#!/usr/bin/env python
+"""One-shot TPU live-window capture: everything we want from the relay,
+in a single clean process with SOFT internal deadlines.
+
+The sandbox's TPU relay admits one claim and wedges when a client is
+SIGKILLed mid-RPC (both round-1 and round-2 wedges happened exactly that
+way, via `timeout ...` on an experiment). This script therefore never
+relies on an external kill: every phase checks a wall-clock budget between
+device calls and skips forward, so the process always exits cleanly and
+the relay claim is always released.
+
+Phase order is safest-first so a far-side compiler abort (seen once with
+the round-1 Pallas kernel) can only cost the phases after it:
+  1. bench      — end-to-end learn steps/s on the flat-transfer staging path
+  2. transfer   — flat vs shaped uint8 put latency (the re-tiling microscopy)
+  3. trace      — jax.profiler device trace of ~30 learn steps -> /tmp
+  4. pallas     — jnp vs Pallas loss learn-step sweep over BLOCK_B (riskiest:
+                  first-ever on-chip compile of the reworked kernel, LAST)
+
+Every phase emits one JSON line; zero-iteration loops emit a `skipped`
+marker, never a fake rate.
+
+Usage:  python scripts/tpu_session.py [total_budget_seconds=420]
+"""
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from bench_pallas import measure_learn  # noqa: E402  (sibling script)
+
+BUDGET = float(sys.argv[1]) if len(sys.argv) > 1 else 420.0
+T0 = time.monotonic()
+
+
+def left() -> float:
+    return BUDGET - (time.monotonic() - T0)
+
+
+def emit(**row) -> None:
+    print(json.dumps(row), flush=True)
+
+
+def main() -> None:
+    import jax
+    import numpy as np
+
+    from rainbow_iqn_apex_tpu.agents.agent import to_device_batch
+    from rainbow_iqn_apex_tpu.config import Config
+    from rainbow_iqn_apex_tpu.ops.learn import build_learn_step, init_train_state
+    from rainbow_iqn_apex_tpu.replay.buffer import SampledBatch
+
+    platform = jax.devices()[0].platform
+    emit(phase="hello", platform=platform, budget_s=BUDGET)
+    rng = np.random.default_rng(0)
+    cfg = Config()
+    A = 18
+    b = cfg.batch_size
+
+    def host_sample():
+        return SampledBatch(
+            idx=np.arange(b),
+            obs=rng.integers(0, 255, (b, *cfg.state_shape), dtype=np.uint8),
+            action=rng.integers(0, A, b).astype(np.int32),
+            reward=rng.normal(size=b).astype(np.float32),
+            next_obs=rng.integers(0, 255, (b, *cfg.state_shape), dtype=np.uint8),
+            discount=np.full(b, 0.99**3, np.float32),
+            weight=np.ones(b, np.float32),
+            prob=np.full(b, 1.0 / b),
+        )
+
+    samples = [host_sample() for _ in range(8)]
+
+    # ---- phase 1: end-to-end bench on the production staging path --------
+    state = init_train_state(cfg, A, jax.random.PRNGKey(0))
+    learn = jax.jit(build_learn_step(cfg, A), donate_argnums=0)
+    key = jax.random.PRNGKey(1)
+
+    def one(state, s, key):
+        batch = to_device_batch(s)
+        key, k = jax.random.split(key)
+        state, info = learn(state, batch, k)
+        return state, info, key
+
+    for _ in range(3):
+        state, info, key = one(state, samples[0], key)
+    jax.block_until_ready(info["loss"])
+    n = 0
+    t = time.perf_counter()
+    while n < 300 and left() > BUDGET * 0.55:
+        state, info, key = one(state, samples[n % 8], key)
+        n += 1
+    jax.block_until_ready(info["loss"])
+    dt = time.perf_counter() - t
+    if n == 0:
+        emit(phase="bench", skipped="budget exhausted during warmup")
+    else:
+        emit(phase="bench", steps_per_sec=round(n / dt, 2), iters=n,
+             note="end-to-end incl. flat-byte host transfer, batch 32 Atari shape")
+
+    # ---- phase 2: transfer microscopy ------------------------------------
+    if left() > BUDGET * 0.45:
+        d = jax.devices()[0]
+        shaped = samples[0].obs
+        flat = shaped.reshape(-1)
+        for name, arr in (("rank4", shaped), ("rank1", flat)):
+            jax.device_put(arr, d).block_until_ready()
+            t = time.perf_counter()
+            k = 0
+            while k < 20 and left() > BUDGET * 0.4:
+                jax.device_put(arr, d).block_until_ready()
+                k += 1
+            if k == 0:
+                emit(phase="transfer", layout=name, skipped="budget exhausted")
+                continue
+            ms = (time.perf_counter() - t) / k * 1e3
+            emit(phase="transfer", layout=name, mb=round(arr.nbytes / 1e6, 2),
+                 ms=round(ms, 2))
+
+    # ---- phase 3: profiler trace -----------------------------------------
+    if left() > BUDGET * 0.3:
+        trace_dir = "/tmp/tpu_trace"
+        try:
+            done = 0
+            with jax.profiler.trace(trace_dir):
+                st = init_train_state(cfg, A, jax.random.PRNGKey(0))
+                fn = jax.jit(build_learn_step(cfg, A), donate_argnums=0)
+                kk = jax.random.PRNGKey(3)
+                nf = None
+                for i in range(30):
+                    if left() < BUDGET * 0.2:
+                        break
+                    kk, k2 = jax.random.split(kk)
+                    st, nf = fn(st, to_device_batch(samples[i % 8]), k2)
+                    done += 1
+                if nf is not None:
+                    jax.block_until_ready(nf["loss"])
+            if done == 0:
+                emit(phase="trace", skipped="budget exhausted before any step")
+            else:
+                emit(phase="trace", dir=trace_dir, ok=True, steps=done)
+        except Exception as e:
+            emit(phase="trace", ok=False, error=repr(e)[:200])
+
+    # ---- phase 4: pallas sweep (riskiest compile, deliberately last) -----
+    if left() > 60:
+        try:
+            emit(phase="pallas", **measure_learn(False, 8, 100,
+                                                 stop=lambda: left() < 30))
+        except Exception as e:
+            emit(phase="pallas", impl="jnp", error=repr(e)[:200])
+        for bb in (8, 16, 32):
+            if left() < 60:
+                emit(phase="pallas", block_b=bb, skipped="budget exhausted")
+                continue
+            try:
+                emit(phase="pallas", **measure_learn(True, bb, 100,
+                                                     stop=lambda: left() < 30))
+            except Exception as e:
+                emit(phase="pallas", impl="pallas", block_b=bb,
+                     error=repr(e)[:200])
+
+    emit(phase="done", elapsed_s=round(time.monotonic() - T0, 1))
+
+
+if __name__ == "__main__":
+    main()

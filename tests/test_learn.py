@@ -123,3 +123,17 @@ def test_terminal_discount_blocks_bootstrap(setup):
         net, CFG, state.params, state.target_params, done, jax.random.PRNGKey(6)
     )
     assert np.all(np.isfinite(np.asarray(aux["td_abs"])))
+
+
+def test_put_frames_bit_equal_to_shaped_transfer():
+    """put_frames (flat-byte staging, agents/agent.py) must be a pure
+    transport optimization: bit-identical device contents, same shape/dtype,
+    including for non-contiguous host views."""
+    from rainbow_iqn_apex_tpu.agents.agent import put_frames
+
+    rng = np.random.default_rng(0)
+    x = rng.integers(0, 255, (6, 44, 44, 2), dtype=np.uint8)
+    for arr in (x, x[::2], np.asfortranarray(x)):  # contiguous + 2 views
+        got = put_frames(arr)
+        assert got.shape == arr.shape and got.dtype == jnp.uint8
+        np.testing.assert_array_equal(np.asarray(got), np.asarray(arr))
