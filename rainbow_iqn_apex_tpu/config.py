@@ -89,7 +89,7 @@ class Config:
     use_native_sumtree: bool = True  # C++ core; falls back to NumPy if unbuilt
 
     # ---- Ape-X topology (SURVEY §2 rows 7-8) --------------------------------------
-    role: str = "single"  # "single" | "learner" | "actor" | "apex"
+    role: str = "single"  # "single" | "apex" | "anakin" (HBM-resident replay)
     num_actors: int = 1  # actor loops (vector-env lanes per loop below)
     actor_id: int = 0
     num_envs_per_actor: int = 16  # batched vector-env width per actor loop

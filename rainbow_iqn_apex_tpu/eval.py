@@ -5,6 +5,10 @@ mean/median scores plus normalised scores when baselines are known."""
 
 from __future__ import annotations
 
+import functools
+
+import jax
+
 from typing import Any, Dict, Optional
 
 import numpy as np
@@ -68,3 +72,25 @@ def evaluate(
     if hn is not None:
         out["human_normalized"] = hn
     return out
+
+
+@functools.lru_cache(maxsize=4)
+def _cached_eval_agent(cfg: Config, num_actions: int, frame_shape):
+    """One throwaway eval Agent per (cfg, env) — its jitted act function is
+    retraced only on a config change, not on every eval interval."""
+    return Agent(
+        cfg,
+        num_actions,
+        jax.random.PRNGKey(cfg.seed + 1),
+        train=False,
+        state_shape=(*frame_shape, cfg.history_length),
+    )
+
+
+def evaluate_state(cfg: Config, env, state, seed: int = 0) -> Dict[str, Any]:
+    """Evaluate a learner's current TrainState on a single-device eval agent
+    (reference evaluates the learner checkpoint, SURVEY §3.5).  Shared by the
+    apex driver and the anakin trainer."""
+    agent = _cached_eval_agent(cfg, env.num_actions, tuple(env.frame_shape))
+    agent.state = jax.device_put(state, jax.local_devices()[0])
+    return evaluate(cfg, agent, seed=seed)

@@ -317,18 +317,9 @@ class ApexDriver:
 def _eval_learner(cfg: Config, env, driver: "ApexDriver") -> Dict[str, Any]:
     """Evaluate the LEARNER's current params (reference evaluates the learner
     checkpoint, SURVEY §3.5) on a single-device eval agent."""
-    from rainbow_iqn_apex_tpu.agents.agent import Agent
-    from rainbow_iqn_apex_tpu.eval import evaluate
+    from rainbow_iqn_apex_tpu.eval import evaluate_state
 
-    eval_agent = Agent(
-        cfg,
-        env.num_actions,
-        jax.random.PRNGKey(cfg.seed + 1),
-        train=False,
-        state_shape=(*env.frame_shape, cfg.history_length),
-    )
-    eval_agent.state = jax.device_put(host_state(driver.state), jax.local_devices()[0])
-    return evaluate(cfg, eval_agent, seed=cfg.seed + 977)
+    return evaluate_state(cfg, env, host_state(driver.state), seed=cfg.seed + 977)
 
 
 def train_apex(cfg: Config, max_frames: Optional[int] = None) -> Dict[str, Any]:

@@ -1,0 +1,97 @@
+"""End-to-end tests for the Anakin trainer (train_anakin.py): the
+device-resident-replay learner must run the same act/learn/eval/checkpoint
+lifecycle as the host trainers — and LEARN (slow marker), since its replay
+semantics are pinned to the host oracle in tests/test_device_replay.py.
+"""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from rainbow_iqn_apex_tpu.config import Config
+from rainbow_iqn_apex_tpu.train_anakin import train_anakin
+
+
+def _cfg(tmp_path, **kw):
+    base = dict(
+        env_id="toy:catch",
+        compute_dtype="float32",
+        frame_height=44,
+        frame_width=44,
+        history_length=2,
+        hidden_size=64,
+        num_cosines=16,
+        num_tau_samples=8,
+        num_tau_prime_samples=8,
+        num_quantile_samples=4,
+        batch_size=16,
+        learning_rate=1e-3,
+        multi_step=3,
+        gamma=0.9,
+        memory_capacity=4096,
+        learn_start=256,
+        replay_ratio=4,
+        target_update_period=100,
+        num_envs_per_actor=8,
+        metrics_interval=100,
+        eval_interval=0,
+        checkpoint_interval=0,
+        eval_episodes=10,
+        results_dir=str(tmp_path / "results"),
+        checkpoint_dir=str(tmp_path / "ckpt"),
+        seed=3,
+    )
+    base.update(kw)
+    return Config(**base)
+
+
+def test_anakin_smoke_end_to_end(tmp_path):
+    """Runs, learns steps on schedule, logs metrics, evals, checkpoints."""
+    cfg = _cfg(tmp_path, checkpoint_interval=100)
+    summary = train_anakin(cfg, max_frames=2_000)
+    assert summary["frames"] >= 2_000
+    # replay_ratio 4: ~2000/4 minus warmup
+    assert summary["learn_steps"] > 200
+    assert np.isfinite(summary["eval_score_mean"])
+    metrics_path = os.path.join(cfg.results_dir, cfg.run_id, "metrics.jsonl")
+    rows = [json.loads(l) for l in open(metrics_path)]
+    kinds = {r["kind"] for r in rows}
+    assert "train" in kinds and "eval" in kinds
+    train_rows = [r for r in rows if r["kind"] == "train"]
+    assert all(np.isfinite(r["loss"]) for r in train_rows)
+
+
+def test_anakin_resume_continues_counters(tmp_path):
+    cfg = _cfg(tmp_path, checkpoint_interval=50, snapshot_replay=True)
+    first = train_anakin(cfg, max_frames=1_200)
+    cfg2 = cfg.replace(resume=True)
+    second = train_anakin(cfg2, max_frames=2_400)
+    assert second["frames"] >= 2_400
+    assert second["learn_steps"] > first["learn_steps"]
+    # the resume must have restored the replay snapshot (warm restart):
+    # learn steps continue at the replay_ratio cadence from restored frames
+    assert second["learn_steps"] >= second["frames"] // cfg.replay_ratio - 64
+
+
+@pytest.mark.slow
+def test_anakin_learns_catch(tmp_path):
+    cfg = _cfg(
+        tmp_path,
+        frame_height=80,
+        frame_width=80,
+        hidden_size=128,
+        num_cosines=32,
+        batch_size=32,
+        memory_capacity=8192,
+        learn_start=512,
+        replay_ratio=2,
+        target_update_period=200,
+        eval_episodes=40,
+        seed=7,
+    )
+    summary = train_anakin(cfg, max_frames=4_000)
+    # same bar as the host trainer's catch test (test_train_integration.py)
+    assert summary["eval_score_mean"] > 0.2, summary
+    assert summary["learn_steps"] > 1_500

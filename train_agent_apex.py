@@ -7,6 +7,9 @@ Roles (--role):
   apex     one process driving the whole device mesh: learner cores + actor
            lanes + sharded replay (the TPU-native Ape-X: the pod IS the
            learner and the actor fleet — no Redis, no external processes)
+  anakin   single chip, replay in HBM: the fused sample->learn->write-back
+           graph of replay/device.py, zero per-step host transfer (same
+           algorithm/schedules as `single`; fastest single-chip learner)
 
 The reference selects learner/actor roles per *process* and couples them
 through Redis; here the coupling is XLA collectives + host shared memory, so
@@ -56,10 +59,18 @@ def main(argv=None) -> int:
         from rainbow_iqn_apex_tpu.parallel.apex import train_apex
 
         summary = train_apex(cfg)
+    elif cfg.role == "anakin" and cfg.architecture == "iqn":
+        from rainbow_iqn_apex_tpu.train_anakin import train_anakin
+
+        summary = train_anakin(cfg)
+    elif cfg.role == "anakin":
+        print("--role anakin supports --architecture iqn only (for now)",
+              file=sys.stderr)
+        return 2
     else:
         print(
-            f"unknown --role '{cfg.role}' (want 'single' or 'apex'; the "
-            "reference's separate learner/actor processes are one SPMD "
+            f"unknown --role '{cfg.role}' (want 'single', 'apex' or 'anakin'; "
+            "the reference's separate learner/actor processes are one SPMD "
             "program here)",
             file=sys.stderr,
         )
