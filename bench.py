@@ -107,9 +107,10 @@ def measure() -> None:
     # priority write-back is one XLA graph, so a learn step involves no
     # host->device batch at all.  Measured with sampling + priority
     # write-back INCLUDED, which is what the reference learner's loop does
-    # per step (SURVEY §3.1); the host-feed row above goes to stderr as a
-    # secondary diagnostic.  Skipped on CPU (minutes per step); any failure
-    # falls back to the host-feed row so the driver always gets a number.
+    # per step (SURVEY §3.1).  The host-feed row is printed to STDOUT first
+    # and must stay there: the parent keeps the LAST stdout JSON line and
+    # recovers partial stdout on a watchdog kill, so an emitted host-feed
+    # row survives a hang in this phase.  Skipped on CPU (minutes per step).
     if platform == "cpu":
         print(json.dumps(host_feed_row))
         return

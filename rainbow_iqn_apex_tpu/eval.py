@@ -93,4 +93,8 @@ def evaluate_state(cfg: Config, env, state, seed: int = 0) -> Dict[str, Any]:
     apex driver and the anakin trainer."""
     agent = _cached_eval_agent(cfg, env.num_actions, tuple(env.frame_shape))
     agent.state = jax.device_put(state, jax.local_devices()[0])
+    # fresh key per eval: two evals of the same params draw identical
+    # taus/noise (bit-reproducible curves), as the pre-cache fresh-Agent
+    # construction did
+    agent.key = jax.random.PRNGKey(cfg.seed + 1)
     return evaluate(cfg, agent, seed=seed)

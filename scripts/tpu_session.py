@@ -145,6 +145,21 @@ def main() -> None:
         except Exception as e:
             emit(phase="trace", ok=False, error=repr(e)[:200])
 
+    # ---- phase 3b: device-resident PER learner (the bench headline) ------
+    # A first-ever on-chip compile of the fused sample->learn graph, so it
+    # runs AFTER the trace is safely captured; work is bounded by env knobs
+    # (small ring + few segments) rather than an external kill, keeping the
+    # no-mid-RPC-kill invariant.  bench.py does the full-size measurement.
+    if left() > BUDGET * 0.25:
+        try:
+            import bench as bench_mod
+
+            os.environ.setdefault("BENCH_DR_SEG", "2048")  # 32k-frame ring
+            os.environ.setdefault("BENCH_DR_SEGMENTS", "2")
+            emit(phase="device_replay", **bench_mod._measure_device_replay(cfg, A))
+        except Exception as e:
+            emit(phase="device_replay", error=repr(e)[:200])
+
     # ---- phase 4: pallas sweep (riskiest compile, deliberately last) -----
     if left() > 60:
         try:

@@ -172,10 +172,11 @@ def test_fused_learn_runs_and_updates_priorities():
     from rainbow_iqn_apex_tpu.config import Config
 
     rng = np.random.default_rng(5)
+    # 44x44 frames: the conv trunk's three VALID convs need >= ~44 pixels
     cfg = Config(
         compute_dtype="float32",
-        frame_height=H,
-        frame_width=W,
+        frame_height=44,
+        frame_width=44,
         history_length=HIST,
         hidden_size=32,
         num_cosines=8,
@@ -186,9 +187,6 @@ def test_fused_learn_runs_and_updates_priorities():
         multi_step=NSTEP,
         gamma=GAMMA,
     )
-    # 10x10 frames are below the conv trunk's minimum (three VALID convs);
-    # use the small-arch path via hidden sizing? No: use 44x44 frames.
-    cfg = cfg.replace(frame_height=44, frame_width=44)
     dev = DeviceReplay(
         lanes=L, seg=S, frame_shape=(44, 44), history=HIST,
         n_step=NSTEP, gamma=GAMMA,
