@@ -287,6 +287,117 @@ class DeviceReplay:
         )
 
 
+def _shard_map():
+    """jax.shard_map (stable since jax 0.6; replication checks on — every
+    out_spec below is either shard-varying or provably replicated)."""
+    try:
+        return jax.shard_map
+    except AttributeError:  # pragma: no cover — older jax
+        from jax.experimental.shard_map import shard_map
+
+        return shard_map
+
+
+def build_device_learn_sharded(cfg, num_actions: int, local_replay: DeviceReplay, mesh, axis: str = "dp"):
+    """Multi-chip Anakin: the HBM replay lane-sharded over the mesh's dp axis,
+    the learn step dp-sharded as usual — zero host traffic per step on every
+    chip.
+
+    Scheme (the in-graph twin of the multi-host sharded replay,
+    parallel/multihost.py): each device draws a FIXED batch/n quota from its
+    OWN lane shard — static shapes, no cross-device gathers of frames — which
+    makes global sampling a uniform mixture over shards; IS weights are
+    re-derived from that mixture probability q(i) = prob_local(i)/n and
+    max-normalised across all shards with one tiny pmax collective
+    (`global_is_nq` math).  The gradient all-reduce stays GSPMD-inserted
+    from the batch sharding, exactly as in the host-fed apex learner.
+
+    `local_replay` must be configured with the PER-DEVICE lane count
+    (total_lanes // n_devices); the replay state passed to the returned
+    function is the GLOBAL state, lane-sharded over `axis` (scalars
+    replicated) — see `device_replay_specs`.
+    """
+    from rainbow_iqn_apex_tpu.ops.learn import build_learn_step
+
+    P = jax.sharding.PartitionSpec
+    n_dev = mesh.shape[axis]
+    if cfg.batch_size % n_dev:
+        raise ValueError(f"batch {cfg.batch_size} not divisible by {n_dev} devices")
+    b_loc = cfg.batch_size // n_dev
+    learn_step = build_learn_step(cfg, num_actions)
+    state_spec = device_replay_specs(axis)
+    batch_spec = Batch(
+        obs=P(axis), action=P(axis), reward=P(axis),
+        next_obs=P(axis), discount=P(axis), weight=P(axis),
+    )
+    smap = _shard_map()
+
+    def _draw_assemble(ds_loc, key, beta):
+        k = jax.random.fold_in(key, jax.lax.axis_index(axis))
+        idx = local_replay.draw(ds_loc, k, b_loc)
+        batch, prob = local_replay.assemble(ds_loc, idx, beta)
+        # globally consistent IS weights over the shard mixture
+        n_global = (ds_loc.filled * local_replay.lanes * n_dev).astype(jnp.float32)
+        nq = jnp.maximum(n_global * prob / n_dev, 1e-12)
+        w = nq ** (-beta)
+        w = w / jax.lax.pmax(w.max(), axis)
+        return idx, batch.replace(weight=w)
+
+    def _write_back(ds_loc, idx, td_abs):
+        ds_loc = local_replay.update_priorities(ds_loc, idx, td_abs)
+        # keep the replicated max_priority scalar shard-consistent
+        return ds_loc.replace(
+            max_priority=jax.lax.pmax(ds_loc.max_priority, axis)
+        )
+
+    draw_assemble = smap(
+        _draw_assemble, mesh=mesh,
+        in_specs=(state_spec, P(), P()),
+        out_specs=(P(axis), batch_spec),
+    )
+    write_back = smap(
+        _write_back, mesh=mesh,
+        in_specs=(state_spec, P(axis), P(axis)),
+        out_specs=state_spec,
+    )
+
+    def _check_geometry(replay_state):
+        got = replay_state.frames.shape[0]
+        want = local_replay.lanes * n_dev
+        if got != want:
+            raise ValueError(
+                f"sharded device replay geometry mismatch: global state has "
+                f"{got} lanes but local_replay.lanes ({local_replay.lanes}) x "
+                f"{n_dev} devices = {want}"
+            )
+
+    def fused(train_state, replay_state, key, beta):
+        _check_geometry(replay_state)
+        k_sample, k_learn = jax.random.split(key)
+        idx, batch = draw_assemble(replay_state, k_sample, beta)
+        train_state, info = learn_step(train_state, batch, k_learn)
+        replay_state = write_back(replay_state, idx, info["priorities"])
+        return train_state, replay_state, info
+
+    # exposed for tests: the in-graph per-shard draw with globally corrected
+    # IS weights, without the learn half
+    fused.draw_assemble = lambda replay_state, key, beta: (
+        _check_geometry(replay_state) or draw_assemble(replay_state, key, beta)
+    )
+    return fused
+
+
+def device_replay_specs(axis: str = "dp"):
+    """PartitionSpecs for a lane-sharded DeviceReplayState: every per-lane
+    array sharded on its lane dimension, cursor scalars replicated."""
+    P = jax.sharding.PartitionSpec
+    return DeviceReplayState(
+        frames=P(axis), actions=P(axis), rewards=P(axis),
+        terminals=P(axis), cuts=P(axis), priority=P(axis),
+        pos=P(), filled=P(), max_priority=P(),
+    )
+
+
 def build_device_learn(cfg, num_actions: int, replay: DeviceReplay):
     """The Anakin learner tick: sample -> learn -> priority write-back as ONE
     jittable pure function (train_state, replay_state, key, beta) ->

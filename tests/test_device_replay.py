@@ -213,3 +213,156 @@ def test_fused_learn_runs_and_updates_priorities():
     assert (before != after).any()
     ts, ds, info2 = fused(ts, ds, jax.random.PRNGKey(2), jnp.float32(0.5))
     assert np.isfinite(float(info2["loss"]))
+
+
+class TestShardedDeviceLearn:
+    """Multi-chip Anakin: lane-sharded HBM replay over a dp mesh."""
+
+    N_DEV = 4
+    L_TOT = 4  # one lane per device at N_DEV=4
+
+    def _mesh(self):
+        from jax.sharding import Mesh
+
+        return Mesh(np.array(jax.devices()[: self.N_DEV]), ("dp",))
+
+    def _global_state(self, rng, ticks):
+        """Fill a GLOBAL (unsharded) state — appends never mix lanes, so a
+        global fill equals per-shard fills with the same data."""
+        glob = DeviceReplay(
+            lanes=self.L_TOT, seg=S, frame_shape=(44, 44),
+            history=HIST, n_step=NSTEP, gamma=GAMMA,
+        )
+        ds = glob.init_state()
+        append = jax.jit(glob.append)
+        Lt = self.L_TOT
+        for _ in range(ticks):
+            ds = append(
+                ds,
+                jnp.asarray(rng.integers(0, 255, (Lt, 44, 44), dtype=np.uint8)),
+                jnp.asarray(rng.integers(0, 4, Lt).astype(np.int32)),
+                jnp.asarray(rng.normal(size=Lt).astype(np.float32)),
+                jnp.asarray(rng.random(Lt) < 0.05),
+                jnp.asarray(np.zeros(Lt, bool)),
+                jnp.asarray(rng.random(Lt).astype(np.float32) + 0.05),
+            )
+        return glob, ds
+
+    def test_sharded_fused_learn_matches_global_semantics(self):
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        from rainbow_iqn_apex_tpu.config import Config
+        from rainbow_iqn_apex_tpu.ops.learn import init_train_state
+        from rainbow_iqn_apex_tpu.replay.device import (
+            build_device_learn_sharded,
+            device_replay_specs,
+        )
+
+        mesh = self._mesh()
+        cfg = Config(
+            compute_dtype="float32", frame_height=44, frame_width=44,
+            history_length=HIST, hidden_size=32, num_cosines=8,
+            num_tau_samples=4, num_tau_prime_samples=4,
+            num_quantile_samples=2, batch_size=8, multi_step=NSTEP,
+            gamma=GAMMA,
+        )
+        rng = np.random.default_rng(11)
+        glob, ds = self._global_state(rng, 2 * S)
+        specs = device_replay_specs("dp")
+        ds_sharded = jax.device_put(
+            ds, jax.tree.map(lambda s: NamedSharding(mesh, s), specs,
+                             is_leaf=lambda x: isinstance(x, P)),
+        )
+        local = DeviceReplay(
+            lanes=self.L_TOT // self.N_DEV, seg=S, frame_shape=(44, 44),
+            history=HIST, n_step=NSTEP, gamma=GAMMA,
+        )
+        ts = jax.device_put(
+            init_train_state(cfg, 4, jax.random.PRNGKey(0)),
+            NamedSharding(mesh, P()),
+        )
+        fused = jax.jit(
+            build_device_learn_sharded(cfg, 4, local, mesh),
+            donate_argnums=(0, 1),
+        )
+        before = np.asarray(ds.priority).copy()
+        ts, ds_sharded, info = fused(
+            ts, ds_sharded, jax.random.PRNGKey(3), jnp.float32(0.5)
+        )
+        assert np.isfinite(float(info["loss"]))
+        after = np.asarray(ds_sharded.priority)
+        # every shard wrote SOME priorities (fixed per-device quota of 2)
+        Lloc_S = (self.L_TOT // self.N_DEV) * S
+        changed = before != after
+        for k in range(self.N_DEV):
+            assert changed[k * Lloc_S : (k + 1) * Lloc_S].any(), f"shard {k}"
+        # weights were globally max-normalised: global max == 1
+        # (re-derive: run a second step and inspect via the info dict's loss
+        # finiteness; the direct weight check needs the batch, so instead
+        # assert the max_priority scalar stayed shard-consistent/replicated)
+        assert np.isfinite(float(ds_sharded.max_priority))
+        ts, ds_sharded, info2 = fused(
+            ts, ds_sharded, jax.random.PRNGKey(4), jnp.float32(0.5)
+        )
+        assert np.isfinite(float(info2["loss"]))
+
+    def test_sharded_is_weights_match_multihost_math(self):
+        """The builder's in-graph IS correction must equal the multihost
+        formula (global_is_nq + global max-normalisation) computed
+        independently on host-carved shards with the same draw keys."""
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        from rainbow_iqn_apex_tpu.config import Config
+        from rainbow_iqn_apex_tpu.replay.device import (
+            build_device_learn_sharded,
+            device_replay_specs,
+        )
+
+        mesh = self._mesh()
+        rng = np.random.default_rng(12)
+        glob, ds = self._global_state(rng, 2 * S)
+        n_dev, beta = self.N_DEV, 0.7
+        Lloc = self.L_TOT // n_dev
+        local = DeviceReplay(
+            lanes=Lloc, seg=S, frame_shape=(44, 44),
+            history=HIST, n_step=NSTEP, gamma=GAMMA,
+        )
+        cfg = Config(
+            compute_dtype="float32", frame_height=44, frame_width=44,
+            history_length=HIST, hidden_size=32, num_cosines=8,
+            num_tau_samples=4, num_tau_prime_samples=4,
+            num_quantile_samples=2, batch_size=2 * n_dev, multi_step=NSTEP,
+            gamma=GAMMA,
+        )
+        fused = build_device_learn_sharded(cfg, 4, local, mesh)
+
+        # --- the REAL in-graph path -----------------------------------
+        specs = device_replay_specs("dp")
+        ds_sharded = jax.device_put(
+            ds, jax.tree.map(lambda s: NamedSharding(mesh, s), specs,
+                             is_leaf=lambda x: isinstance(x, P)),
+        )
+        key = jax.random.PRNGKey(9)
+        _idx, batch = fused.draw_assemble(ds_sharded, key, jnp.float32(beta))
+        got_w = np.asarray(batch.weight)
+
+        # --- independent host-side recomputation ----------------------
+        probs = []
+        for k in range(n_dev):
+            lo, hi = k * Lloc, (k + 1) * Lloc
+            ds_loc = DeviceReplayState(
+                frames=ds.frames[lo:hi], actions=ds.actions[lo:hi],
+                rewards=ds.rewards[lo:hi], terminals=ds.terminals[lo:hi],
+                cuts=ds.cuts[lo:hi], priority=ds.priority[lo * S : hi * S],
+                pos=ds.pos, filled=ds.filled, max_priority=ds.max_priority,
+            )
+            kk = jax.random.fold_in(key, k)
+            idx = local.draw(ds_loc, kk, cfg.batch_size // n_dev)
+            _b, prob = local.assemble(ds_loc, idx, jnp.float32(beta))
+            probs.append(np.asarray(prob))
+        probs = np.concatenate(probs)
+        n_global = int(ds.filled) * self.L_TOT
+        nq = np.maximum(n_global * probs / n_dev, 1e-12)
+        w_expected = nq ** (-beta)
+        w_expected = w_expected / w_expected.max()
+        np.testing.assert_allclose(got_w, w_expected, rtol=1e-5)
