@@ -21,10 +21,16 @@ reference class (SURVEY.md §6, RECON) is ~75 learn-steps/s for a Rainbow-IQN
 GPU learner of that era, so vs_baseline = steps_per_sec / 75.  Re-verify when
 reference numbers become available (SURVEY.md §8 item 6).
 
-Robustness: the TPU relay in this sandbox admits one claim and can wedge
-(see .claude/skills/verify/SKILL.md), so the measurement runs in a child
-process under a watchdog; if the device path never comes up, a CPU fallback
-provides a (clearly labelled) number rather than no output.
+Robustness: the TPU relay in this sandbox admits one claim and wedges when a
+client holding the claim is killed mid-RPC (see
+.claude/skills/verify/SKILL.md; both round-1 and round-2 wedges happened that
+way).  The measurement therefore runs in a child process that enforces a SOFT
+internal budget — checked between device calls — and always exits cleanly,
+releasing the claim.  The parent's hard watchdog is only a backstop for a
+child that is truly hung (i.e. the relay was already dead), and each finished
+row is flushed immediately so a late hang can never discard an earlier
+measurement.  If the device path never comes up, a CPU fallback provides a
+(clearly labelled) number rather than no output.
 """
 
 import functools
@@ -35,10 +41,31 @@ import sys
 import time
 
 WATCHDOG_SECS = int(os.environ.get("BENCH_WATCHDOG_SECS", "480"))
+# the child gives up (cleanly) well before the parent would kill it; clamped
+# so an override can never put the soft budget past the hard watchdog
+_margin = min(30.0, WATCHDOG_SECS * 0.28)  # scales down for small watchdogs
+_override = os.environ.get("BENCH_CHILD_BUDGET_SECS")
+_child_budget = float(_override) if _override else WATCHDOG_SECS * 0.72
+CHILD_BUDGET_SECS = min(_child_budget, WATCHDOG_SECS - _margin)
+if _override and CHILD_BUDGET_SECS < _child_budget:
+    print(
+        f"bench: BENCH_CHILD_BUDGET_SECS={_child_budget:.0f} clamped to "
+        f"{CHILD_BUDGET_SECS:.0f} (watchdog {WATCHDOG_SECS}s minus margin)",
+        file=sys.stderr,
+    )
 
 
 def measure() -> None:
-    """Child-process body: measure on whatever device jax gives us."""
+    """Child-process body: measure on whatever device jax gives us.
+
+    Soft-deadline discipline: every loop that issues device calls checks the
+    remaining budget between calls and bails out early, keeping whatever it
+    measured, so this process always exits on its own."""
+    t_start = time.monotonic()
+
+    def left() -> float:
+        return CHILD_BUDGET_SECS - (time.monotonic() - t_start)
+
     import jax
     import numpy as np
 
@@ -51,6 +78,8 @@ def measure() -> None:
     )
 
     platform = jax.devices()[0].platform
+    print(f"bench child: platform={platform} t_import={time.monotonic()-t_start:.1f}s",
+          file=sys.stderr, flush=True)
     cfg = Config()  # reference defaults: 84x84x4, N=N'=64, K=32, batch 32
     num_actions = 18  # SABER full action set
     batch_size = cfg.batch_size
@@ -79,21 +108,33 @@ def measure() -> None:
         state, info = learn(state, batch, k)
         return state, info, key
 
-    for _ in range(3):  # warmup / compile
+    state, info, key = step(state, host_batch(), key)  # compile
+    jax.block_until_ready(info["loss"])
+    print(f"bench child: learn compiled t={time.monotonic()-t_start:.1f}s",
+          file=sys.stderr, flush=True)
+    for _ in range(2):  # warmup
         state, info, key = step(state, host_batch(), key)
     jax.block_until_ready(info["loss"])
 
     # CPU fallback exists to always give the driver a labelled row, not to
     # stress the host: keep it short enough to fit inside the watchdog.
-    iters = 300 if platform != "cpu" else 8
+    # budget checks must observe DEVICE time, not dispatch time (jit calls
+    # are async), so sync every chunk before consulting the clock
+    # chunk large enough that the per-chunk sync RTT stays negligible next
+    # to the chunk's device time (3 syncs over 300 iters)
+    max_iters = 300 if platform != "cpu" else 8
+    chunk = 100 if platform != "cpu" else 2
     batches = [host_batch() for _ in range(8)]
     t0 = time.perf_counter()
-    for i in range(iters):
-        state, info, key = step(state, batches[i % 8], key)
-    jax.block_until_ready(info["loss"])
+    n = 0
+    while n < max_iters and (n < 1 or left() > CHILD_BUDGET_SECS * 0.5):
+        for _ in range(chunk):
+            state, info, key = step(state, batches[n % 8], key)
+            n += 1
+        jax.block_until_ready(info["loss"])
     dt = time.perf_counter() - t0
 
-    steps_per_sec = iters / dt
+    steps_per_sec = n / dt
     host_feed_row = {
         "metric": "iqn_learner_steps_per_sec_atari_shape",
         "value": round(steps_per_sec, 2),
@@ -118,19 +159,30 @@ def measure() -> None:
     # LAST parseable stdout line, and recovers partial stdout on a watchdog
     # kill) so a hang in the device-replay phase can never discard it
     print(json.dumps(host_feed_row), flush=True)
+    if left() < CHILD_BUDGET_SECS * 0.35:
+        print(f"bench child: skipping device-replay phase, {left():.0f}s left",
+              file=sys.stderr, flush=True)
+        return
     try:
-        device_row = _measure_device_replay(cfg, num_actions)
-        print(json.dumps(device_row), flush=True)
+        device_row = _measure_device_replay(cfg, num_actions, left)
+        if device_row is not None:
+            print(json.dumps(device_row), flush=True)
     except Exception as e:  # noqa: BLE001 — never lose the bench row
         print(f"device-replay bench failed, host-feed row kept: {e!r}",
               file=sys.stderr)
 
 
-def _measure_device_replay(cfg, num_actions: int) -> dict:
+def _measure_device_replay(cfg, num_actions: int, left=None) -> dict | None:
     """Fused on-device PER learner at the reference Atari workload: 100k-frame
     HBM ring (16 lanes), prefilled in-graph by a lax.scan of appends (no host
     traffic), then timed over jitted 50-step lax.scan segments of the
-    sample->learn->update tick."""
+    sample->learn->update tick.
+
+    ``left`` (remaining soft-budget seconds) is checked between device calls;
+    when it runs out the phase returns what it has (or None before the first
+    timed segment) instead of being killed mid-RPC."""
+    if left is None:
+        left = lambda: float("inf")  # noqa: E731
     import jax
     import jax.numpy as jnp
 
@@ -169,6 +221,12 @@ def _measure_device_replay(cfg, num_actions: int) -> dict:
 
     ds = prefill(replay.init_state(), jax.random.PRNGKey(7))
     jax.block_until_ready(ds.priority)
+    print(f"bench child: device replay prefilled, {left():.0f}s left",
+          file=sys.stderr, flush=True)
+    if left() < 60:  # segment compile + first run still ahead
+        print("bench child: budget exhausted after prefill, skipping",
+              file=sys.stderr, flush=True)
+        return None
 
     ts = init_train_state(cfg, num_actions, jax.random.PRNGKey(0))
     fused = build_device_learn(cfg, num_actions, replay)
@@ -188,12 +246,22 @@ def _measure_device_replay(cfg, num_actions: int) -> dict:
     key, k = jax.random.split(key)
     ts, ds, last = segment(ts, ds, k)  # compile + warm
     jax.block_until_ready(last)
-    segments = int(os.environ.get("BENCH_DR_SEGMENTS", "8"))
+    print(f"bench child: fused segment compiled, {left():.0f}s left",
+          file=sys.stderr, flush=True)
+    if left() < 20:
+        print("bench child: budget exhausted after segment compile, skipping",
+              file=sys.stderr, flush=True)
+        return None
+    max_segments = int(os.environ.get("BENCH_DR_SEGMENTS", "8"))
     t0 = time.perf_counter()
-    for _ in range(segments):
+    segments = 0
+    while segments < max_segments and (segments < 1 or left() > 20):
         key, k = jax.random.split(key)
         ts, ds, last = segment(ts, ds, k)
-    jax.block_until_ready(last)
+        # sync before the budget check: dispatch is async, only device
+        # completion spends real time (donation serialises segments anyway)
+        jax.block_until_ready(last)
+        segments += 1
     dt = time.perf_counter() - t0
     sps = segments * SCAN / dt
     platform = jax.devices()[0].platform
@@ -233,8 +301,15 @@ def main() -> None:
             out = p.stdout
         except subprocess.TimeoutExpired as te:
             # keep any measurement the child completed before the watchdog
-            # fired (the child prints each finished row immediately)
-            print("bench child timed out", file=sys.stderr)
+            # fired (the child prints each finished row immediately); the
+            # child self-budgets and exits cleanly, so reaching this point
+            # means it was truly hung (relay dead) — surface its progress log
+            err = te.stderr or b""
+            if isinstance(err, bytes):
+                err = err.decode(errors="replace")
+            tail = "\n".join(err.strip().splitlines()[-10:])
+            print(f"bench child timed out; child stderr tail:\n{tail}",
+                  file=sys.stderr)
             out = te.stdout or b""
             if isinstance(out, bytes):
                 out = out.decode(errors="replace")

@@ -220,10 +220,20 @@ class DeviceReplay:
         ).astype(jnp.int32)
 
     def assemble(
-        self, state: DeviceReplayState, idx: jnp.ndarray, beta: jnp.ndarray
+        self,
+        state: DeviceReplayState,
+        idx: jnp.ndarray,
+        beta: jnp.ndarray,
+        *,
+        with_weight: bool = True,
     ) -> Tuple[Batch, jnp.ndarray]:
         """n-step assembly + stack gathers + IS weights at given global slot
-        ids.  Returns (Batch, prob [B])."""
+        ids.  Returns (Batch, prob [B]).
+
+        ``with_weight=False`` skips the locally max-normalised IS weight
+        (batch.weight comes back as ones) for callers that derive a globally
+        consistent weight from ``prob`` instead — the sharded learner's
+        pmax-normalised mixture formula (build_device_learn_sharded)."""
         B, S, n = idx.shape[0], self.seg, self.n_step
         p = state.priority
         total = p.sum()
@@ -245,9 +255,12 @@ class DeviceReplay:
         obs = self._gather_stacks(state, lane, off)
         next_obs = self._gather_stacks(state, lane, (off + n) % S)
 
-        n_stored = (state.filled * self.lanes).astype(jnp.float32)
-        w = (n_stored * prob) ** (-beta)
-        weight = w / w.max()
+        if with_weight:
+            n_stored = (state.filled * self.lanes).astype(jnp.float32)
+            w = (n_stored * prob) ** (-beta)
+            weight = w / w.max()
+        else:
+            weight = jnp.ones_like(prob)
 
         batch = Batch(
             obs=obs,
@@ -335,7 +348,7 @@ def build_device_learn_sharded(cfg, num_actions: int, local_replay: DeviceReplay
     def _draw_assemble(ds_loc, key, beta):
         k = jax.random.fold_in(key, jax.lax.axis_index(axis))
         idx = local_replay.draw(ds_loc, k, b_loc)
-        batch, prob = local_replay.assemble(ds_loc, idx, beta)
+        batch, prob = local_replay.assemble(ds_loc, idx, beta, with_weight=False)
         # globally consistent IS weights over the shard mixture
         n_global = (ds_loc.filled * local_replay.lanes * n_dev).astype(jnp.float32)
         nq = jnp.maximum(n_global * prob / n_dev, 1e-12)
@@ -370,6 +383,14 @@ def build_device_learn_sharded(cfg, num_actions: int, local_replay: DeviceReplay
                 f"{got} lanes but local_replay.lanes ({local_replay.lanes}) x "
                 f"{n_dev} devices = {want}"
             )
+        got_seg = replay_state.frames.shape[1]
+        if got_seg != local_replay.seg:
+            # a seg mismatch would silently mis-decode lane = idx // seg
+            # (gather clamps instead of erroring), so refuse loudly
+            raise ValueError(
+                f"sharded device replay geometry mismatch: global state has "
+                f"seg={got_seg} but local_replay.seg={local_replay.seg}"
+            )
 
     def fused(train_state, replay_state, key, beta):
         _check_geometry(replay_state)
@@ -395,6 +416,19 @@ def device_replay_specs(axis: str = "dp"):
         frames=P(axis), actions=P(axis), rewards=P(axis),
         terminals=P(axis), cuts=P(axis), priority=P(axis),
         pos=P(), filled=P(), max_priority=P(),
+    )
+
+
+def device_replay_shardings(mesh, axis: str = "dp"):
+    """NamedShardings for placing a global DeviceReplayState on `mesh`:
+    `jax.device_put(state, device_replay_shardings(mesh))`.  Wraps
+    device_replay_specs in the tree-map callers would otherwise have to
+    repeat (PartitionSpec is itself a pytree, hence the is_leaf guard)."""
+    P = jax.sharding.PartitionSpec
+    return jax.tree.map(
+        lambda s: jax.sharding.NamedSharding(mesh, s),
+        device_replay_specs(axis),
+        is_leaf=lambda x: isinstance(x, P),
     )
 
 

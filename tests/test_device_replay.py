@@ -255,7 +255,7 @@ class TestShardedDeviceLearn:
         from rainbow_iqn_apex_tpu.ops.learn import init_train_state
         from rainbow_iqn_apex_tpu.replay.device import (
             build_device_learn_sharded,
-            device_replay_specs,
+            device_replay_shardings,
         )
 
         mesh = self._mesh()
@@ -268,11 +268,7 @@ class TestShardedDeviceLearn:
         )
         rng = np.random.default_rng(11)
         glob, ds = self._global_state(rng, 2 * S)
-        specs = device_replay_specs("dp")
-        ds_sharded = jax.device_put(
-            ds, jax.tree.map(lambda s: NamedSharding(mesh, s), specs,
-                             is_leaf=lambda x: isinstance(x, P)),
-        )
+        ds_sharded = jax.device_put(ds, device_replay_shardings(mesh))
         local = DeviceReplay(
             lanes=self.L_TOT // self.N_DEV, seg=S, frame_shape=(44, 44),
             history=HIST, n_step=NSTEP, gamma=GAMMA,
@@ -296,10 +292,9 @@ class TestShardedDeviceLearn:
         changed = before != after
         for k in range(self.N_DEV):
             assert changed[k * Lloc_S : (k + 1) * Lloc_S].any(), f"shard {k}"
-        # weights were globally max-normalised: global max == 1
-        # (re-derive: run a second step and inspect via the info dict's loss
-        # finiteness; the direct weight check needs the batch, so instead
-        # assert the max_priority scalar stayed shard-consistent/replicated)
+        # max_priority scalar stayed finite (shard-consistency is pinned by
+        # its replicated out-spec; the global max==1 weight normalisation is
+        # pinned by test_sharded_is_weights_match_multihost_math)
         assert np.isfinite(float(ds_sharded.max_priority))
         ts, ds_sharded, info2 = fused(
             ts, ds_sharded, jax.random.PRNGKey(4), jnp.float32(0.5)
@@ -310,12 +305,10 @@ class TestShardedDeviceLearn:
         """The builder's in-graph IS correction must equal the multihost
         formula (global_is_nq + global max-normalisation) computed
         independently on host-carved shards with the same draw keys."""
-        from jax.sharding import NamedSharding, PartitionSpec as P
-
         from rainbow_iqn_apex_tpu.config import Config
         from rainbow_iqn_apex_tpu.replay.device import (
             build_device_learn_sharded,
-            device_replay_specs,
+            device_replay_shardings,
         )
 
         mesh = self._mesh()
@@ -337,11 +330,7 @@ class TestShardedDeviceLearn:
         fused = build_device_learn_sharded(cfg, 4, local, mesh)
 
         # --- the REAL in-graph path -----------------------------------
-        specs = device_replay_specs("dp")
-        ds_sharded = jax.device_put(
-            ds, jax.tree.map(lambda s: NamedSharding(mesh, s), specs,
-                             is_leaf=lambda x: isinstance(x, P)),
-        )
+        ds_sharded = jax.device_put(ds, device_replay_shardings(mesh))
         key = jax.random.PRNGKey(9)
         _idx, batch = fused.draw_assemble(ds_sharded, key, jnp.float32(beta))
         got_w = np.asarray(batch.weight)
