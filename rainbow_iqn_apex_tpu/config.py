@@ -99,6 +99,10 @@ class Config:
     # device (ship one [L,H,W] frame/tick, shift+reset inside the jitted act
     # step) instead of host-side FrameStacker shifting — 4x less transfer
     # and no strided host copy; bit-identical stacks (tested)
+    fused_env: bool = True  # anakin + jaxgame:* envs: compile the env INTO
+    # the act->append->learn graph (zero per-tick host traffic); turn off to
+    # drive jax games through the host loop instead
+    anakin_segment_ticks: int = 64  # env ticks per fused-graph dispatch
     pipelined_actor: bool = False  # overlap device inference with env stepping
     # (one-tick action lag: the action executed at tick t was computed from
     # the observation at t-1 — Podracer/SEED-style; replay stores the action

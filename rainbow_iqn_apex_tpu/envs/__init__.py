@@ -9,6 +9,10 @@ def make_env(env_id: str, seed: int = 0, **kwargs) -> Env:
     kind, _, name = env_id.partition(":")
     if kind == "toy":
         return make_toy_env(name, seed=seed)
+    if kind == "jaxgame":
+        from rainbow_iqn_apex_tpu.envs.device_games import JaxGameEnv
+
+        return JaxGameEnv(name, seed=seed)
     if kind == "atari":
         return make_atari_env(name, seed=seed, **kwargs)
     if kind == "gym":
@@ -20,7 +24,8 @@ def make_env(env_id: str, seed: int = 0, **kwargs) -> Env:
 
         return make_procgen_env(name, seed=seed, **kwargs)
     raise ValueError(
-        f"unknown env id '{env_id}' (want 'toy:', 'atari:', 'gym:' or 'procgen:')"
+        f"unknown env id '{env_id}' "
+        "(want 'toy:', 'jaxgame:', 'atari:', 'gym:' or 'procgen:')"
     )
 
 

@@ -1,0 +1,575 @@
+"""Pure-JAX games: Atari-class dynamics that run INSIDE the XLA graph.
+
+Why this exists: the reference's env layer is ALE behind atari-py (SURVEY.md
+§2 row 2) — host-side C++ that caps every TPU design at the host->device
+frame-transfer rate.  These games keep the reference's observation contract
+(uint8 single-channel frames, small discrete action set, clipped-scale
+rewards, episodic terminals + time-limit truncations) but are written as pure
+jittable functions of (state, action, key), so they can be:
+
+  * vmapped over lanes  -> one [L, H, W] frame tensor per tick, on device;
+  * fused into the Anakin trainer's act->step->append->learn graph
+    (train_anakin.py), eliminating host traffic entirely — the full Podracer
+    "everything on chip" topology the reference's Redis loop cannot express;
+  * driven from the host through the ordinary `Env` adapter (JaxGameEnv) so
+    every trainer/eval path runs them unchanged.
+
+Dynamics are in the MinAtar family (Young & Tian, arXiv:1903.03176 — cited
+as the public spec these games follow; implementations here are original):
+10x10 logic grids, one entity class per game mechanic, rendered by intensity
+so a frame-stacking conv agent must learn motion.  Design rules for TPU:
+static shapes everywhere, no data-dependent Python control flow (jnp.where
+only), randomness through explicit keys, state as a NamedTuple of arrays.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from rainbow_iqn_apex_tpu.envs.base import Env, TimeStep
+
+G = 10  # logic grid is GxG for every game
+
+# render intensities (distinct so the conv net can tell entities apart)
+I_PLAYER = jnp.uint8(140)
+I_BALL = jnp.uint8(255)
+I_BRICK = jnp.uint8(90)
+I_ENEMY = jnp.uint8(200)
+I_GOLD = jnp.uint8(255)
+I_BULLET = jnp.uint8(255)
+
+
+def _upscale(grid: jnp.ndarray, cell: int) -> jnp.ndarray:
+    """[G, G] u8 -> [G*cell, G*cell] u8 (nearest-neighbour)."""
+    return jnp.repeat(jnp.repeat(grid, cell, axis=0), cell, axis=1)
+
+
+class DeviceGame:
+    """Base: a pure-functional game.  Subclasses define init/step/render as
+    jit-safe single-instance functions; batching is the caller's vmap."""
+
+    num_actions: int
+    # frame = (G*cell, G*cell).  cell=8 -> 80x80: the canonical DQN trunk
+    # reduces that to a 6x6 feature grid; at cell=5 (50x50) the final grid is
+    # only 2x2, too coarse to localise entities (measured: catch learns ~3x
+    # slower at 50x50 than at 80x80 on both the host and fused trainers).
+    cell: int = 8
+
+    @property
+    def frame_shape(self) -> Tuple[int, int]:
+        return (G * self.cell, G * self.cell)
+
+    def init(self, key):  # -> state
+        raise NotImplementedError
+
+    def step(self, state, action, key):  # -> (state, reward f32, term bool, trunc bool)
+        raise NotImplementedError
+
+    def render(self, state) -> jnp.ndarray:  # -> [H, W] uint8
+        raise NotImplementedError
+
+
+# --------------------------------------------------------------------------
+# Catch — the learnability anchor (same rules as envs/toy.py CatchEnv)
+# --------------------------------------------------------------------------
+
+
+class CatchState(NamedTuple):
+    ball_r: jnp.ndarray  # i32 scalar
+    ball_c: jnp.ndarray
+    paddle: jnp.ndarray
+    t: jnp.ndarray
+
+
+class CatchGame(DeviceGame):
+    """Ball falls straight down; catch it with the bottom paddle.
+    Actions: 0=stay 1=left 2=right.  +1 catch / -1 miss, episode ends at the
+    bottom row — the in-graph twin of toy.py's CatchEnv (SURVEY §4 Pong-role)."""
+
+    num_actions = 3
+
+    def init(self, key) -> CatchState:
+        return CatchState(
+            ball_r=jnp.int32(0),
+            ball_c=jax.random.randint(key, (), 0, G, jnp.int32),
+            paddle=jnp.int32(G // 2),
+            t=jnp.int32(0),
+        )
+
+    def step(self, s: CatchState, action, key):
+        move = jnp.array([0, -1, 1], jnp.int32)[action]
+        paddle = jnp.clip(s.paddle + move, 0, G - 1)
+        ball_r = s.ball_r + 1
+        terminal = ball_r == G - 1
+        reward = jnp.where(
+            terminal, jnp.where(paddle == s.ball_c, 1.0, -1.0), 0.0
+        ).astype(jnp.float32)
+        ns = CatchState(ball_r, s.ball_c, paddle, s.t + 1)
+        return ns, reward, terminal, jnp.bool_(False)
+
+    def render(self, s: CatchState) -> jnp.ndarray:
+        grid = jnp.zeros((G, G), jnp.uint8)
+        grid = grid.at[s.ball_r, s.ball_c].set(I_BALL)
+        grid = grid.at[G - 1, s.paddle].set(I_PLAYER)
+        return _upscale(grid, self.cell)
+
+
+# --------------------------------------------------------------------------
+# Breakout
+# --------------------------------------------------------------------------
+
+
+class BreakoutState(NamedTuple):
+    paddle: jnp.ndarray  # i32 col
+    ball_r: jnp.ndarray
+    ball_c: jnp.ndarray
+    dr: jnp.ndarray  # i32 in {-1, +1}
+    dc: jnp.ndarray
+    bricks: jnp.ndarray  # [G, G] bool (rows 1..3 used)
+    t: jnp.ndarray
+
+
+class BreakoutGame(DeviceGame):
+    """Paddle/ball/brick-wall: +1 per brick, wall respawns when cleared,
+    episode ends when the ball passes the paddle.  Actions: 0=stay 1=left
+    2=right."""
+
+    num_actions = 3
+    BRICK_ROWS = (1, 2, 3)
+
+    def _wall(self) -> jnp.ndarray:
+        bricks = jnp.zeros((G, G), bool)
+        for r in self.BRICK_ROWS:
+            bricks = bricks.at[r].set(True)
+        return bricks
+
+    def init(self, key) -> BreakoutState:
+        kc, kd = jax.random.split(key)
+        return BreakoutState(
+            paddle=jnp.int32(G // 2),
+            ball_r=jnp.int32(4),
+            ball_c=jax.random.randint(kc, (), 0, G, jnp.int32),
+            dr=jnp.int32(1),
+            dc=jnp.where(jax.random.bernoulli(kd), 1, -1).astype(jnp.int32),
+            bricks=self._wall(),
+            t=jnp.int32(0),
+        )
+
+    def step(self, s: BreakoutState, action, key):
+        move = jnp.array([0, -1, 1], jnp.int32)[action]
+        paddle = jnp.clip(s.paddle + move, 0, G - 1)
+
+        # diagonal flight with side/top reflection
+        nc = s.ball_c + s.dc
+        dc = jnp.where((nc < 0) | (nc > G - 1), -s.dc, s.dc)
+        nc = jnp.clip(nc, 0, G - 1)  # reflected into the wall cell it hit
+        nr = s.ball_r + s.dr
+        dr = jnp.where(nr < 0, jnp.int32(1), s.dr)
+        nr = jnp.where(nr < 0, jnp.int32(1), nr)
+
+        # brick hit: clear it, bounce back (ball keeps its old row)
+        nr_idx = jnp.clip(nr, 0, G - 1)
+        hit_brick = s.bricks[nr_idx, nc]
+        bricks = s.bricks.at[nr_idx, nc].set(
+            jnp.where(hit_brick, False, s.bricks[nr_idx, nc])
+        )
+        reward = jnp.where(hit_brick, 1.0, 0.0).astype(jnp.float32)
+        dr = jnp.where(hit_brick, -dr, dr)
+        nr = jnp.where(hit_brick, s.ball_r, nr)
+
+        # paddle plane: bounce if aligned, lose otherwise
+        at_bottom = nr >= G - 1
+        caught = at_bottom & (nc == paddle)
+        dr = jnp.where(caught, jnp.int32(-1), dr)
+        nr = jnp.where(caught, jnp.int32(G - 2), nr)
+        terminal = at_bottom & ~caught
+
+        # cleared wall respawns (dense long-horizon reward, like the
+        # reference's multi-life Atari episodes)
+        cleared = ~bricks.any()
+        bricks = jnp.where(cleared, self._wall(), bricks)
+
+        ns = BreakoutState(paddle, nr, nc, dr, dc, bricks, s.t + 1)
+        return ns, reward, terminal, jnp.bool_(False)
+
+    def render(self, s: BreakoutState) -> jnp.ndarray:
+        grid = jnp.where(s.bricks, I_BRICK, jnp.uint8(0)).astype(jnp.uint8)
+        grid = grid.at[s.ball_r, s.ball_c].set(I_BALL)
+        grid = grid.at[G - 1, s.paddle].set(I_PLAYER)
+        return _upscale(grid, self.cell)
+
+
+# --------------------------------------------------------------------------
+# Freeway
+# --------------------------------------------------------------------------
+
+
+class FreewayState(NamedTuple):
+    chicken: jnp.ndarray  # i32 row (col fixed at CHICKEN_COL)
+    cars: jnp.ndarray  # [8] i32 col of the car in lanes rows 1..8
+    t: jnp.ndarray
+
+
+class FreewayGame(DeviceGame):
+    """Cross 8 lanes of traffic: +1 at the top (then restart at the bottom);
+    a collision sends the chicken back down.  No terminal state — episodes
+    end by time-limit truncation (`cap` ticks), exercising the two-channel
+    terminal/truncation replay contract end-to-end."""
+
+    num_actions = 3  # 0=stay 1=up 2=down
+    CHICKEN_COL = 4
+    # per-lane (speed, direction): car advances every `speed` ticks
+    SPEEDS = jnp.array([2, 3, 2, 4, 2, 3, 4, 2], jnp.int32)
+    DIRS = jnp.array([1, -1, 1, -1, -1, 1, -1, 1], jnp.int32)
+
+    def __init__(self, cap: int = 500):
+        self.cap = cap
+
+    def init(self, key) -> FreewayState:
+        return FreewayState(
+            chicken=jnp.int32(G - 1),
+            cars=jax.random.randint(key, (8,), 0, G, jnp.int32),
+            t=jnp.int32(0),
+        )
+
+    def step(self, s: FreewayState, action, key):
+        move = jnp.array([0, -1, 1], jnp.int32)[action]
+        chicken = jnp.clip(s.chicken + move, 0, G - 1)
+
+        advance = (s.t % self.SPEEDS) == 0
+        cars = (s.cars + jnp.where(advance, self.DIRS, 0)) % G
+
+        # lanes are rows 1..8; car in the chicken's row at the chicken's col?
+        lane = chicken - 1  # -1 or 8+ when off the road
+        on_road = (lane >= 0) & (lane < 8)
+        car_col = cars[jnp.clip(lane, 0, 7)]
+        hit = on_road & (car_col == self.CHICKEN_COL)
+        chicken = jnp.where(hit, jnp.int32(G - 1), chicken)
+
+        scored = chicken == 0
+        reward = jnp.where(scored, 1.0, 0.0).astype(jnp.float32)
+        chicken = jnp.where(scored, jnp.int32(G - 1), chicken)
+
+        t = s.t + 1
+        trunc = t >= self.cap
+        ns = FreewayState(chicken, cars, t)
+        return ns, reward, jnp.bool_(False), trunc
+
+    def render(self, s: FreewayState) -> jnp.ndarray:
+        grid = jnp.zeros((G, G), jnp.uint8)
+        grid = grid.at[jnp.arange(1, 9), s.cars].set(I_ENEMY)
+        grid = grid.at[s.chicken, self.CHICKEN_COL].set(I_PLAYER)
+        return _upscale(grid, self.cell)
+
+
+# --------------------------------------------------------------------------
+# Asterix
+# --------------------------------------------------------------------------
+
+
+class AsterixState(NamedTuple):
+    pr: jnp.ndarray  # player row/col, i32
+    pc: jnp.ndarray
+    active: jnp.ndarray  # [8] bool — one entity per lane (rows 1..8)
+    col: jnp.ndarray  # [8] i32
+    dirn: jnp.ndarray  # [8] i32 in {-1, +1}
+    gold: jnp.ndarray  # [8] bool — collectible vs lethal
+    t: jnp.ndarray
+
+
+class AsterixGame(DeviceGame):
+    """Dodge enemies, collect gold.  Entities stream through 8 lanes; walking
+    into gold is +1, into an enemy is death.  Actions: 0=stay 1=left 2=right
+    3=up 4=down (player confined to the road rows 1..8)."""
+
+    num_actions = 5
+    SPAWN_P = 0.25  # per empty lane per tick
+    MOVE_EVERY = 2  # entities advance every 2nd tick
+
+    def init(self, key) -> AsterixState:
+        return AsterixState(
+            pr=jnp.int32(G // 2),
+            pc=jnp.int32(G // 2),
+            active=jnp.zeros(8, bool),
+            col=jnp.zeros(8, jnp.int32),
+            dirn=jnp.ones(8, jnp.int32),
+            gold=jnp.zeros(8, bool),
+            t=jnp.int32(0),
+        )
+
+    def step(self, s: AsterixState, action, key):
+        k_spawn, k_dir, k_gold = jax.random.split(key, 3)
+        dmove = jnp.array([[0, 0], [0, -1], [0, 1], [-1, 0], [1, 0]], jnp.int32)
+        pr = jnp.clip(s.pr + dmove[action, 0], 1, 8)
+        pc = jnp.clip(s.pc + dmove[action, 1], 0, G - 1)
+
+        # advance entities on their beat; deactivate on exit
+        advance = s.active & ((s.t % self.MOVE_EVERY) == 0)
+        col = s.col + jnp.where(advance, s.dirn, 0)
+        exited = (col < 0) | (col > G - 1)
+        active = s.active & ~exited
+        col = jnp.clip(col, 0, G - 1)
+
+        # spawn into empty lanes (left edge moving right / right edge moving
+        # left), 1-in-3 gold — MinAtar's treasure ratio
+        spawn = (~active) & (jax.random.uniform(k_spawn, (8,)) < self.SPAWN_P)
+        new_dir = jnp.where(jax.random.bernoulli(k_dir, 0.5, (8,)), 1, -1).astype(
+            jnp.int32
+        )
+        new_gold = jax.random.uniform(k_gold, (8,)) < (1.0 / 3.0)
+        dirn = jnp.where(spawn, new_dir, s.dirn)
+        col = jnp.where(spawn, jnp.where(new_dir > 0, 0, G - 1), col)
+        gold = jnp.where(spawn, new_gold, s.gold)
+        active = active | spawn
+
+        # collision in the player's lane
+        lane = pr - 1
+        collide = active[lane] & (col[lane] == pc)
+        hit_gold = collide & gold[lane]
+        terminal = collide & ~gold[lane]
+        reward = jnp.where(hit_gold, 1.0, 0.0).astype(jnp.float32)
+        active = active.at[lane].set(jnp.where(hit_gold, False, active[lane]))
+
+        ns = AsterixState(pr, pc, active, col, dirn, gold, s.t + 1)
+        return ns, reward, terminal, jnp.bool_(False)
+
+    def render(self, s: AsterixState) -> jnp.ndarray:
+        grid = jnp.zeros((G, G), jnp.uint8)
+        lane_rows = jnp.arange(1, 9)
+        val = jnp.where(
+            s.active, jnp.where(s.gold, I_GOLD, I_ENEMY), jnp.uint8(0)
+        ).astype(jnp.uint8)
+        grid = grid.at[lane_rows, s.col].max(val)
+        grid = grid.at[s.pr, s.pc].set(I_PLAYER)
+        return _upscale(grid, self.cell)
+
+
+# --------------------------------------------------------------------------
+# Space Invaders
+# --------------------------------------------------------------------------
+
+
+class InvadersState(NamedTuple):
+    pc: jnp.ndarray  # player col (row G-1), i32
+    aliens: jnp.ndarray  # [G, G] bool (block starts rows 1..4, cols 2..7)
+    adir: jnp.ndarray  # i32 march direction
+    shot_r: jnp.ndarray  # player bullet (-1 row = inactive)
+    shot_c: jnp.ndarray
+    bomb_r: jnp.ndarray  # alien bomb (-1 row = inactive)
+    bomb_c: jnp.ndarray
+    t: jnp.ndarray
+
+
+class InvadersGame(DeviceGame):
+    """March-and-shoot: +1 per alien; death by bomb or by the fleet reaching
+    the bottom row; fleet respawns when cleared.  Actions: 0=stay 1=left
+    2=right 3=fire."""
+
+    num_actions = 4
+    MARCH_EVERY = 4  # fleet advances every 4th tick
+    BOMB_EVERY = 6  # a random front-line alien bombs every 6th tick
+
+    def _fleet(self) -> jnp.ndarray:
+        a = jnp.zeros((G, G), bool)
+        return a.at[1:5, 2:8].set(True)
+
+    def init(self, key) -> InvadersState:
+        return InvadersState(
+            pc=jnp.int32(G // 2),
+            aliens=self._fleet(),
+            adir=jnp.int32(1),
+            shot_r=jnp.int32(-1),
+            shot_c=jnp.int32(0),
+            bomb_r=jnp.int32(-1),
+            bomb_c=jnp.int32(0),
+            t=jnp.int32(0),
+        )
+
+    def step(self, s: InvadersState, action, key):
+        move = jnp.array([0, -1, 1, 0], jnp.int32)[action]
+        pc = jnp.clip(s.pc + move, 0, G - 1)
+
+        # fire: one player bullet in flight at a time
+        fire = (action == 3) & (s.shot_r < 0)
+        shot_r = jnp.where(fire, jnp.int32(G - 2), s.shot_r - (s.shot_r >= 0))
+        shot_c = jnp.where(fire, pc, s.shot_c)
+
+        # bullet hits the alien it flies into
+        shot_live = shot_r >= 0
+        sr = jnp.clip(shot_r, 0, G - 1)
+        hit = shot_live & s.aliens[sr, shot_c]
+        aliens = s.aliens.at[sr, shot_c].set(
+            jnp.where(hit, False, s.aliens[sr, shot_c])
+        )
+        reward = jnp.where(hit, 1.0, 0.0).astype(jnp.float32)
+        shot_r = jnp.where(hit, jnp.int32(-1), shot_r)
+
+        # fleet march: sideways on the beat, down + reverse at an edge
+        march = (s.t % self.MARCH_EVERY) == 0
+        cols_occ = aliens.any(axis=0)
+        leftmost = jnp.argmax(cols_occ)
+        rightmost = G - 1 - jnp.argmax(cols_occ[::-1])
+        at_edge = jnp.where(s.adir > 0, rightmost >= G - 1, leftmost <= 0)
+        drop = march & at_edge & cols_occ.any()
+        shift = march & ~at_edge
+        aliens = jnp.where(drop, jnp.roll(aliens, 1, axis=0), aliens)
+        adir = jnp.where(drop, -s.adir, s.adir)
+        aliens = jnp.where(shift, jnp.roll(aliens, s.adir, axis=1), aliens)
+
+        # bombing: a pseudorandom occupied column releases a bomb from its
+        # lowest alien on the bomb beat
+        bomb_due = ((s.t % self.BOMB_EVERY) == 0) & (s.bomb_r < 0) & aliens.any()
+        occ = aliens.any(axis=0)
+        pick = jax.random.randint(key, (), 0, G, jnp.int32)
+        # nearest occupied column to `pick` (static-shape argmin trick)
+        dist = jnp.where(occ, jnp.abs(jnp.arange(G) - pick), G + 1)
+        bcol = jnp.argmin(dist).astype(jnp.int32)
+        lowest = G - 1 - jnp.argmax(aliens[::-1, bcol]).astype(jnp.int32)
+        bomb_r = jnp.where(bomb_due, lowest + 1, s.bomb_r + (s.bomb_r >= 0))
+        bomb_c = jnp.where(bomb_due, bcol, s.bomb_c)
+        bomb_r = jnp.where(bomb_r > G - 1, jnp.int32(-1), bomb_r)
+
+        # deaths: bomb reaches the player row at the player's col, or the
+        # fleet reaches the bottom row
+        killed = (bomb_r == G - 1) & (bomb_c == pc)
+        terminal = killed | aliens[G - 1].any()
+
+        # cleared fleet respawns
+        cleared = ~aliens.any()
+        aliens = jnp.where(cleared, self._fleet(), aliens)
+
+        ns = InvadersState(pc, aliens, adir, shot_r, shot_c, bomb_r, bomb_c, s.t + 1)
+        return ns, reward, terminal, jnp.bool_(False)
+
+    def render(self, s: InvadersState) -> jnp.ndarray:
+        grid = jnp.where(s.aliens, I_ENEMY, jnp.uint8(0)).astype(jnp.uint8)
+        shot_live = s.shot_r >= 0
+        grid = grid.at[jnp.clip(s.shot_r, 0, G - 1), s.shot_c].max(
+            jnp.where(shot_live, I_BULLET, jnp.uint8(0))
+        )
+        bomb_live = s.bomb_r >= 0
+        grid = grid.at[jnp.clip(s.bomb_r, 0, G - 1), s.bomb_c].max(
+            jnp.where(bomb_live, I_BULLET, jnp.uint8(0))
+        )
+        grid = grid.at[G - 1, s.pc].set(I_PLAYER)
+        return _upscale(grid, self.cell)
+
+
+# --------------------------------------------------------------------------
+# registry + batched auto-reset step (the Anakin building block)
+# --------------------------------------------------------------------------
+
+GAMES = {
+    "catch": CatchGame,
+    "breakout": BreakoutGame,
+    "freeway": FreewayGame,
+    "asterix": AsterixGame,
+    "invaders": InvadersGame,
+}
+
+
+def make_device_game(name: str) -> DeviceGame:
+    try:
+        return GAMES[name]()
+    except KeyError:
+        raise ValueError(
+            f"unknown jax game '{name}' (have: {', '.join(sorted(GAMES))})"
+        ) from None
+
+
+def batched_init(game: DeviceGame, key, lanes: int):
+    """Per-lane independent initial states: [L, ...] state pytree."""
+    return jax.vmap(game.init)(jax.random.split(key, lanes))
+
+
+def batched_reset_step(game: DeviceGame):
+    """Returns step(states, actions, key) -> (states, frames, reward,
+    terminal, truncated, ep_return) for [L]-batched lanes, with auto-reset:
+    on terminal OR truncation the lane's state is re-initialised and the
+    returned frame is the new episode's first observation — the exact
+    VectorEnv.step contract (envs/base.py), in-graph.  ep_return is the
+    completed episode's return on cut ticks and NaN elsewhere; the running
+    accumulator rides in the state pytree via a wrapper field."""
+
+    def one(carry, action, key):
+        state, ep_ret = carry
+        k_step, k_reset = jax.random.split(key)
+        ns, reward, term, trunc = game.step(state, action, k_step)
+        cut = term | trunc
+        ep_ret = ep_ret + reward
+        out_ret = jnp.where(cut, ep_ret, jnp.nan)
+        fresh = game.init(k_reset)
+        ns = jax.tree.map(lambda new, init: jnp.where(cut, init, new), ns, fresh)
+        frame = game.render(ns)
+        ep_ret = jnp.where(cut, 0.0, ep_ret)
+        return (ns, ep_ret), frame, reward, term, trunc & ~term, out_ret
+
+    vone = jax.vmap(one)
+
+    def step(states, ep_rets, actions, key):
+        lanes = actions.shape[0]
+        keys = jax.random.split(key, lanes)
+        (states, ep_rets), frames, reward, term, trunc, out_ret = vone(
+            (states, ep_rets), actions, keys
+        )
+        return states, ep_rets, frames, reward, term, trunc, out_ret
+
+    return step
+
+
+# --------------------------------------------------------------------------
+# host adapter: a DeviceGame as an ordinary Env (works in every trainer)
+# --------------------------------------------------------------------------
+
+
+class JaxGameEnv(Env):
+    """Host-loop adapter.  Heavier per step than a native NumPy env (one
+    jitted dispatch per step) — it exists for eval/CI parity and for running
+    jax games through the host trainers; the fused Anakin path is where
+    these games perform."""
+
+    def __init__(self, name: str, seed: int = 0):
+        self.game = make_device_game(name)
+        self._key = jax.random.PRNGKey(seed)
+        self._step = jax.jit(self.game.step)
+        self._init = jax.jit(self.game.init)
+        self._render = jax.jit(self.game.render)
+        self._state = None
+        self._ret = 0.0
+
+    @property
+    def num_actions(self) -> int:
+        return self.game.num_actions
+
+    @property
+    def frame_shape(self) -> Tuple[int, int]:
+        return self.game.frame_shape
+
+    def _split(self):
+        self._key, k = jax.random.split(self._key)
+        return k
+
+    def reset(self) -> np.ndarray:
+        self._state = self._init(self._split())
+        self._ret = 0.0
+        return np.asarray(self._render(self._state))
+
+    def step(self, action: int) -> TimeStep:
+        self._state, reward, term, trunc = self._step(
+            self._state, jnp.int32(action), self._split()
+        )
+        reward = float(reward)
+        self._ret += reward
+        done = bool(term) or bool(trunc)
+        info = {"episode_return": self._ret} if done else None
+        return TimeStep(
+            np.asarray(self._render(self._state)),
+            reward,
+            bool(term),
+            bool(trunc),
+            info,
+        )

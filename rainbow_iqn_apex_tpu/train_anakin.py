@@ -81,7 +81,12 @@ def _maybe_restore_replay(cfg: Config, ds):
 
 
 def train_anakin(cfg: Config, max_frames: Optional[int] = None) -> Dict[str, Any]:
-    """Runs training; returns a summary dict (final eval, fps, steps)."""
+    """Runs training; returns a summary dict (final eval, fps, steps).
+
+    With a pure-JAX env (`jaxgame:*`) and `fused_env` on, dispatches to the
+    fully fused variant (env compiled into the graph) below."""
+    if cfg.fused_env and cfg.env_id.startswith("jaxgame:"):
+        return train_anakin_fused(cfg, max_frames)
     total_frames = max_frames or cfg.t_max
     lanes = cfg.num_envs_per_actor
     env = make_vector_env(cfg.env_id, lanes, seed=cfg.seed)
@@ -207,3 +212,250 @@ def _eval(cfg: Config, env, ts) -> Dict[str, Any]:
     from rainbow_iqn_apex_tpu.eval import evaluate_state
 
     return evaluate_state(cfg, env, ts, seed=cfg.seed + 977)
+
+
+# ---------------------------------------------------------------------------
+# Fully fused Anakin: the ENV inside the graph (jaxgame:* pure-JAX games)
+# ---------------------------------------------------------------------------
+
+
+def build_fused_segment(cfg: Config, game, replay: DeviceReplay, learn_fn):
+    """The fused Anakin program: a jitted (carry, key) -> (carry, outs)
+    scanning `cfg.anakin_segment_ticks` ticks of
+    act -> env.step -> replay.append -> lax.cond(warm, k x learn).
+
+    carry = (ts, ds, env_states, ep_returns, stack, frame, keep, frames);
+    outs = per-tick (ep_return [L] NaN-except-on-cut, loss/q_mean/grad_norm
+    [learns_per_tick] NaN-when-cold).  `learn_fn` is either the single-chip
+    `build_device_learn` graph or the mesh-sharded
+    `build_device_learn_sharded` one — the tick body is identical, which is
+    what lets the trainer, the multichip dryrun, and the TPU capture harness
+    share this exact program."""
+    from rainbow_iqn_apex_tpu.envs.device_games import batched_reset_step
+
+    lanes = cfg.num_envs_per_actor
+    learns_per_tick = lanes // cfg.replay_ratio
+    seg = replay.seg
+    act_fn = build_act_step(cfg, game.num_actions, use_noise=True)
+    env_step = batched_reset_step(game)
+    bw = cfg.priority_weight
+
+    def tick(carry, k):
+        ts, ds, env_s, ep, stack, frame, keep, frames = carry
+        ka, ks, kl = jax.random.split(k, 3)
+        stack = shift_stack(stack, frame, keep)
+        actions, _q = act_fn(ts.params, stack, ka)
+        env_s, ep, nframe, reward, term, trunc, out_ret = env_step(
+            env_s, ep, actions, ks
+        )
+        # the completed transition, appended the same tick (the host loop's
+        # lag-one bookkeeping exists only because its env stepped off-device)
+        ds = replay.append(ds, frame, actions, reward, term, trunc)
+        frames = frames + lanes
+
+        stored = jnp.minimum(ds.filled, seg) * lanes
+        warm = (stored >= cfg.learn_start) & (ds.filled > cfg.multi_step)
+        beta = jnp.float32(
+            bw + (1.0 - bw) * jnp.minimum(frames / float(cfg.t_max), 1.0)
+        )
+
+        def do_learn(args):
+            ts, ds = args
+
+            def one(c, kk):
+                ts, ds = c
+                ts, ds, info = learn_fn(ts, ds, kk, beta)
+                return (ts, ds), (info["loss"], info["q_mean"], info["grad_norm"])
+
+            (ts, ds), infos = jax.lax.scan(
+                one, (ts, ds), jax.random.split(kl, learns_per_tick)
+            )
+            return ts, ds, infos
+
+        def no_learn(args):
+            ts, ds = args
+            nanv = jnp.full((learns_per_tick,), jnp.nan, jnp.float32)
+            return ts, ds, (nanv, nanv, nanv)
+
+        ts, ds, infos = jax.lax.cond(warm, do_learn, no_learn, (ts, ds))
+        keep = (~(term | trunc)).astype(jnp.uint8)
+        out = (out_ret, infos[0], infos[1], infos[2])
+        return (ts, ds, env_s, ep, stack, nframe, keep, frames), out
+
+    @functools.partial(jax.jit, donate_argnums=(0,))
+    def segment(carry, key):
+        return jax.lax.scan(tick, carry, jax.random.split(key, cfg.anakin_segment_ticks))
+
+    return segment
+
+
+def init_fused_carry(cfg: Config, game, replay: DeviceReplay, ts, ds, key,
+                     frames: int = 0):
+    """Fresh lane states + empty device stack for build_fused_segment."""
+    from rainbow_iqn_apex_tpu.envs.device_games import batched_init
+
+    lanes = cfg.num_envs_per_actor
+    h, w = game.frame_shape
+    env_s = batched_init(game, key, lanes)
+    ep = jnp.zeros(lanes)
+    stack = jnp.zeros((lanes, h, w, cfg.history_length), jnp.uint8)
+    frame = jax.vmap(game.render)(env_s)
+    keep = jnp.ones(lanes, jnp.uint8)
+    return (ts, ds, env_s, ep, stack, frame, keep, jnp.int32(frames))
+
+
+def train_anakin_fused(cfg: Config, max_frames: Optional[int] = None) -> Dict[str, Any]:
+    """Everything on chip: act -> env.step -> replay.append -> (learn x k),
+    scanned over `anakin_segment_ticks` ticks per dispatch.
+
+    This is the Podracer/Anakin topology proper — the reference's whole
+    actor+learner+Redis loop (SURVEY §3.1-3.2) collapses into ONE jitted
+    program; host traffic is a handful of scalars per segment for metrics.
+    Semantics kept from the host anakin path: same IQN learn graph, same
+    max-priority fresh insertion, same two-channel terminal/truncation cuts,
+    same beta anneal (computed in-graph from the frame counter), learning
+    gated in-graph on the same warmness rule.  One deliberate deviation: the
+    learn cadence is `lanes/replay_ratio` steps per tick (lanes must divide
+    by replay_ratio), the in-graph form of `frames // replay_ratio`.
+    """
+    from rainbow_iqn_apex_tpu.envs.device_games import make_device_game
+
+    total_frames = max_frames or cfg.t_max
+    lanes = cfg.num_envs_per_actor
+    if lanes % cfg.replay_ratio:
+        raise ValueError(
+            f"fused anakin needs lanes ({lanes}) divisible by replay_ratio "
+            f"({cfg.replay_ratio}) — the learn cadence is in-graph"
+        )
+    T = cfg.anakin_segment_ticks
+    game = make_device_game(cfg.env_id.split(":", 1)[1])
+    h, w = game.frame_shape
+    if cfg.memory_capacity % lanes:
+        raise ValueError(
+            f"memory capacity {cfg.memory_capacity} not divisible by {lanes} lanes"
+        )
+    seg = cfg.memory_capacity // lanes
+    replay = DeviceReplay(
+        lanes=lanes, seg=seg, frame_shape=(h, w),
+        history=cfg.history_length, n_step=cfg.multi_step, gamma=cfg.gamma,
+        priority_exponent=cfg.priority_exponent, priority_eps=cfg.priority_eps,
+    )
+    key = jax.random.PRNGKey(cfg.seed)
+    key, k_init, k_env = jax.random.split(key, 3)
+    ts = init_train_state(
+        cfg, game.num_actions, k_init, state_shape=(h, w, cfg.history_length)
+    )
+
+    # multi-device: one dp mesh; env lanes + HBM replay lane-sharded over it,
+    # learn dp-sharded with per-shard draws (build_device_learn_sharded) —
+    # the env/act/append half needs no collectives, so GSPMD shards it from
+    # the lane-dim placements alone.  learner_devices follows the config
+    # contract: 0 = all visible devices (anakin has no separate actor mesh).
+    n_dev = cfg.learner_devices if cfg.learner_devices > 0 else len(jax.devices())
+    mesh = None
+    if n_dev > 1:
+        from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+        from rainbow_iqn_apex_tpu.replay.device import (
+            build_device_learn_sharded,
+            device_replay_shardings,
+        )
+
+        if lanes % n_dev or cfg.batch_size % n_dev:
+            raise ValueError(
+                f"fused anakin over {n_dev} devices needs lanes ({lanes}) and "
+                f"batch ({cfg.batch_size}) divisible by the device count"
+            )
+        mesh = Mesh(np.array(jax.devices()[:n_dev]), ("dp",))
+        local_replay = DeviceReplay(
+            lanes=lanes // n_dev, seg=seg, frame_shape=(h, w),
+            history=cfg.history_length, n_step=cfg.multi_step, gamma=cfg.gamma,
+            priority_exponent=cfg.priority_exponent, priority_eps=cfg.priority_eps,
+        )
+        learn_fn = build_device_learn_sharded(cfg, game.num_actions,
+                                              local_replay, mesh)
+        _lane = NamedSharding(mesh, P("dp"))
+        _rep = NamedSharding(mesh, P())
+
+        def place(carry):
+            ts, ds, env_s, ep, stack, frame, keep, frames = carry
+            lane_tree = jax.tree.map(lambda x: jax.device_put(x, _lane),
+                                     (env_s, ep, stack, frame, keep))
+            return (
+                jax.device_put(ts, _rep),
+                jax.device_put(ds, device_replay_shardings(mesh)),
+                *lane_tree,
+                jax.device_put(frames, _rep),
+            )
+    else:
+        learn_fn = build_device_learn(cfg, game.num_actions, replay)
+        place = lambda carry: carry  # noqa: E731
+
+    segment = build_fused_segment(cfg, game, replay, learn_fn)
+
+    run_dir = os.path.join(cfg.results_dir, cfg.run_id)
+    metrics = MetricsLogger(os.path.join(run_dir, "metrics.jsonl"), cfg.run_id)
+    ckpt = Checkpointer(os.path.join(cfg.checkpoint_dir, cfg.run_id))
+
+    frames = 0
+    if cfg.resume and ckpt.latest_step() is not None:
+        ts, extra = ckpt.restore(ts)
+        frames = int(extra.get("frames", 0))
+        metrics.log("resume", step=int(ts.step), frames=frames)
+    learn_steps = int(ts.step)
+    ds = replay.init_state()
+    ds, _ = _maybe_restore_replay(cfg, ds)
+
+    carry = place(init_fused_carry(cfg, game, replay, ts, ds, k_env, frames))
+
+    # eval runs through the host adapter (same game, ordinary Env loop)
+    from rainbow_iqn_apex_tpu.envs import make_env as _make_env
+
+    eval_env = _make_env(cfg.env_id, seed=cfg.seed + 977)
+    returns: collections.deque = collections.deque(maxlen=100)
+
+    def crossed(interval: int, before: int, after: int) -> bool:
+        return interval > 0 and before // interval != after // interval
+
+    while frames < total_frames:
+        key, k = jax.random.split(key)
+        carry, (out_ret, loss, q_mean, grad_norm) = segment(carry, k)
+        ts, ds = carry[0], carry[1]
+        frames += T * lanes
+        prev_steps = learn_steps
+        learn_steps = int(ts.step)  # the in-graph counter is authoritative
+        for r in np.asarray(out_ret)[~np.isnan(np.asarray(out_ret))]:
+            returns.append(float(r))
+
+        if crossed(cfg.metrics_interval, prev_steps, learn_steps):
+            l = np.asarray(loss)
+            metrics.log(
+                "train",
+                step=learn_steps,
+                frames=frames,
+                fps=metrics.fps(frames),
+                loss=float(np.nanmean(l)) if np.any(~np.isnan(l)) else float("nan"),
+                q_mean=float(np.nanmean(np.asarray(q_mean)))
+                if np.any(~np.isnan(np.asarray(q_mean))) else float("nan"),
+                grad_norm=float(np.nanmean(np.asarray(grad_norm)))
+                if np.any(~np.isnan(np.asarray(grad_norm))) else float("nan"),
+                mean_return=float(np.mean(returns)) if returns else float("nan"),
+            )
+        if crossed(cfg.eval_interval, prev_steps, learn_steps):
+            metrics.log("eval", step=learn_steps, **_eval(cfg, eval_env, ts))
+        if crossed(cfg.checkpoint_interval, prev_steps, learn_steps):
+            ckpt.save(learn_steps, ts, {"frames": frames})
+            _save_replay(cfg, ds)
+
+    final_eval = _eval(cfg, eval_env, ts)
+    metrics.log("eval", step=learn_steps, **final_eval)
+    ckpt.save(learn_steps, ts, {"frames": frames})
+    _save_replay(cfg, ds)
+    ckpt.wait()
+    metrics.close()
+    return {
+        "frames": frames,
+        "learn_steps": learn_steps,
+        "train_return_mean": float(np.mean(returns)) if returns else float("nan"),
+        **{f"eval_{k}": v for k, v in final_eval.items()},
+    }

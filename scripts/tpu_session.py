@@ -160,6 +160,75 @@ def main() -> None:
         except Exception as e:
             emit(phase="device_replay", error=repr(e)[:200])
 
+    # ---- phase 3c: fused anakin (env INSIDE the graph) -------------------
+    # jaxgame breakout at the Atari-class 80x80 shape, running the EXACT
+    # program the trainer ships (train_anakin.build_fused_segment): reports
+    # env-frames/s AND learn-steps/s of the single graph.
+    if left() > BUDGET * 0.2:
+        try:
+            import numpy as _np
+
+            from rainbow_iqn_apex_tpu.envs.device_games import make_device_game
+            from rainbow_iqn_apex_tpu.ops.learn import (
+                init_train_state as init_ts2,
+            )
+            from rainbow_iqn_apex_tpu.replay.device import (
+                DeviceReplay,
+                build_device_learn,
+            )
+            from rainbow_iqn_apex_tpu.train_anakin import (
+                build_fused_segment,
+                init_fused_carry,
+            )
+
+            game = make_device_game("breakout")
+            lanes = int(os.environ.get("TPUS_FA_LANES", "16"))
+            T = int(os.environ.get("TPUS_FA_TICKS", "32"))
+            seg_slots = int(os.environ.get("TPUS_FA_SEG", "2048"))
+            h, w = game.frame_shape
+            # low learn_start so the timed segments all take the warm branch
+            # (the trainer's own warm gate, just reached quickly)
+            acfg = cfg.replace(
+                num_envs_per_actor=lanes, anakin_segment_ticks=T,
+                memory_capacity=lanes * seg_slots,
+                learn_start=lanes * (cfg.multi_step + 2), learner_devices=1,
+            )
+            rep = DeviceReplay(
+                lanes=lanes, seg=seg_slots, frame_shape=(h, w),
+                history=acfg.history_length, n_step=acfg.multi_step,
+                gamma=acfg.gamma, priority_exponent=acfg.priority_exponent,
+                priority_eps=acfg.priority_eps,
+            )
+            ts2 = init_ts2(acfg, game.num_actions, jax.random.PRNGKey(0),
+                           state_shape=(h, w, acfg.history_length))
+            segment = build_fused_segment(
+                acfg, game, rep, build_device_learn(acfg, game.num_actions, rep)
+            )
+            lpt = lanes // acfg.replay_ratio
+            carry = init_fused_carry(acfg, game, rep, ts2, rep.init_state(),
+                                     jax.random.PRNGKey(1))
+            kk = jax.random.PRNGKey(2)
+            for _ in range(2):  # compile + warm past learn_start
+                kk, k2 = jax.random.split(kk)
+                carry, (_, loss, _, _) = segment(carry, k2)
+            jax.block_until_ready(loss)
+            n_seg = 0
+            t = time.perf_counter()
+            while n_seg < 10 and (n_seg < 1 or left() > BUDGET * 0.12):
+                kk, k2 = jax.random.split(kk)
+                carry, (_, loss, _, _) = segment(carry, k2)
+                jax.block_until_ready(loss)
+                n_seg += 1
+            dt = time.perf_counter() - t
+            warm_ticks = int(_np.isfinite(_np.asarray(loss)[:, -1]).sum())
+            emit(phase="fused_anakin",
+                 env_frames_per_sec=round(n_seg * T * lanes / dt, 1),
+                 learn_steps_per_sec=round(n_seg * T * lpt / dt, 1),
+                 warm_ticks_last_seg=warm_ticks, ticks_per_seg=T, lanes=lanes,
+                 note="jaxgame:breakout 80x80, trainer's own fused graph")
+        except Exception as e:
+            emit(phase="fused_anakin", error=repr(e)[:200])
+
     # ---- phase 4: pallas sweep (riskiest compile, deliberately last) -----
     if left() > 60:
         try:
