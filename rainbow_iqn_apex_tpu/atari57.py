@@ -179,6 +179,30 @@ def write_results_csv(path: str, rows: List[Dict]) -> None:
         w.writerows(rows)
 
 
+def train_one_game(env_id: str, run_id: str, base_args: List[str]) -> Dict:
+    """Train+eval one game via the training CLI (cwd-independent); returns
+    the CLI's final JSON summary, or {} if none was printed.  Shared by this
+    sweep and jaxsuite.run_sweep so orchestration can't drift."""
+    import subprocess
+    import sys
+
+    train_cli = os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "train_agent_apex.py",
+    )
+    cmd = [
+        sys.executable, train_cli,
+        "--env-id", env_id, "--run-id", run_id, *base_args,
+    ]
+    out = subprocess.run(cmd, capture_output=True, text=True)
+    for line in reversed(out.stdout.strip().splitlines()):
+        try:
+            return json.loads(line)
+        except (ValueError, json.JSONDecodeError):
+            continue
+    return {}
+
+
 def run_sweep(base_args: List[str], games: Optional[List[str]] = None,
               results_dir: str = "results/atari57",
               record_table: Optional[str] = None,
@@ -190,28 +214,13 @@ def run_sweep(base_args: List[str], games: Optional[List[str]] = None,
     world-record JSON before aggregating (see ``load_record_table``).
     Returns the aggregate, including verified/recon coverage counts.
     """
-    import subprocess
-    import sys
-
     if record_table:
         load_record_table(record_table)
     games = games or ATARI57
     per_game: Dict[str, float] = {}
     rows = []
     for game in games:
-        run_id = f"atari57_{game}"
-        cmd = [
-            sys.executable, "train_agent_apex.py",
-            "--env-id", f"atari:{game}", "--run-id", run_id, *base_args,
-        ]
-        out = subprocess.run(cmd, capture_output=True, text=True)
-        summary = {}
-        for line in reversed(out.stdout.strip().splitlines()):
-            try:
-                summary = json.loads(line)
-                break
-            except (ValueError, json.JSONDecodeError):
-                continue
+        summary = train_one_game(f"atari:{game}", f"atari57_{game}", base_args)
         raw = summary.get("eval_score_mean")
         if raw is not None:
             per_game[game] = raw

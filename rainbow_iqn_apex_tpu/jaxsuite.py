@@ -1,0 +1,208 @@
+"""Benchmark suite over the pure-JAX game family (envs/device_games.py).
+
+Role: the runnable counterpart of the Atari-57 harness (atari57.py).  The
+reference's headline benchmark needs ALE + ROMs, absent in this sandbox
+(SURVEY.md §7); this suite gives the framework a benchmark it can actually
+execute anywhere: same sweep driver shape, same CSV/aggregate outputs, same
+normalisation math — but with baselines that are MEASURED, not recalled:
+
+- random baseline: the measured mean return of a uniform-random policy;
+- scripted reference: the measured mean return of a hand-written competent
+  policy (state-based, defined per game where one is sensible).
+
+normalized = (score - random) / (scripted - random) — "1.0 plays like the
+script, 0.0 plays like noise" — so nothing in the aggregate rests on an
+unverifiable constant (contrast atari57.HUMAN_WORLD_RECORDS, which stays
+RECON-gated).  Baselines are computed on demand by vmapped device rollouts
+of the same in-graph step the trainers use.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from statistics import median as _median
+from typing import Callable, Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from rainbow_iqn_apex_tpu.envs.device_games import (
+    GAMES,
+    batched_init,
+    batched_reset_step,
+    make_device_game,
+)
+
+JAXSUITE = sorted(GAMES)
+
+# enough ticks for >= 1 full episode per lane in every game (freeway's
+# truncation cap is the longest at 500)
+_EPISODE_TICK_BUDGET = {"catch": 64, "breakout": 512, "freeway": 600,
+                        "asterix": 512, "invaders": 512}
+
+
+# ---------------------------------------------------------------- policies
+
+
+def _p_random(game):
+    def policy(state, key):
+        return jax.random.randint(key, (), 0, game.num_actions, jnp.int32)
+
+    return policy
+
+
+def _p_catch(game):
+    def policy(state, key):
+        d = state.ball_c - state.paddle
+        return jnp.where(d == 0, 0, jnp.where(d > 0, 2, 1)).astype(jnp.int32)
+
+    return policy
+
+
+def _p_breakout(game):
+    def policy(state, key):
+        d = state.ball_c - state.paddle
+        return jnp.where(d == 0, 0, jnp.where(d > 0, 2, 1)).astype(jnp.int32)
+
+    return policy
+
+
+def _p_freeway(game):
+    def policy(state, key):
+        return jnp.int32(1)  # always up
+
+    return policy
+
+
+def _p_invaders(game):
+    def policy(state, key):
+        return jnp.int32(3)  # hold fire from the spawn column
+
+    return policy
+
+
+# game -> scripted policy builder (None: no sensible script; normalisation
+# is then undefined and the game reports raw scores only)
+SCRIPTED: Dict[str, Optional[Callable]] = {
+    "catch": _p_catch,
+    "breakout": _p_breakout,
+    "freeway": _p_freeway,
+    "asterix": None,
+    "invaders": _p_invaders,
+}
+
+
+# ---------------------------------------------------------------- rollouts
+
+
+def rollout_returns(name: str, policy_builder, episodes: int = 64,
+                    seed: int = 0, max_ticks: Optional[int] = None) -> np.ndarray:
+    """Mean-per-lane FIRST-episode returns of `policy` on `episodes` parallel
+    lanes, via one jitted scan of the in-graph auto-reset step.  Lanes whose
+    first episode did not finish inside the tick budget are dropped (the
+    budgets in _EPISODE_TICK_BUDGET make that rare)."""
+    game = make_device_game(name)
+    policy = policy_builder(game)
+    step = batched_reset_step(game)
+    T = max_ticks or _EPISODE_TICK_BUDGET.get(name, 512)
+
+    def tick(carry, k):
+        states, ep = carry
+        kp, ks = jax.random.split(k)
+        actions = jax.vmap(policy)(states, jax.random.split(kp, episodes))
+        states, ep, _f, _r, _t, _u, out_ret = step(states, ep, actions, ks)
+        return (states, ep), out_ret
+
+    @jax.jit
+    def run(key):
+        k_init, k_scan = jax.random.split(key)
+        states = batched_init(game, k_init, episodes)
+        _, rets = jax.lax.scan(tick, (states, jnp.zeros(episodes)),
+                               jax.random.split(k_scan, T))
+        return rets  # [T, L], NaN except on episode-end ticks
+
+    rets = np.asarray(run(jax.random.PRNGKey(seed)))
+    first = np.full(episodes, np.nan, np.float32)
+    for t in range(rets.shape[0]):
+        row = rets[t]
+        take = np.isnan(first) & ~np.isnan(row)
+        first[take] = row[take]
+    return first[~np.isnan(first)]
+
+
+def measure_baselines(name: str, episodes: int = 64, seed: int = 0) -> Dict:
+    """Measured {random, scripted?} mean returns for one game.  A baseline
+    whose rollout completed zero episodes inside the tick budget is omitted
+    (the game then reports raw scores only) rather than recorded as NaN."""
+    out: Dict[str, float] = {}
+    rnd = rollout_returns(name, _p_random, episodes, seed)
+    if len(rnd):
+        out["random"] = float(np.mean(rnd))
+    builder = SCRIPTED.get(name)
+    if builder is not None:
+        scr = rollout_returns(name, builder, episodes, seed + 1)
+        if len(scr):
+            out["scripted"] = float(np.mean(scr))
+    return out
+
+
+def normalized_score(raw: float, baselines: Dict) -> Optional[float]:
+    """(raw - random) / (scripted - random); None without a scripted ceiling
+    meaningfully above random (or with non-finite baselines)."""
+    rnd = baselines.get("random")
+    scr = baselines.get("scripted")
+    if rnd is None or scr is None:
+        return None
+    if not (np.isfinite(rnd) and np.isfinite(scr)) or scr <= rnd + 1e-6:
+        return None
+    return (raw - rnd) / (scr - rnd)
+
+
+def aggregate(per_game_raw: Dict[str, float],
+              baselines: Dict[str, Dict]) -> Dict[str, float]:
+    norm = {
+        g: n
+        for g, s in per_game_raw.items()
+        if (n := normalized_score(s, baselines.get(g, {}))) is not None
+    }
+    out: Dict[str, float] = {"games": len(per_game_raw),
+                             "games_normalized": len(norm)}
+    if norm:
+        out["median_script_normalized"] = _median(norm.values())
+        out["mean_script_normalized"] = sum(norm.values()) / len(norm)
+    return out
+
+
+def run_sweep(base_args: List[str], games: Optional[List[str]] = None,
+              results_dir: str = "results/jaxsuite",
+              baseline_episodes: int = 64) -> Dict[str, float]:
+    """Train+eval each jax game via the training CLI (mirror of
+    atari57.run_sweep), then aggregate against measured baselines."""
+    from rainbow_iqn_apex_tpu.atari57 import train_one_game, write_results_csv
+
+    games = games or JAXSUITE
+    per_game: Dict[str, float] = {}
+    baselines: Dict[str, Dict] = {}
+    rows = []
+    for game in games:
+        summary = train_one_game(f"jaxgame:{game}", f"jaxsuite_{game}", base_args)
+        raw = summary.get("eval_score_mean")
+        if raw is None:
+            continue
+        baselines[game] = measure_baselines(game, episodes=baseline_episodes)
+        per_game[game] = raw
+        rows.append({
+            "game": game,
+            "score_mean": raw,
+            "random_baseline": baselines[game].get("random"),
+            "scripted_baseline": baselines[game].get("scripted"),
+            "script_normalized": normalized_score(raw, baselines[game]),
+            **{k: v for k, v in summary.items() if k.startswith("eval_")},
+        })
+    write_results_csv(os.path.join(results_dir, "per_game.csv"), rows)
+    agg = aggregate(per_game, baselines)
+    with open(os.path.join(results_dir, "aggregate.json"), "w") as f:
+        json.dump(agg, f, indent=2)
+    return agg

@@ -398,13 +398,15 @@ def train_anakin_fused(cfg: Config, max_frames: Optional[int] = None) -> Dict[st
     ckpt = Checkpointer(os.path.join(cfg.checkpoint_dir, cfg.run_id))
 
     frames = 0
+    ds = replay.init_state()
     if cfg.resume and ckpt.latest_step() is not None:
         ts, extra = ckpt.restore(ts)
         frames = int(extra.get("frames", 0))
+        # replay snapshot only on an actual resume (host-path parity): a
+        # fresh run with the same run_id must cold-start its ring
+        ds, _ = _maybe_restore_replay(cfg, ds)
         metrics.log("resume", step=int(ts.step), frames=frames)
     learn_steps = int(ts.step)
-    ds = replay.init_state()
-    ds, _ = _maybe_restore_replay(cfg, ds)
 
     carry = place(init_fused_carry(cfg, game, replay, ts, ds, k_env, frames))
 

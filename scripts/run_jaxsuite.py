@@ -1,0 +1,45 @@
+#!/usr/bin/env python
+"""Run the pure-JAX game benchmark suite end-to-end.
+
+Trains each game via the training CLI with the flags you pass through,
+evals, measures random/scripted baselines on device, and writes
+results/jaxsuite/{per_game.csv, aggregate.json}.
+
+Example (CPU sandbox, short budget):
+  python scripts/run_jaxsuite.py --games catch breakout -- \
+    --role anakin --t-max 8000 --learn-start 512 --replay-ratio 2 \
+    --history-length 2 --gamma 0.9 --memory-capacity 8192 \
+    --learning-rate 1e-3 --target-update-period 200 \
+    --compute-dtype float32 --eval-episodes 40
+
+Everything after `--` goes verbatim to train_agent_apex.py.
+"""
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from rainbow_iqn_apex_tpu.jaxsuite import JAXSUITE, run_sweep  # noqa: E402
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--games", nargs="*", default=None, choices=JAXSUITE,
+                    help="subset of games (default: all)")
+    ap.add_argument("--results-dir", default="results/jaxsuite")
+    ap.add_argument("--baseline-episodes", type=int, default=64)
+    args, passthrough = ap.parse_known_args()
+    if passthrough and passthrough[0] == "--":
+        passthrough = passthrough[1:]
+    agg = run_sweep(passthrough, games=args.games,
+                    results_dir=args.results_dir,
+                    baseline_episodes=args.baseline_episodes)
+    print(json.dumps(agg))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,55 @@
+"""jaxsuite: measured baselines + normalisation + aggregate (the runnable
+counterpart of the atari57 harness tests in test_atari57_and_gym.py)."""
+
+import numpy as np
+import pytest
+
+from rainbow_iqn_apex_tpu.jaxsuite import (
+    JAXSUITE,
+    SCRIPTED,
+    aggregate,
+    measure_baselines,
+    normalized_score,
+    rollout_returns,
+    _p_random,
+)
+
+
+def test_suite_covers_all_games():
+    assert JAXSUITE == sorted(
+        ["catch", "breakout", "freeway", "asterix", "invaders"]
+    )
+
+
+def test_random_rollouts_complete_episodes():
+    rets = rollout_returns("catch", _p_random, episodes=16, seed=0)
+    assert len(rets) == 16  # every lane finished an episode in budget
+    assert set(np.unique(rets)) <= {-1.0, 1.0}
+
+
+def test_scripted_catch_is_perfect():
+    rets = rollout_returns("catch", SCRIPTED["catch"], episodes=16, seed=1)
+    assert np.all(rets == 1.0)
+
+
+@pytest.mark.parametrize("name", ["breakout", "freeway"])
+def test_scripted_beats_random(name):
+    b = measure_baselines(name, episodes=24, seed=0)
+    assert b["scripted"] > b["random"], b
+
+
+def test_normalized_score_and_aggregate():
+    baselines = {
+        "catch": {"random": -0.8, "scripted": 1.0},
+        "asterix": {"random": 0.5},  # no script -> excluded from norm
+    }
+    n = normalized_score(0.1, baselines["catch"])
+    assert n == pytest.approx((0.1 + 0.8) / 1.8)
+    agg = aggregate({"catch": 1.0, "asterix": 2.0}, baselines)
+    assert agg["games"] == 2 and agg["games_normalized"] == 1
+    assert agg["median_script_normalized"] == pytest.approx(1.0)
+
+
+def test_degenerate_script_gives_none():
+    assert normalized_score(1.0, {"random": 0.5, "scripted": 0.5}) is None
+    assert normalized_score(1.0, {"random": 0.5}) is None
