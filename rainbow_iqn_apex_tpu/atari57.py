@@ -195,6 +195,12 @@ def train_one_game(env_id: str, run_id: str, base_args: List[str]) -> Dict:
         "--env-id", env_id, "--run-id", run_id, *base_args,
     ]
     out = subprocess.run(cmd, capture_output=True, text=True)
+    if out.returncode != 0:
+        tail = "\n".join(out.stderr.strip().splitlines()[-10:])
+        print(
+            f"[sweep] {env_id} training CLI failed (rc={out.returncode}):\n{tail}",
+            file=sys.stderr,
+        )
     for line in reversed(out.stdout.strip().splitlines()):
         try:
             return json.loads(line)

@@ -471,6 +471,67 @@ GAMES = {
     "invaders": InvadersGame,
 }
 
+# the suite's episode cap, in ticks — the SABER 30-min-cap analog for these
+# games: eval/baseline rollouts score each lane's FIRST episode, and a lane
+# still mid-episode at the cap contributes its partial return (capped-return
+# semantics, eval.py parity) rather than being censored, so unbounded games
+# (breakout/invaders respawn their targets) cannot under-count strong agents
+EPISODE_TICK_BUDGET = {"catch": 64, "breakout": 512, "freeway": 600,
+                       "asterix": 512, "invaders": 512}
+
+
+def build_rollout(game: "DeviceGame", action_fn, episodes: int,
+                  max_ticks: int, history: int = 0):
+    """One jitted (aux, key) -> first-episode returns [episodes] rollout over
+    `episodes` parallel auto-reset lanes — the single episode-accounting core
+    shared by the trainers' in-graph eval (train_anakin.build_fused_eval) and
+    the benchmark baselines (jaxsuite.rollout_returns).
+
+    `action_fn(aux, states, stack, key) -> actions [episodes]` chooses
+    actions from either the game states (state-based scripts; `history=0`
+    skips stack upkeep) or the device frame stack (`history=C` maintains a
+    [L, H, W, C] stack with cut-zeroing exactly like the training tick).
+    Returns are capped, never censored: a lane whose first episode is still
+    running at `max_ticks` yields its partial return."""
+    step = batched_reset_step(game)
+    h, w = game.frame_shape
+
+    @jax.jit
+    def run(aux, key):
+        k_init, k_scan = jax.random.split(key)
+        states = batched_init(game, k_init, episodes)
+
+        def tick(carry, k):
+            states, ep, stack, frame, keep, first, done = carry
+            ka, ks = jax.random.split(k)
+            if history:
+                from rainbow_iqn_apex_tpu.parallel.multihost import shift_stack
+
+                stack = shift_stack(stack, frame, keep)
+            actions = action_fn(aux, states, stack, ka)
+            states, ep, nframe, _r, term, trunc, out_ret = step(
+                states, ep, actions, ks
+            )
+            ended = ~jnp.isnan(out_ret)
+            first = jnp.where(ended & ~done, out_ret, first)
+            done = done | ended
+            keep = (~(term | trunc)).astype(jnp.uint8)
+            return (states, ep, stack, nframe, keep, first, done), None
+
+        carry = (
+            states, jnp.zeros(episodes),
+            jnp.zeros((episodes, h, w, max(history, 1)), jnp.uint8),
+            jax.vmap(game.render)(states), jnp.ones(episodes, jnp.uint8),
+            jnp.full((episodes,), jnp.nan), jnp.zeros(episodes, bool),
+        )
+        carry, _ = jax.lax.scan(tick, carry, jax.random.split(k_scan, max_ticks))
+        _s, ep, _st, _f, _k, first, done = carry
+        # capped-return semantics: an unfinished first episode scores its
+        # running return (ep still tracks the first episode iff never done)
+        return jnp.where(done, first, ep)
+
+    return run
+
 
 def make_device_game(name: str) -> DeviceGame:
     try:

@@ -29,18 +29,13 @@ import jax.numpy as jnp
 import numpy as np
 
 from rainbow_iqn_apex_tpu.envs.device_games import (
+    EPISODE_TICK_BUDGET,
     GAMES,
-    batched_init,
-    batched_reset_step,
+    build_rollout,
     make_device_game,
 )
 
 JAXSUITE = sorted(GAMES)
-
-# enough ticks for >= 1 full episode per lane in every game (freeway's
-# truncation cap is the longest at 500)
-_EPISODE_TICK_BUDGET = {"catch": 64, "breakout": 512, "freeway": 600,
-                        "asterix": 512, "invaders": 512}
 
 
 # ---------------------------------------------------------------- policies
@@ -99,43 +94,27 @@ SCRIPTED: Dict[str, Optional[Callable]] = {
 
 def rollout_returns(name: str, policy_builder, episodes: int = 64,
                     seed: int = 0, max_ticks: Optional[int] = None) -> np.ndarray:
-    """Mean-per-lane FIRST-episode returns of `policy` on `episodes` parallel
-    lanes, via one jitted scan of the in-graph auto-reset step.  Lanes whose
-    first episode did not finish inside the tick budget are dropped (the
-    budgets in _EPISODE_TICK_BUDGET make that rare)."""
+    """FIRST-episode returns of `policy` on `episodes` parallel lanes via the
+    shared rollout core (envs/device_games.build_rollout) — same episode
+    accounting as the trainers' in-graph eval, including capped-return
+    semantics: a lane still mid-episode at the tick budget scores its
+    partial return, so long-surviving policies (breakout rallies) are
+    counted, never censored."""
     game = make_device_game(name)
     policy = policy_builder(game)
-    step = batched_reset_step(game)
-    T = max_ticks or _EPISODE_TICK_BUDGET.get(name, 512)
+    T = max_ticks or EPISODE_TICK_BUDGET.get(name, 512)
 
-    def tick(carry, k):
-        states, ep = carry
-        kp, ks = jax.random.split(k)
-        actions = jax.vmap(policy)(states, jax.random.split(kp, episodes))
-        states, ep, _f, _r, _t, _u, out_ret = step(states, ep, actions, ks)
-        return (states, ep), out_ret
+    def action_fn(aux, states, stack, key):
+        return jax.vmap(policy)(states, jax.random.split(key, episodes))
 
-    @jax.jit
-    def run(key):
-        k_init, k_scan = jax.random.split(key)
-        states = batched_init(game, k_init, episodes)
-        _, rets = jax.lax.scan(tick, (states, jnp.zeros(episodes)),
-                               jax.random.split(k_scan, T))
-        return rets  # [T, L], NaN except on episode-end ticks
-
-    rets = np.asarray(run(jax.random.PRNGKey(seed)))
-    first = np.full(episodes, np.nan, np.float32)
-    for t in range(rets.shape[0]):
-        row = rets[t]
-        take = np.isnan(first) & ~np.isnan(row)
-        first[take] = row[take]
-    return first[~np.isnan(first)]
+    run = build_rollout(game, action_fn, episodes, T, history=0)
+    return np.asarray(run(None, jax.random.PRNGKey(seed)))
 
 
 def measure_baselines(name: str, episodes: int = 64, seed: int = 0) -> Dict:
-    """Measured {random, scripted?} mean returns for one game.  A baseline
-    whose rollout completed zero episodes inside the tick budget is omitted
-    (the game then reports raw scores only) rather than recorded as NaN."""
+    """Measured {random, scripted?} mean returns for one game (capped-return
+    semantics — every lane contributes; the emptiness guards below are pure
+    defence-in-depth)."""
     out: Dict[str, float] = {}
     rnd = rollout_returns(name, _p_random, episodes, seed)
     if len(rnd):

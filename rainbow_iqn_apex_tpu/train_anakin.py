@@ -289,6 +289,38 @@ def build_fused_segment(cfg: Config, game, replay: DeviceReplay, learn_fn):
     return segment
 
 
+def build_fused_eval(cfg: Config, game, episodes: int, max_ticks: int = 1024):
+    """In-graph evaluation: `episodes` parallel lanes played greedily (noise
+    OFF, per-tick tau draws as in eval.py) for up to `max_ticks` — one
+    jitted (params, key) -> returns call instead of per-step host dispatches
+    through the Env adapter.  Built on the shared rollout core
+    (envs/device_games.build_rollout): each lane scores its FIRST episode,
+    with capped-return semantics at the tick budget."""
+    from rainbow_iqn_apex_tpu.envs.device_games import build_rollout
+
+    act_fn = build_act_step(cfg, game.num_actions, use_noise=False)
+
+    def action_fn(params, states, stack, key):
+        actions, _q = act_fn(params, stack, key)
+        return actions
+
+    return build_rollout(game, action_fn, episodes, max_ticks,
+                         history=cfg.history_length)
+
+
+def fused_eval_scores(eval_fn, params, key) -> Dict[str, Any]:
+    """Host-side summary of build_fused_eval's output, with the same keys as
+    eval.evaluate (so metrics rows are interchangeable)."""
+    scores = np.asarray(eval_fn(params, key))
+    return {
+        "episodes": int(len(scores)),
+        "score_mean": float(scores.mean()),
+        "score_median": float(np.median(scores)),
+        "score_min": float(scores.min()),
+        "score_max": float(scores.max()),
+    }
+
+
 def init_fused_carry(cfg: Config, game, replay: DeviceReplay, ts, ds, key,
                      frames: int = 0):
     """Fresh lane states + empty device stack for build_fused_segment."""
@@ -410,10 +442,20 @@ def train_anakin_fused(cfg: Config, max_frames: Optional[int] = None) -> Dict[st
 
     carry = place(init_fused_carry(cfg, game, replay, ts, ds, k_env, frames))
 
-    # eval runs through the host adapter (same game, ordinary Env loop)
-    from rainbow_iqn_apex_tpu.envs import make_env as _make_env
+    # eval is in-graph too: greedy lanes scanned on device, one dispatch
+    from rainbow_iqn_apex_tpu.envs.device_games import EPISODE_TICK_BUDGET
 
-    eval_env = _make_env(cfg.env_id, seed=cfg.seed + 977)
+    game_name = cfg.env_id.split(":", 1)[1]
+    eval_fn = build_fused_eval(
+        cfg, game, cfg.eval_episodes,
+        max_ticks=EPISODE_TICK_BUDGET.get(game_name, 1024),
+    )
+
+    def run_eval(params, step_no: int) -> Dict[str, Any]:
+        # deterministic per eval point (bit-reproducible curves, as eval.py)
+        k = jax.random.fold_in(jax.random.PRNGKey(cfg.seed + 977), step_no)
+        return fused_eval_scores(eval_fn, params, k)
+
     returns: collections.deque = collections.deque(maxlen=100)
 
     def crossed(interval: int, before: int, after: int) -> bool:
@@ -444,12 +486,13 @@ def train_anakin_fused(cfg: Config, max_frames: Optional[int] = None) -> Dict[st
                 mean_return=float(np.mean(returns)) if returns else float("nan"),
             )
         if crossed(cfg.eval_interval, prev_steps, learn_steps):
-            metrics.log("eval", step=learn_steps, **_eval(cfg, eval_env, ts))
+            metrics.log("eval", step=learn_steps,
+                        **run_eval(carry[0].params, learn_steps))
         if crossed(cfg.checkpoint_interval, prev_steps, learn_steps):
             ckpt.save(learn_steps, ts, {"frames": frames})
             _save_replay(cfg, ds)
 
-    final_eval = _eval(cfg, eval_env, ts)
+    final_eval = run_eval(carry[0].params, learn_steps)
     metrics.log("eval", step=learn_steps, **final_eval)
     ckpt.save(learn_steps, ts, {"frames": frames})
     _save_replay(cfg, ds)

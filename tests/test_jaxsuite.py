@@ -38,6 +38,16 @@ def test_scripted_beats_random(name):
     assert b["scripted"] > b["random"], b
 
 
+def test_capped_return_not_censored():
+    """A tick budget too small to finish any episode must still score every
+    lane (partial return), not drop them — the anti-censoring guarantee for
+    unbounded games / strong policies."""
+    rets = rollout_returns("freeway", SCRIPTED["freeway"], episodes=8,
+                           seed=2, max_ticks=40)
+    assert len(rets) == 8  # freeway's own cap is 500: nothing finished...
+    assert np.all(rets >= 0.0)  # ...yet every lane reports its capped return
+
+
 def test_normalized_score_and_aggregate():
     baselines = {
         "catch": {"random": -0.8, "scripted": 1.0},
