@@ -1,0 +1,276 @@
+"""Device-resident stored-state sequence replay (the R2D2 twin of
+replay/device.py).
+
+Same semantics as the host SequenceReplay (replay/sequence.py) — per-lane
+builders chopping episode streams into overlapping fixed-length sequences
+with the actor's LSTM state at each window start, two-channel cuts (flush on
+terminal OR truncation, `done` only for true terminals), max-priority
+insertion, eta-mix write-back — but the ring, the builders, and prioritized
+sampling all live in HBM as one pytree, so the fused R2D2 Anakin tick
+(act -> env.step -> append -> learn) compiles into a single XLA graph.
+
+The one structural difference from the host version: the number of sequences
+EMITTED per tick is data-dependent (a lane emits when its builder fills or
+its episode cuts), which XLA cannot express as a dynamic store count.  The
+ring therefore carries ONE scratch row (index C): every lane scatters its
+builder window somewhere each tick — emitting lanes to `(pos + rank) % C`
+(rank = that lane's position among this tick's emitters), non-emitting lanes
+to the scratch row — so shapes stay static and the write is one batched
+scatter.  Sampling and priorities only ever see rows [0, C).
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple, Optional, Tuple
+
+import chex
+import jax
+import jax.numpy as jnp
+
+from rainbow_iqn_apex_tpu.ops.r2d2 import SequenceBatch
+
+
+class DeviceSeqState(NamedTuple):
+    # sequence ring, one scratch row at index C
+    frames: jnp.ndarray  # [C+1, L, H, W] uint8
+    actions: jnp.ndarray  # [C+1, L] int32
+    rewards: jnp.ndarray  # [C+1, L] f32
+    dones: jnp.ndarray  # [C+1, L] bool
+    valids: jnp.ndarray  # [C+1, L] bool
+    init_c: jnp.ndarray  # [C+1, lstm] f32
+    init_h: jnp.ndarray  # [C+1, lstm] f32
+    priority: jnp.ndarray  # [C] f32 (already ^omega, like the host tree)
+    pos: jnp.ndarray  # scalar i32 — next ring slot
+    filled: jnp.ndarray  # scalar i32
+    max_priority: jnp.ndarray  # scalar f32
+    # per-lane builders
+    buf_frames: jnp.ndarray  # [lanes, L, H, W] uint8
+    buf_actions: jnp.ndarray  # [lanes, L] i32
+    buf_rewards: jnp.ndarray  # [lanes, L] f32
+    buf_dones: jnp.ndarray  # [lanes, L] bool
+    buf_c: jnp.ndarray  # [lanes, L, lstm] f32
+    buf_h: jnp.ndarray  # [lanes, L, lstm] f32
+    buf_len: jnp.ndarray  # [lanes] i32
+
+
+class DeviceSequenceReplay:
+    """Pure-functional sequence replay: all methods are jit-safe
+    (state, ...) -> state transforms over a DeviceSeqState pytree."""
+
+    def __init__(
+        self,
+        capacity: int,
+        seq_len: int,
+        frame_shape: Tuple[int, int],
+        lstm_size: int,
+        lanes: int,
+        stride: Optional[int] = None,
+        priority_exponent: float = 0.9,
+        priority_eps: float = 1e-6,
+    ):
+        if stride is not None and not (0 < stride <= seq_len):
+            raise ValueError("stride must be in (0, seq_len]")
+        if capacity < lanes:
+            raise ValueError(
+                f"capacity ({capacity}) must be >= lanes ({lanes}): every "
+                "lane can emit a sequence on the same tick"
+            )
+        self.capacity = capacity
+        self.L = seq_len
+        self.lanes = lanes
+        self.stride = stride or max(seq_len // 2, 1)
+        self.omega = priority_exponent
+        self.eps = priority_eps
+        self.frame_shape = frame_shape
+        self.lstm_size = lstm_size
+
+    def init_state(self) -> DeviceSeqState:
+        C, L, (h, w), m, lanes = (
+            self.capacity, self.L, self.frame_shape, self.lstm_size, self.lanes,
+        )
+        return DeviceSeqState(
+            frames=jnp.zeros((C + 1, L, h, w), jnp.uint8),
+            actions=jnp.zeros((C + 1, L), jnp.int32),
+            rewards=jnp.zeros((C + 1, L), jnp.float32),
+            dones=jnp.zeros((C + 1, L), bool),
+            valids=jnp.zeros((C + 1, L), bool),
+            init_c=jnp.zeros((C + 1, m), jnp.float32),
+            init_h=jnp.zeros((C + 1, m), jnp.float32),
+            priority=jnp.zeros((C,), jnp.float32),
+            pos=jnp.int32(0),
+            filled=jnp.int32(0),
+            max_priority=jnp.float32(1.0),
+            buf_frames=jnp.zeros((lanes, L, h, w), jnp.uint8),
+            buf_actions=jnp.zeros((lanes, L), jnp.int32),
+            buf_rewards=jnp.zeros((lanes, L), jnp.float32),
+            buf_dones=jnp.zeros((lanes, L), bool),
+            buf_c=jnp.zeros((lanes, L, m), jnp.float32),
+            buf_h=jnp.zeros((lanes, L, m), jnp.float32),
+            buf_len=jnp.zeros((lanes,), jnp.int32),
+        )
+
+    # ------------------------------------------------------------- appending
+    def append(
+        self,
+        s: DeviceSeqState,
+        frames: jnp.ndarray,  # [lanes, H, W] uint8 — frame the action saw
+        actions: jnp.ndarray,  # [lanes] i32
+        rewards: jnp.ndarray,  # [lanes] f32
+        terminals: jnp.ndarray,  # [lanes] bool — TRUE terminals only
+        truncations: jnp.ndarray,  # [lanes] bool — time-limit cuts
+        lstm_c: jnp.ndarray,  # [lanes, lstm] actor state BEFORE this step
+        lstm_h: jnp.ndarray,
+    ) -> DeviceSeqState:
+        """One lockstep tick of all lanes (mirror of _append_locked,
+        replay/sequence.py): builder scatter, then emit full/cut windows into
+        the ring via the scratch-row batched scatter, then carry-over."""
+        lanes, L, C, stride = self.lanes, self.L, self.capacity, self.stride
+        lane = jnp.arange(lanes)
+        k = s.buf_len  # [lanes] write offsets, in [0, L-1]
+
+        bf = s.buf_frames.at[lane, k].set(frames)
+        ba = s.buf_actions.at[lane, k].set(actions.astype(jnp.int32))
+        br = s.buf_rewards.at[lane, k].set(rewards.astype(jnp.float32))
+        bd = s.buf_dones.at[lane, k].set(terminals)
+        bc = s.buf_c.at[lane, k].set(lstm_c.astype(jnp.float32))
+        bh = s.buf_h.at[lane, k].set(lstm_h.astype(jnp.float32))
+        klen = k + 1  # post-write lengths
+
+        cut = terminals | truncations
+        emit = cut | (klen == L)
+
+        # ring slots: emitters take pos+rank (mod C), others the scratch row
+        rank = jnp.cumsum(emit.astype(jnp.int32)) - 1
+        n_emit = emit.sum().astype(jnp.int32)
+        slots = jnp.where(emit, (s.pos + rank) % C, C)
+
+        steps = jnp.arange(L)
+        valid_mask = steps[None, :] < klen[:, None]  # [lanes, L]
+
+        def zpad(buf, mask):
+            return jnp.where(mask, buf, jnp.zeros_like(buf))
+
+        vm = valid_mask
+        frames_row = zpad(bf, vm[..., None, None])
+        actions_row = zpad(ba, vm)
+        rewards_row = zpad(br, vm)
+        dones_row = zpad(bd, vm)
+
+        st = s._replace(
+            buf_frames=bf, buf_actions=ba, buf_rewards=br, buf_dones=bd,
+            buf_c=bc, buf_h=bh,
+        )
+        st = st._replace(
+            frames=st.frames.at[slots].set(frames_row),
+            actions=st.actions.at[slots].set(actions_row),
+            rewards=st.rewards.at[slots].set(rewards_row),
+            dones=st.dones.at[slots].set(dones_row),
+            valids=st.valids.at[slots].set(vm),
+            init_c=st.init_c.at[slots].set(bc[:, 0]),
+            init_h=st.init_h.at[slots].set(bh[:, 0]),
+        )
+        # max-priority insertion for emitted slots (clip scratch writes away
+        # by scattering into a length-C+1 view and dropping the tail)
+        pri_ext = jnp.concatenate([st.priority, jnp.zeros((1,), jnp.float32)])
+        pri_ext = pri_ext.at[slots].set(
+            jnp.where(emit, st.max_priority, pri_ext[slots])
+        )
+        st = st._replace(
+            priority=pri_ext[:C],
+            pos=(s.pos + n_emit) % C,
+            filled=jnp.minimum(s.filled + n_emit, C),
+        )
+
+        # ---- builder carry-over -------------------------------------------
+        # flush (cut): restart empty.  full (no cut): keep last L-stride
+        # steps.  neither: just the incremented length.
+        tail = L - stride
+        shifted = jax.tree.map(
+            lambda b: jnp.roll(b, -stride, axis=1),
+            (bf, ba, br, bd, bc, bh),
+        )
+
+        def pick(orig, shift):
+            sel = emit & ~cut  # overlap carry-over
+            sh = jnp.reshape(sel, (lanes,) + (1,) * (orig.ndim - 1))
+            return jnp.where(sh, shift, orig)
+
+        bf2, ba2, br2, bd2, bc2, bh2 = (
+            pick(o, sh) for o, sh in zip((bf, ba, br, bd, bc, bh), shifted)
+        )
+        new_len = jnp.where(cut, 0, jnp.where(emit, tail, klen))
+        return st._replace(
+            buf_frames=bf2, buf_actions=ba2, buf_rewards=br2, buf_dones=bd2,
+            buf_c=bc2, buf_h=bh2, buf_len=new_len.astype(jnp.int32),
+        )
+
+    # -------------------------------------------------------------- sampling
+    def draw(self, s: DeviceSeqState, key: chex.PRNGKey,
+             batch_size: int) -> jnp.ndarray:
+        """Stratified proportional draw over ring priorities (mirror of
+        SumTree.sample_stratified)."""
+        p = s.priority
+        total = p.sum()
+        cdf = jnp.cumsum(p)
+        u = (jnp.arange(batch_size) + jax.random.uniform(key, (batch_size,)))
+        u = u / batch_size * total
+        return jnp.clip(
+            jnp.searchsorted(cdf, u, side="right"), 0, p.shape[0] - 1
+        ).astype(jnp.int32)
+
+    def assemble(
+        self, s: DeviceSeqState, idx: jnp.ndarray, beta: jnp.ndarray
+    ) -> Tuple[SequenceBatch, jnp.ndarray]:
+        """Gather sequences + IS weights at slot ids.  Returns
+        (SequenceBatch with [B, L, H, W, 1] obs, prob [B])."""
+        p = s.priority
+        total = p.sum()
+        prob = jnp.maximum(p[idx] / jnp.maximum(total, 1e-12), 1e-12)
+        w = (s.filled.astype(jnp.float32) * prob) ** (-beta)
+        weight = w / w.max()
+        batch = SequenceBatch(
+            obs=s.frames[idx][..., None],
+            action=s.actions[idx],
+            reward=s.rewards[idx],
+            done=s.dones[idx],
+            valid=s.valids[idx],
+            init_c=s.init_c[idx],
+            init_h=s.init_h[idx],
+            weight=weight,
+        )
+        return batch, prob
+
+    # ------------------------------------------------------------- priorities
+    def update_priorities(
+        self, s: DeviceSeqState, idx: jnp.ndarray, td_mix: jnp.ndarray
+    ) -> DeviceSeqState:
+        """Learner eta-mix write-back (mirror of SequenceReplay
+        .update_priorities: direct set, running max)."""
+        pri = (td_mix.astype(jnp.float32) + self.eps) ** self.omega
+        return s._replace(
+            priority=s.priority.at[idx].set(pri),
+            max_priority=jnp.maximum(s.max_priority, pri.max()),
+        )
+
+
+def build_device_r2d2_learn(cfg, num_actions: int,
+                            replay: DeviceSequenceReplay):
+    """The fused R2D2 learner tick: draw -> assemble -> sequence learn step
+    -> eta-mix priority write-back, one jittable pure function
+    (train_state, replay_state, key, beta) -> (train_state, replay_state,
+    info) — the recurrent twin of replay/device.build_device_learn."""
+    from rainbow_iqn_apex_tpu.ops.r2d2 import build_r2d2_learn_step
+
+    learn_step = build_r2d2_learn_step(cfg, num_actions)
+
+    def fused(train_state, replay_state, key, beta):
+        k_sample, k_learn = jax.random.split(key)
+        idx = replay.draw(replay_state, k_sample, cfg.batch_size)
+        batch, _prob = replay.assemble(replay_state, idx, beta)
+        train_state, info = learn_step(train_state, batch, k_learn)
+        replay_state = replay.update_priorities(
+            replay_state, idx, info["priorities"]
+        )
+        return train_state, replay_state, info
+
+    return fused

@@ -1,0 +1,199 @@
+"""Device-resident sequence replay (replay/device_sequence.py) vs the host
+SequenceReplay: same trace in, same ring/priorities/batches out.
+
+The host buffer (replay/sequence.py) is the semantics oracle — these tests
+pin the in-graph mirror to it tick by tick: ring rows (zero-padding,
+two-channel cuts, overlap carry-over with exact stored LSTM states),
+max-priority insertion order, assemble weights, and eta-mix write-back."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from rainbow_iqn_apex_tpu.replay.device_sequence import (
+    DeviceSequenceReplay,
+    build_device_r2d2_learn,
+)
+from rainbow_iqn_apex_tpu.replay.sequence import SequenceReplay
+
+LANES, L, STRIDE, CAP = 3, 6, 3, 16
+H = W = 8
+LSTM = 4
+OMEGA, EPS = 0.9, 1e-6
+
+
+def _make_pair():
+    host = SequenceReplay(
+        capacity=CAP, seq_len=L, frame_shape=(H, W), lstm_size=LSTM,
+        lanes=LANES, stride=STRIDE, priority_exponent=OMEGA,
+        priority_eps=EPS, seed=0,
+    )
+    dev = DeviceSequenceReplay(
+        capacity=CAP, seq_len=L, frame_shape=(H, W), lstm_size=LSTM,
+        lanes=LANES, stride=STRIDE, priority_exponent=OMEGA, priority_eps=EPS,
+    )
+    return host, dev
+
+
+def _trace(rng, ticks, p_term=0.1, p_trunc=0.07):
+    for _ in range(ticks):
+        term = rng.random(LANES) < p_term
+        yield dict(
+            frames=rng.integers(0, 255, (LANES, H, W), dtype=np.uint8),
+            actions=rng.integers(0, 4, LANES).astype(np.int32),
+            rewards=rng.normal(size=LANES).astype(np.float32),
+            terminals=term,
+            truncations=(rng.random(LANES) < p_trunc) & ~term,
+            lstm_c=rng.normal(size=(LANES, LSTM)).astype(np.float32),
+            lstm_h=rng.normal(size=(LANES, LSTM)).astype(np.float32),
+        )
+
+
+def _drive(host, dev, ticks, seed=0, p_term=0.1, p_trunc=0.07):
+    append = jax.jit(dev.append)
+    ds = dev.init_state()
+    rng = np.random.default_rng(seed)
+    for t in _trace(rng, ticks, p_term, p_trunc):
+        host.append_batch(
+            t["frames"], t["actions"], t["rewards"], t["terminals"],
+            t["lstm_c"], t["lstm_h"], truncations=t["truncations"],
+        )
+        ds = append(
+            ds, jnp.asarray(t["frames"]), jnp.asarray(t["actions"]),
+            jnp.asarray(t["rewards"]), jnp.asarray(t["terminals"]),
+            jnp.asarray(t["truncations"]), jnp.asarray(t["lstm_c"]),
+            jnp.asarray(t["lstm_h"]),
+        )
+    return ds
+
+
+@pytest.mark.parametrize("ticks", [4, 17, 60])
+def test_ring_matches_host(ticks):
+    host, dev = _make_pair()
+    ds = _drive(host, dev, ticks)
+    assert int(ds.filled) == host.filled
+    assert int(ds.pos) == host.pos
+    n = host.filled
+    sl = np.arange(n) if n < CAP else np.arange(CAP)
+    np.testing.assert_array_equal(np.asarray(ds.frames)[sl], host.frames[sl])
+    np.testing.assert_array_equal(np.asarray(ds.actions)[sl], host.actions[sl])
+    np.testing.assert_allclose(
+        np.asarray(ds.rewards)[sl], host.rewards[sl], rtol=1e-6
+    )
+    np.testing.assert_array_equal(np.asarray(ds.dones)[sl], host.dones[sl])
+    np.testing.assert_array_equal(np.asarray(ds.valids)[sl], host.valids[sl])
+    np.testing.assert_allclose(
+        np.asarray(ds.init_c)[sl], host.init_c[sl], rtol=1e-6
+    )
+    np.testing.assert_allclose(
+        np.asarray(ds.init_h)[sl], host.init_h[sl], rtol=1e-6
+    )
+    np.testing.assert_allclose(
+        np.asarray(ds.priority), host.tree.get(np.arange(CAP)), rtol=1e-5
+    )
+    assert float(ds.max_priority) == pytest.approx(host.max_priority, rel=1e-6)
+
+
+def test_ring_matches_host_no_cuts():
+    """Pure overlap regime: every sequence comes from the stride carry-over,
+    exercising the stored-state-at-window-start bookkeeping."""
+    host, dev = _make_pair()
+    ds = _drive(host, dev, 40, seed=3, p_term=0.0, p_trunc=0.0)
+    n = min(host.filled, CAP)
+    sl = np.arange(n)
+    np.testing.assert_array_equal(np.asarray(ds.frames)[sl], host.frames[sl])
+    np.testing.assert_allclose(
+        np.asarray(ds.init_c)[sl], host.init_c[sl], rtol=1e-6
+    )
+    assert np.asarray(ds.valids)[sl].all()  # full windows only
+
+
+def test_assemble_matches_host_sample_fields():
+    host, dev = _make_pair()
+    ds = _drive(host, dev, 50, seed=5)
+    beta = 0.6
+    hs = host.sample(8, beta)
+    batch, prob = jax.jit(dev.assemble)(
+        ds, jnp.asarray(hs.idx, jnp.int32), jnp.float32(beta)
+    )
+    np.testing.assert_array_equal(np.asarray(batch.obs), hs.obs)
+    np.testing.assert_array_equal(np.asarray(batch.action), hs.action)
+    np.testing.assert_allclose(np.asarray(batch.reward), hs.reward, rtol=1e-6)
+    np.testing.assert_array_equal(np.asarray(batch.done), hs.done)
+    np.testing.assert_array_equal(np.asarray(batch.valid), hs.valid)
+    np.testing.assert_allclose(np.asarray(batch.init_c), hs.init_c, rtol=1e-6)
+    np.testing.assert_allclose(np.asarray(batch.weight), hs.weight, rtol=1e-4)
+    np.testing.assert_allclose(np.asarray(prob), hs.prob, rtol=1e-4)
+
+
+def test_update_priorities_matches_host():
+    host, dev = _make_pair()
+    ds = _drive(host, dev, 30, seed=7)
+    idx = np.array([0, 2, 5], np.int64)
+    td = np.array([0.5, 2.0, 0.01], np.float32)
+    host.update_priorities(idx, td)
+    ds2 = jax.jit(dev.update_priorities)(
+        ds, jnp.asarray(idx, jnp.int32), jnp.asarray(td)
+    )
+    np.testing.assert_allclose(
+        np.asarray(ds2.priority), host.tree.get(np.arange(CAP)), rtol=1e-5
+    )
+    assert float(ds2.max_priority) == pytest.approx(host.max_priority, rel=1e-6)
+
+
+def test_draw_tracks_priorities():
+    host, dev = _make_pair()
+    ds = _drive(host, dev, 40, seed=9)
+    hot = 3
+    pri = np.asarray(ds.priority)
+    ds = ds._replace(priority=ds.priority.at[hot].set(pri.sum() * 20))
+    idx = jax.jit(dev.draw, static_argnums=2)(ds, jax.random.PRNGKey(0), 64)
+    share = float((np.asarray(idx) == hot).mean())
+    expected = float(ds.priority[hot] / ds.priority.sum())
+    assert share == pytest.approx(expected, abs=0.15)
+
+
+def test_fused_r2d2_learn_runs():
+    """draw -> assemble -> R2D2 learn -> eta-mix write-back as one jitted
+    call: finite loss, priorities change at the sampled slots.  44x44
+    frames: the conv trunk's three VALID convs need >= ~44 pixels."""
+    from rainbow_iqn_apex_tpu.config import Config
+    from rainbow_iqn_apex_tpu.ops.r2d2 import init_r2d2_state
+
+    hw = 44
+    host = SequenceReplay(
+        capacity=CAP, seq_len=L, frame_shape=(hw, hw), lstm_size=LSTM,
+        lanes=LANES, stride=STRIDE, seed=0,
+    )
+    dev = DeviceSequenceReplay(
+        capacity=CAP, seq_len=L, frame_shape=(hw, hw), lstm_size=LSTM,
+        lanes=LANES, stride=STRIDE,
+    )
+    append = jax.jit(dev.append)
+    ds = dev.init_state()
+    rng = np.random.default_rng(11)
+    for _ in range(40):
+        term = rng.random(LANES) < 0.1
+        ds = append(
+            ds,
+            jnp.asarray(rng.integers(0, 255, (LANES, hw, hw), dtype=np.uint8)),
+            jnp.asarray(rng.integers(0, 4, LANES).astype(np.int32)),
+            jnp.asarray(rng.normal(size=LANES).astype(np.float32)),
+            jnp.asarray(term),
+            jnp.asarray((rng.random(LANES) < 0.07) & ~term),
+            jnp.asarray(rng.normal(size=(LANES, LSTM)).astype(np.float32)),
+            jnp.asarray(rng.normal(size=(LANES, LSTM)).astype(np.float32)),
+        )
+    cfg = Config(
+        compute_dtype="float32", history_length=1, hidden_size=32,
+        num_cosines=8, lstm_size=LSTM, r2d2_burn_in=2, r2d2_seq_len=L - 2,
+        batch_size=4, multi_step=1, gamma=0.9,
+    )
+    ts = init_r2d2_state(cfg, 4, jax.random.PRNGKey(0), (hw, hw), channels=1)
+    fused = jax.jit(build_device_r2d2_learn(cfg, 4, dev), donate_argnums=(0, 1))
+    before = np.asarray(ds.priority).copy()
+    ts, ds, info = fused(ts, ds, jax.random.PRNGKey(1), jnp.float32(0.5))
+    assert np.isfinite(float(info["loss"]))
+    assert (np.asarray(ds.priority) != before).any()
+    assert int(ts.step) == 1
