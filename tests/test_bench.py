@@ -1,0 +1,59 @@
+"""bench.py child-process discipline.
+
+BENCH_r02's failure mode: the child printed its finished row, then hung in
+interpreter teardown (PJRT client cleanup against a wedged TPU relay) until
+the parent's 480s watchdog fired.  The child must therefore hard-exit
+(os._exit) after flushing its last row, so nothing that runs at interpreter
+teardown — atexit hooks, non-daemon threads, PJRT destructors — can convert
+a finished measurement into a timeout.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+# Simulates the wedged-relay teardown: a non-daemon thread that never exits.
+# Without os._exit, interpreter shutdown joins it and the process hangs
+# exactly like the round-2 bench child did.
+CHILD_WRAPPER = """
+import threading, time
+threading.Thread(target=lambda: time.sleep(3600), daemon=False).start()
+
+import rainbow_iqn_apex_tpu.config as C
+_orig = C.Config
+C.Config = lambda: _orig(
+    frame_height=44, frame_width=44, batch_size=4,
+    num_tau_samples=8, num_tau_prime_samples=8, num_quantile_samples=4,
+    compute_dtype="float32",
+)
+
+import bench
+bench.main()
+"""
+
+
+@pytest.mark.slow
+def test_bench_child_hard_exits_despite_hung_teardown():
+    env = dict(os.environ)
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["_BENCH_CHILD"] = "1"
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    # 180s soft budget; the tiny patched shape compiles + runs in well under
+    # that, and the hung thread would block exit for 3600s without _exit
+    env["BENCH_WATCHDOG_SECS"] = "180"
+    p = subprocess.run(
+        [sys.executable, "-c", CHILD_WRAPPER],
+        env=env, capture_output=True, text=True, timeout=240,
+    )
+    assert p.returncode == 0, p.stderr[-2000:]
+    rows = [json.loads(l) for l in p.stdout.strip().splitlines()
+            if l.startswith("{")]
+    assert rows, p.stdout
+    assert rows[-1]["value"] > 0
+    assert "learn_steps/s" in rows[-1]["unit"]
