@@ -20,6 +20,16 @@ as the public spec these games follow; implementations here are original):
 so a frame-stacking conv agent must learn motion.  Design rules for TPU:
 static shapes everywhere, no data-dependent Python control flow (jnp.where
 only), randomness through explicit keys, state as a NamedTuple of arrays.
+
+Intended dynamics note (collision semantics): collisions are checked at
+post-move coincidence only.  On ticks where two entities move toward each
+other (a bullet and a marching alien, a bomb and the player, a car and the
+freeway chicken) they can swap cells without registering a hit — classic
+discrete-grid "tunneling".  This is deliberate: it keeps every entity update
+one vectorised move-then-compare (no sub-tick sweep), it is identical for
+the agent and for the scripted baselines (jaxsuite.py), and MinAtar-family
+play is unaffected beyond an occasional lucky pass-through that the agent
+can in fact learn to exploit, like any other game rule.
 """
 
 from __future__ import annotations
@@ -191,10 +201,16 @@ class BreakoutGame(DeviceGame):
         # cleared wall respawns (dense long-horizon reward, like the
         # reference's multi-life Atari episodes)
         cleared = ~bricks.any()
-        bricks = jnp.where(cleared, self._wall(), bricks)
+        bricks = jnp.where(cleared, self._respawn(s), bricks)
 
-        ns = BreakoutState(paddle, nr, nc, dr, dc, bricks, s.t + 1)
+        # _replace keeps any subclass state fields (e.g. the variant's
+        # per-level wall template) flowing through unchanged
+        ns = s._replace(paddle=paddle, ball_r=nr, ball_c=nc, dr=dr, dc=dc,
+                        bricks=bricks, t=s.t + 1)
         return ns, reward, terminal, jnp.bool_(False)
+
+    def _respawn(self, s) -> jnp.ndarray:
+        return self._wall()
 
     def render(self, s: BreakoutState) -> jnp.ndarray:
         grid = jnp.where(s.bricks, I_BRICK, jnp.uint8(0)).astype(jnp.uint8)
@@ -236,12 +252,18 @@ class FreewayGame(DeviceGame):
             t=jnp.int32(0),
         )
 
+    def _lane_dynamics(self, s):
+        """(speeds [8], dirs [8]) — the variant subclass reads them from the
+        per-level state instead of the class constants."""
+        return self.SPEEDS, self.DIRS
+
     def step(self, s: FreewayState, action, key):
         move = jnp.array([0, -1, 1], jnp.int32)[action]
         chicken = jnp.clip(s.chicken + move, 0, G - 1)
 
-        advance = (s.t % self.SPEEDS) == 0
-        cars = (s.cars + jnp.where(advance, self.DIRS, 0)) % G
+        speeds, dirs = self._lane_dynamics(s)
+        advance = (s.t % speeds) == 0
+        cars = (s.cars + jnp.where(advance, dirs, 0)) % G
 
         # lanes are rows 1..8; car in the chicken's row at the chicken's col?
         lane = chicken - 1  # -1 or 8+ when off the road
@@ -256,7 +278,7 @@ class FreewayGame(DeviceGame):
 
         t = s.t + 1
         trunc = t >= self.cap
-        ns = FreewayState(chicken, cars, t)
+        ns = s._replace(chicken=chicken, cars=cars, t=t)
         return ns, reward, jnp.bool_(False), trunc
 
     def render(self, s: FreewayState) -> jnp.ndarray:
@@ -460,6 +482,107 @@ class InvadersGame(DeviceGame):
 
 
 # --------------------------------------------------------------------------
+# seeded level variants (the Procgen-class generalization stand-in,
+# BASELINE.md config 5): "<game>@var" draws each episode's level from a
+# TRAIN pool of seeds, "<game>@var-test" from a disjoint HELD-OUT pool.
+# A level is a deterministic function of its id (fold_in of a fixed base
+# key), so train/test splits are reproducible everywhere; per-episode
+# randomness (ball entry, car phases) stays on top of the level layout.
+# --------------------------------------------------------------------------
+
+N_TRAIN_LEVELS = 16
+N_TEST_LEVELS = 16
+_LEVEL_BASE_KEY = 9137
+
+
+def _level_key(pool_base: int, pool_size: int, key):
+    level = pool_base + jax.random.randint(key, (), 0, pool_size, jnp.int32)
+    return jax.random.fold_in(jax.random.PRNGKey(_LEVEL_BASE_KEY), level)
+
+
+class BreakoutVarState(NamedTuple):
+    paddle: jnp.ndarray
+    ball_r: jnp.ndarray
+    ball_c: jnp.ndarray
+    dr: jnp.ndarray
+    dc: jnp.ndarray
+    bricks: jnp.ndarray
+    wall: jnp.ndarray  # [G, G] bool — this level's respawn template
+    t: jnp.ndarray
+
+
+class BreakoutVarGame(BreakoutGame):
+    """Level-randomized breakout: the level id fixes the brick-wall pattern
+    (random ~3/4-density mask over rows 1..3) and the paddle start; ball
+    entry column/direction remain per-episode randomness.  The wall template
+    rides in the state so cleared walls respawn THIS level's pattern."""
+
+    def __init__(self, pool_base: int, pool_size: int):
+        self.pool_base = pool_base
+        self.pool_size = pool_size
+
+    def init(self, key) -> BreakoutVarState:
+        kl, kc, kd = jax.random.split(key, 3)
+        kw, kp = jax.random.split(_level_key(self.pool_base, self.pool_size, kl))
+        mask = jax.random.uniform(kw, (3, G)) < 0.75
+        mask = mask.at[1, G // 2].set(True)  # a level can never be brickless
+        wall = jnp.zeros((G, G), bool).at[1:4].set(mask)
+        return BreakoutVarState(
+            paddle=jax.random.randint(kp, (), 0, G, jnp.int32),
+            ball_r=jnp.int32(4),
+            ball_c=jax.random.randint(kc, (), 0, G, jnp.int32),
+            dr=jnp.int32(1),
+            dc=jnp.where(jax.random.bernoulli(kd), 1, -1).astype(jnp.int32),
+            bricks=wall,
+            wall=wall,
+            t=jnp.int32(0),
+        )
+
+    def _respawn(self, s) -> jnp.ndarray:
+        return s.wall
+
+
+class FreewayVarState(NamedTuple):
+    chicken: jnp.ndarray
+    cars: jnp.ndarray
+    speeds: jnp.ndarray  # [8] i32 — this level's per-lane beat
+    dirs: jnp.ndarray  # [8] i32 in {-1, +1}
+    t: jnp.ndarray
+
+
+class FreewayVarGame(FreewayGame):
+    """Level-randomized freeway: the level id fixes per-lane speeds (2..4)
+    and directions; car starting phases remain per-episode randomness."""
+
+    def __init__(self, pool_base: int, pool_size: int, cap: int = 500):
+        super().__init__(cap=cap)
+        self.pool_base = pool_base
+        self.pool_size = pool_size
+
+    def init(self, key) -> FreewayVarState:
+        kl, kc = jax.random.split(key)
+        ks, kd = jax.random.split(_level_key(self.pool_base, self.pool_size, kl))
+        return FreewayVarState(
+            chicken=jnp.int32(G - 1),
+            cars=jax.random.randint(kc, (8,), 0, G, jnp.int32),
+            speeds=jax.random.randint(ks, (8,), 2, 5, jnp.int32),
+            dirs=jnp.where(jax.random.bernoulli(kd, 0.5, (8,)), 1, -1).astype(
+                jnp.int32
+            ),
+            t=jnp.int32(0),
+        )
+
+    def _lane_dynamics(self, s):
+        return s.speeds, s.dirs
+
+
+VARIANT_GAMES = {
+    "breakout": BreakoutVarGame,
+    "freeway": FreewayVarGame,
+}
+
+
+# --------------------------------------------------------------------------
 # registry + batched auto-reset step (the Anakin building block)
 # --------------------------------------------------------------------------
 
@@ -481,7 +604,7 @@ EPISODE_TICK_BUDGET = {"catch": 64, "breakout": 512, "freeway": 600,
 
 
 def build_rollout(game: "DeviceGame", action_fn, episodes: int,
-                  max_ticks: int, history: int = 0):
+                  max_ticks: int, history: int = 0, actor_init=None):
     """One jitted (aux, key) -> first-episode returns [episodes] rollout over
     `episodes` parallel auto-reset lanes — the single episode-accounting core
     shared by the trainers' in-graph eval (train_anakin.build_fused_eval) and
@@ -491,10 +614,25 @@ def build_rollout(game: "DeviceGame", action_fn, episodes: int,
     actions from either the game states (state-based scripts; `history=0`
     skips stack upkeep) or the device frame stack (`history=C` maintains a
     [L, H, W, C] stack with cut-zeroing exactly like the training tick).
+
+    Recurrent actors: pass `actor_init(episodes) -> actor_state` (a pytree
+    of [episodes, ...] leaves whose reset value is zero, e.g. an LSTM (c, h))
+    and an `action_fn(aux, states, stack, key, actor_state) -> (actions,
+    actor_state)`; lanes whose episode cut are zero-reset by a keep mask,
+    exactly like the training tick's LSTM handling (train_anakin_r2d2.py).
+
     Returns are capped, never censored: a lane whose first episode is still
     running at `max_ticks` yields its partial return."""
     step = batched_reset_step(game)
     h, w = game.frame_shape
+
+    def mask_actor(actor_state, keep):
+        return jax.tree.map(
+            lambda x: x * keep.astype(x.dtype).reshape(
+                (-1,) + (1,) * (x.ndim - 1)
+            ),
+            actor_state,
+        )
 
     @jax.jit
     def run(aux, key):
@@ -502,13 +640,16 @@ def build_rollout(game: "DeviceGame", action_fn, episodes: int,
         states = batched_init(game, k_init, episodes)
 
         def tick(carry, k):
-            states, ep, stack, frame, keep, first, done = carry
+            states, ep, stack, frame, keep, first, done, actor = carry
             ka, ks = jax.random.split(k)
             if history:
                 from rainbow_iqn_apex_tpu.parallel.multihost import shift_stack
 
                 stack = shift_stack(stack, frame, keep)
-            actions = action_fn(aux, states, stack, ka)
+            if actor_init is None:
+                actions = action_fn(aux, states, stack, ka)
+            else:
+                actions, actor = action_fn(aux, states, stack, ka, actor)
             states, ep, nframe, _r, term, trunc, out_ret = step(
                 states, ep, actions, ks
             )
@@ -516,16 +657,19 @@ def build_rollout(game: "DeviceGame", action_fn, episodes: int,
             first = jnp.where(ended & ~done, out_ret, first)
             done = done | ended
             keep = (~(term | trunc)).astype(jnp.uint8)
-            return (states, ep, stack, nframe, keep, first, done), None
+            if actor_init is not None:
+                actor = mask_actor(actor, keep)
+            return (states, ep, stack, nframe, keep, first, done, actor), None
 
         carry = (
             states, jnp.zeros(episodes),
             jnp.zeros((episodes, h, w, max(history, 1)), jnp.uint8),
             jax.vmap(game.render)(states), jnp.ones(episodes, jnp.uint8),
             jnp.full((episodes,), jnp.nan), jnp.zeros(episodes, bool),
+            actor_init(episodes) if actor_init is not None else (),
         )
         carry, _ = jax.lax.scan(tick, carry, jax.random.split(k_scan, max_ticks))
-        _s, ep, _st, _f, _k, first, done = carry
+        _s, ep, _st, _f, _k, first, done, _a = carry
         # capped-return semantics: an unfinished first episode scores its
         # running return (ep still tracks the first episode iff never done)
         return jnp.where(done, first, ep)
@@ -534,12 +678,33 @@ def build_rollout(game: "DeviceGame", action_fn, episodes: int,
 
 
 def make_device_game(name: str) -> DeviceGame:
+    if "@" in name:
+        base, variant = name.split("@", 1)
+        cls = VARIANT_GAMES.get(base)
+        if cls is None:
+            raise ValueError(
+                f"game '{base}' has no seeded-variant mode (have: "
+                f"{', '.join(sorted(VARIANT_GAMES))})"
+            )
+        if variant == "var":
+            return cls(0, N_TRAIN_LEVELS)
+        if variant == "var-test":
+            return cls(N_TRAIN_LEVELS, N_TEST_LEVELS)
+        raise ValueError(
+            f"unknown variant '@{variant}' for '{base}' (want '@var' for the "
+            "train pool or '@var-test' for the held-out pool)"
+        )
     try:
         return GAMES[name]()
     except KeyError:
         raise ValueError(
             f"unknown jax game '{name}' (have: {', '.join(sorted(GAMES))})"
         ) from None
+
+
+def tick_budget(name: str, default: int = 512) -> int:
+    """Episode tick cap for a game id, variant-suffix aware."""
+    return EPISODE_TICK_BUDGET.get(name.split("@", 1)[0], default)
 
 
 def batched_init(game: DeviceGame, key, lanes: int):

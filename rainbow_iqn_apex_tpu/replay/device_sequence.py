@@ -205,11 +205,24 @@ class DeviceSequenceReplay:
         )
 
     # -------------------------------------------------------------- sampling
+    def _effective_priority(self, s: DeviceSeqState) -> jnp.ndarray:
+        """Cold-ring guard: when every priority is zero (empty ring, or a
+        ring whose only writes were scratch-row misses), degrade to a uniform
+        draw over the filled prefix — never the degenerate always-slot-0 draw
+        a zero cdf would produce.  Trainers still must warm-gate learning
+        (see build_device_r2d2_learn); this guard bounds the damage if one
+        doesn't."""
+        p = s.priority
+        uniform = (
+            jnp.arange(p.shape[0]) < jnp.maximum(s.filled, 1)
+        ).astype(jnp.float32)
+        return jnp.where(p.sum() > 0.0, p, uniform)
+
     def draw(self, s: DeviceSeqState, key: chex.PRNGKey,
              batch_size: int) -> jnp.ndarray:
         """Stratified proportional draw over ring priorities (mirror of
         SumTree.sample_stratified)."""
-        p = s.priority
+        p = self._effective_priority(s)
         total = p.sum()
         cdf = jnp.cumsum(p)
         u = (jnp.arange(batch_size) + jax.random.uniform(key, (batch_size,)))
@@ -219,15 +232,23 @@ class DeviceSequenceReplay:
         ).astype(jnp.int32)
 
     def assemble(
-        self, s: DeviceSeqState, idx: jnp.ndarray, beta: jnp.ndarray
+        self, s: DeviceSeqState, idx: jnp.ndarray, beta: jnp.ndarray,
+        *, with_weight: bool = True,
     ) -> Tuple[SequenceBatch, jnp.ndarray]:
         """Gather sequences + IS weights at slot ids.  Returns
-        (SequenceBatch with [B, L, H, W, 1] obs, prob [B])."""
-        p = s.priority
+        (SequenceBatch with [B, L, H, W, 1] obs, prob [B]).
+
+        ``with_weight=False`` returns batch.weight as ones for callers that
+        derive a globally consistent weight from ``prob`` instead (the
+        sharded learner's psum/pmax mixture formula)."""
+        p = self._effective_priority(s)
         total = p.sum()
         prob = jnp.maximum(p[idx] / jnp.maximum(total, 1e-12), 1e-12)
-        w = (s.filled.astype(jnp.float32) * prob) ** (-beta)
-        weight = w / w.max()
+        if with_weight:
+            w = (jnp.maximum(s.filled, 1).astype(jnp.float32) * prob) ** (-beta)
+            weight = w / w.max()
+        else:
+            weight = jnp.ones_like(prob)
         batch = SequenceBatch(
             obs=s.frames[idx][..., None],
             action=s.actions[idx],
@@ -258,7 +279,14 @@ def build_device_r2d2_learn(cfg, num_actions: int,
     """The fused R2D2 learner tick: draw -> assemble -> sequence learn step
     -> eta-mix priority write-back, one jittable pure function
     (train_state, replay_state, key, beta) -> (train_state, replay_state,
-    info) — the recurrent twin of replay/device.build_device_learn."""
+    info) — the recurrent twin of replay/device.build_device_learn.
+
+    WARM-GATE CONTRACT: callers must not invoke this until the ring holds a
+    meaningful population (the trainers gate on
+    ``filled >= max(learn_start // seq_total, 8)``, train_anakin_r2d2.py /
+    train_r2d2.py parity).  A cold ring degrades draw() to uniform-over-
+    filled (see _effective_priority) rather than corrupting training, but
+    the early gradients would still be on near-empty windows."""
     from rainbow_iqn_apex_tpu.ops.r2d2 import build_r2d2_learn_step
 
     learn_step = build_r2d2_learn_step(cfg, num_actions)
@@ -273,4 +301,148 @@ def build_device_r2d2_learn(cfg, num_actions: int,
         )
         return train_state, replay_state, info
 
+    return fused
+
+
+# ---------------------------------------------------------------------------
+# dp-sharded variant: per-shard rings under shard_map (the sequence twin of
+# replay/device.build_device_learn_sharded)
+# ---------------------------------------------------------------------------
+
+
+def stack_seq_shards(local_state: DeviceSeqState, n_dev: int) -> DeviceSeqState:
+    """The sharded-sequence state layout: every leaf of the per-shard
+    DeviceSeqState gains a leading device dim of size n_dev ("stacked
+    shards"), sharded P(axis) on dim 0.  Unlike the transition replay —
+    whose lockstep appends keep one REPLICATED cursor valid for all lanes —
+    sequence emission counts are data-dependent per lane group, so every
+    shard needs its own pos/filled/max_priority; stacking makes those
+    per-shard scalars one [n_dev] array like everything else."""
+    return jax.tree.map(
+        lambda x: jnp.broadcast_to(x[None], (n_dev, *x.shape)), local_state
+    )
+
+
+def device_seq_specs(axis: str = "dp"):
+    """PartitionSpecs for a stacked-shard DeviceSeqState (see
+    stack_seq_shards): every leaf sharded over its leading device dim."""
+    P = jax.sharding.PartitionSpec
+    return jax.tree.map(lambda _: P(axis), DeviceSeqState(*DeviceSeqState._fields))
+
+
+def device_seq_shardings(mesh, axis: str = "dp"):
+    P = jax.sharding.PartitionSpec
+    return jax.tree.map(
+        lambda s: jax.sharding.NamedSharding(mesh, s),
+        device_seq_specs(axis),
+        is_leaf=lambda x: isinstance(x, P),
+    )
+
+
+def _shard_map():
+    try:
+        return jax.shard_map
+    except AttributeError:  # pragma: no cover — older jax
+        from jax.experimental.shard_map import shard_map
+
+        return shard_map
+
+
+def _unstack(gs: DeviceSeqState) -> DeviceSeqState:
+    return jax.tree.map(lambda x: x[0], gs)
+
+
+def _restack(s: DeviceSeqState) -> DeviceSeqState:
+    return jax.tree.map(lambda x: x[None], s)
+
+
+def build_sharded_seq_append(replay: DeviceSequenceReplay, mesh,
+                             axis: str = "dp"):
+    """shard_map'd append over stacked-shard state: each device's lane group
+    emits into ITS OWN ring (rank/cumsum/pos all shard-local), so the
+    batched scatter never crosses devices.  Inputs are [total_lanes, ...]
+    arrays lane-sharded over `axis`; `replay` is configured with the
+    PER-DEVICE lane count and capacity."""
+    P = jax.sharding.PartitionSpec
+    state_spec = device_seq_specs(axis)
+    smap = _shard_map()
+
+    def _append(gs, frames, actions, rewards, terms, truncs, c, h):
+        s = replay.append(_unstack(gs), frames, actions, rewards, terms,
+                          truncs, c, h)
+        return _restack(s)
+
+    return smap(
+        _append, mesh=mesh,
+        in_specs=(state_spec, P(axis), P(axis), P(axis), P(axis), P(axis),
+                  P(axis), P(axis)),
+        out_specs=state_spec,
+    )
+
+
+def build_device_r2d2_learn_sharded(cfg, num_actions: int,
+                                    local_replay: DeviceSequenceReplay, mesh,
+                                    axis: str = "dp"):
+    """Multi-chip fused R2D2 learner: per-shard sequence rings, per-shard
+    draws of batch/n sequences, one dp-sharded recurrent learn step.
+
+    Because each shard contributes exactly batch/n draws regardless of how
+    full it is, global sampling is a uniform mixture over shards:
+    q(i) = prob_local(i) / n_dev.  Sequence emission is data-dependent, so
+    shard fills genuinely differ — N_global is a real psum over per-shard
+    fills (not the transition replay's symmetric filled * n shortcut) and IS
+    weights are pmax-normalised across shards.  The gradient all-reduce
+    stays GSPMD-inserted from the batch sharding."""
+    from rainbow_iqn_apex_tpu.ops.r2d2 import SequenceBatch, build_r2d2_learn_step
+
+    P = jax.sharding.PartitionSpec
+    n_dev = mesh.shape[axis]
+    if cfg.batch_size % n_dev:
+        raise ValueError(
+            f"batch {cfg.batch_size} not divisible by {n_dev} devices"
+        )
+    b_loc = cfg.batch_size // n_dev
+    learn_step = build_r2d2_learn_step(cfg, num_actions)
+    state_spec = device_seq_specs(axis)
+    batch_spec = SequenceBatch(
+        obs=P(axis), action=P(axis), reward=P(axis), done=P(axis),
+        valid=P(axis), init_c=P(axis), init_h=P(axis), weight=P(axis),
+    )
+    smap = _shard_map()
+
+    def _draw_assemble(gs, key, beta):
+        s = _unstack(gs)
+        k = jax.random.fold_in(key, jax.lax.axis_index(axis))
+        idx = local_replay.draw(s, k, b_loc)
+        batch, prob = local_replay.assemble(s, idx, beta, with_weight=False)
+        n_global = jax.lax.psum(s.filled, axis).astype(jnp.float32)
+        nq = jnp.maximum(jnp.maximum(n_global, 1.0) * prob / n_dev, 1e-12)
+        w = nq ** (-beta)
+        w = w / jax.lax.pmax(w.max(), axis)
+        return idx, batch.replace(weight=w)
+
+    def _write_back(gs, idx, td_mix):
+        return _restack(
+            local_replay.update_priorities(_unstack(gs), idx, td_mix)
+        )
+
+    draw_assemble = smap(
+        _draw_assemble, mesh=mesh,
+        in_specs=(state_spec, P(), P()),
+        out_specs=(P(axis), batch_spec),
+    )
+    write_back = smap(
+        _write_back, mesh=mesh,
+        in_specs=(state_spec, P(axis), P(axis)),
+        out_specs=state_spec,
+    )
+
+    def fused(train_state, replay_state, key, beta):
+        k_sample, k_learn = jax.random.split(key)
+        idx, batch = draw_assemble(replay_state, k_sample, beta)
+        train_state, info = learn_step(train_state, batch, k_learn)
+        replay_state = write_back(replay_state, idx, info["priorities"])
+        return train_state, replay_state, info
+
+    fused.draw_assemble = draw_assemble  # exposed for tests
     return fused

@@ -443,12 +443,11 @@ def train_anakin_fused(cfg: Config, max_frames: Optional[int] = None) -> Dict[st
     carry = place(init_fused_carry(cfg, game, replay, ts, ds, k_env, frames))
 
     # eval is in-graph too: greedy lanes scanned on device, one dispatch
-    from rainbow_iqn_apex_tpu.envs.device_games import EPISODE_TICK_BUDGET
+    from rainbow_iqn_apex_tpu.envs.device_games import tick_budget
 
     game_name = cfg.env_id.split(":", 1)[1]
     eval_fn = build_fused_eval(
-        cfg, game, cfg.eval_episodes,
-        max_ticks=EPISODE_TICK_BUDGET.get(game_name, 1024),
+        cfg, game, cfg.eval_episodes, max_ticks=tick_budget(game_name, 1024)
     )
 
     def run_eval(params, step_no: int) -> Dict[str, Any]:

@@ -1,0 +1,392 @@
+"""Fused R2D2 Anakin: recurrent actor + env + stored-state sequence replay +
+sequence learner, ALL inside one scanned XLA graph.
+
+The recurrent twin of train_anakin.train_anakin_fused — same Podracer/Anakin
+topology (the reference's actor+learner+Redis loop, SURVEY.md §3.1-3.2,
+collapsed into a single jitted program), with the transition ring replaced by
+the HBM sequence ring (replay/device_sequence.py) and the frame-stack actor
+replaced by the LSTM actor threading (c, h) through the scan carry.
+
+Semantics pinned to the host R2D2 trainer (train_r2d2.py):
+  - the actor sees frame-stacked input AND an LSTM; the replay stores single
+    frames + the PRE-act LSTM state of each step (stored-state replay);
+  - LSTM state zero-resets on terminal OR truncation (keep mask);
+  - learn cadence: one sequence learn step per replay_ratio * r2d2_seq_len
+    env frames — the same per-transition reuse as the feedforward path —
+    expressed statically as `period` ticks per step (or k steps per tick
+    when lanes exceed that frame budget);
+  - warm gate: filled >= max(learn_start // seq_total, 8) sequences, the
+    host trainer's learn_start_seqs rule (and the contract
+    build_device_r2d2_learn documents).
+
+Multi-device (`--learner-devices N`): env lanes, LSTM lanes and the sequence
+ring shard over a dp mesh — per-shard rings under shard_map (sequence
+emission is data-dependent, so each shard owns its cursors), per-shard draws
+with psum/pmax-corrected IS weights, GSPMD gradient all-reduce
+(replay/device_sequence.build_device_r2d2_learn_sharded).
+"""
+
+from __future__ import annotations
+
+import collections
+import functools
+import os
+from typing import Any, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from rainbow_iqn_apex_tpu.config import Config
+from rainbow_iqn_apex_tpu.ops.r2d2 import (
+    build_r2d2_act_step,
+    init_r2d2_state,
+)
+from rainbow_iqn_apex_tpu.parallel.multihost import shift_stack
+from rainbow_iqn_apex_tpu.replay.device_sequence import (
+    DeviceSeqState,
+    DeviceSequenceReplay,
+    build_device_r2d2_learn,
+)
+from rainbow_iqn_apex_tpu.utils.checkpoint import Checkpointer
+from rainbow_iqn_apex_tpu.utils.logging import MetricsLogger
+
+
+def _seq_geometry(cfg: Config):
+    """(seq_total, stride, capacity, learn_start_seqs) — host-trainer parity
+    (train_r2d2.train_r2d2)."""
+    seq_total = cfg.r2d2_burn_in + cfg.r2d2_seq_len
+    stride = max(seq_total - cfg.r2d2_overlap, 1)
+    capacity = max(cfg.memory_capacity // seq_total, 64)
+    learn_start_seqs = max(cfg.learn_start // seq_total, 8)
+    return seq_total, stride, capacity, learn_start_seqs
+
+
+def _learn_cadence(cfg: Config):
+    """Static (period_ticks, learns_per_tick) for the in-graph cadence:
+    one learn step per replay_ratio * r2d2_seq_len env frames."""
+    fps = cfg.replay_ratio * cfg.r2d2_seq_len
+    lanes = cfg.num_envs_per_actor
+    if fps % lanes == 0:
+        return fps // lanes, 1
+    if lanes % fps == 0:
+        return 1, lanes // fps
+    raise ValueError(
+        f"fused R2D2 anakin needs lanes ({lanes}) and replay_ratio * "
+        f"r2d2_seq_len ({fps}) to divide one another — the learn cadence "
+        "is compiled into the graph"
+    )
+
+
+def build_fused_r2d2_segment(cfg: Config, game, replay: DeviceSequenceReplay,
+                             learn_fn, append_fn=None):
+    """Jitted (carry, key) -> (carry, outs) scanning anakin_segment_ticks of
+    shift_stack -> recurrent act -> env.step -> sequence append -> gated
+    learn.  carry = (ts, ss, env_states, ep_returns, stack, frame, keep,
+    lstm_c, lstm_h, frames); outs = per-tick (ep_return [L], loss/q_mean/
+    grad_norm [learns_per_tick], NaN when cold or off-cadence).
+
+    `append_fn` defaults to replay.append; the sharded path passes the
+    shard_map'd build_sharded_seq_append so each device's lanes emit into
+    their own ring."""
+    from rainbow_iqn_apex_tpu.envs.device_games import batched_reset_step
+
+    lanes = cfg.num_envs_per_actor
+    period, lpt = _learn_cadence(cfg)
+    _, _, _, learn_start_seqs = _seq_geometry(cfg)
+    act_fn = build_r2d2_act_step(cfg, game.num_actions, use_noise=True)
+    env_step = batched_reset_step(game)
+    append = append_fn or replay.append
+    bw = cfg.priority_weight
+
+    def tick(carry, k):
+        ts, ss, env_s, ep, stack, frame, keep, c, h, frames = carry
+        ka, ks, kl = jax.random.split(k, 3)
+        pre_c, pre_h = c, h  # stored-state replay keeps the PRE-act state
+        stack = shift_stack(stack, frame, keep)
+        actions, _q, (c, h) = act_fn(ts.params, stack, (c, h), ka)
+        env_s, ep, nframe, reward, term, trunc, out_ret = env_step(
+            env_s, ep, actions, ks
+        )
+        ss = append(ss, frame, actions, reward, term, trunc, pre_c, pre_h)
+        frames = frames + lanes
+
+        # warm gate (sum/min are shard-aware: filled is [n_dev] when the
+        # ring is stacked-sharded, a scalar otherwise) + static cadence
+        warm = (jnp.sum(ss.filled) >= learn_start_seqs) & (
+            jnp.min(ss.filled) >= 1
+        )
+        due = (frames // lanes) % period == 0
+        beta = jnp.float32(
+            bw + (1.0 - bw) * jnp.minimum(frames / float(cfg.t_max), 1.0)
+        )
+
+        def do_learn(args):
+            ts, ss = args
+
+            def one(cr, kk):
+                ts, ss = cr
+                ts, ss, info = learn_fn(ts, ss, kk, beta)
+                return (ts, ss), (info["loss"], info["q_mean"],
+                                  info["grad_norm"])
+
+            (ts, ss), infos = jax.lax.scan(
+                one, (ts, ss), jax.random.split(kl, lpt)
+            )
+            return ts, ss, infos
+
+        def no_learn(args):
+            ts, ss = args
+            nanv = jnp.full((lpt,), jnp.nan, jnp.float32)
+            return ts, ss, (nanv, nanv, nanv)
+
+        ts, ss, infos = jax.lax.cond(warm & due, do_learn, no_learn, (ts, ss))
+
+        cut_keep = (~(term | trunc)).astype(jnp.uint8)
+        kf = cut_keep.astype(jnp.float32)[:, None]
+        c, h = c * kf, h * kf  # LSTM zero-reset on episode cut
+        out = (out_ret, infos[0], infos[1], infos[2])
+        return (ts, ss, env_s, ep, stack, nframe, cut_keep, c, h, frames), out
+
+    @functools.partial(jax.jit, donate_argnums=(0,))
+    def segment(carry, key):
+        return jax.lax.scan(
+            tick, carry, jax.random.split(key, cfg.anakin_segment_ticks)
+        )
+
+    return segment
+
+
+def init_fused_r2d2_carry(cfg: Config, game, ts, ss, key, frames: int = 0):
+    from rainbow_iqn_apex_tpu.envs.device_games import batched_init
+
+    lanes = cfg.num_envs_per_actor
+    h, w = game.frame_shape
+    env_s = batched_init(game, key, lanes)
+    ep = jnp.zeros(lanes)
+    stack = jnp.zeros((lanes, h, w, cfg.history_length), jnp.uint8)
+    frame = jax.vmap(game.render)(env_s)
+    keep = jnp.ones(lanes, jnp.uint8)
+    # two distinct buffers: the segment donates its carry, and donating one
+    # array twice (aliased c == h) is a runtime error
+    c = jnp.zeros((lanes, cfg.lstm_size), jnp.float32)
+    h = jnp.zeros((lanes, cfg.lstm_size), jnp.float32)
+    return (ts, ss, env_s, ep, stack, frame, keep, c, h, jnp.int32(frames))
+
+
+def build_fused_r2d2_eval(cfg: Config, game, episodes: int,
+                          max_ticks: int = 1024):
+    """In-graph recurrent evaluation: greedy LSTM lanes on the shared rollout
+    core, state zero-reset on cut via the rollout's keep mask (the recurrent
+    analog of train_anakin.build_fused_eval)."""
+    from rainbow_iqn_apex_tpu.envs.device_games import build_rollout
+
+    act_fn = build_r2d2_act_step(cfg, game.num_actions,
+                                 use_noise=cfg.eval_noisy)
+
+    def action_fn(params, states, stack, key, lstm):
+        a, _q, lstm = act_fn(params, stack, lstm, key)
+        return a, lstm
+
+    def actor_init(n):
+        z = jnp.zeros((n, cfg.lstm_size), jnp.float32)
+        return (z, z)
+
+    return build_rollout(game, action_fn, episodes, max_ticks,
+                         history=cfg.history_length, actor_init=actor_init)
+
+
+def _replay_snapshot_path(cfg: Config) -> str:
+    return os.path.join(cfg.checkpoint_dir, cfg.run_id, "replay_anakin_r2d2.npz")
+
+
+def _save_replay(cfg: Config, ss: DeviceSeqState) -> None:
+    if not cfg.snapshot_replay:
+        return
+    from rainbow_iqn_apex_tpu.replay import snapshot_io
+
+    host = jax.device_get(ss)
+    snapshot_io.atomic_savez(
+        _replay_snapshot_path(cfg),
+        **{f: getattr(host, f) for f in DeviceSeqState._fields},
+    )
+
+
+def _maybe_restore_replay(cfg: Config, ss: DeviceSeqState) -> DeviceSeqState:
+    path = _replay_snapshot_path(cfg)
+    if not (cfg.snapshot_replay and os.path.exists(path)):
+        return ss
+    from rainbow_iqn_apex_tpu.replay import snapshot_io
+
+    z = snapshot_io.load(path)
+    if tuple(z["frames"].shape) != tuple(ss.frames.shape):
+        return ss  # geometry change: degrade to cold replay (host-path rule)
+    return DeviceSeqState(
+        **{f: jnp.asarray(z[f]) for f in DeviceSeqState._fields}
+    )
+
+
+def train_anakin_r2d2(cfg: Config,
+                      max_frames: Optional[int] = None) -> Dict[str, Any]:
+    """Fused R2D2 Anakin training loop (jaxgame:* envs only — the env must
+    compile into the graph)."""
+    from rainbow_iqn_apex_tpu.envs.device_games import (
+        make_device_game,
+        tick_budget,
+    )
+
+    if not (cfg.fused_env and cfg.env_id.startswith("jaxgame:")):
+        raise ValueError(
+            "anakin+r2d2 is the fused trainer: it needs --env-id jaxgame:* "
+            "with fused_env on (host-fed envs: use --role single/apex with "
+            "--architecture r2d2)"
+        )
+    total_frames = max_frames or cfg.t_max
+    lanes = cfg.num_envs_per_actor
+    T = cfg.anakin_segment_ticks
+    game_name = cfg.env_id.split(":", 1)[1]
+    game = make_device_game(game_name)
+    h, w = game.frame_shape
+    seq_total, stride, capacity, _ = _seq_geometry(cfg)
+    _learn_cadence(cfg)  # validate divisibility before building anything
+
+    key = jax.random.PRNGKey(cfg.seed)
+    key, k_init, k_env = jax.random.split(key, 3)
+    ts = init_r2d2_state(cfg, game.num_actions, k_init, frame_shape=(h, w))
+
+    n_dev = cfg.learner_devices if cfg.learner_devices > 0 else len(jax.devices())
+    if n_dev > 1:
+        from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+        from rainbow_iqn_apex_tpu.replay.device_sequence import (
+            build_device_r2d2_learn_sharded,
+            build_sharded_seq_append,
+            device_seq_shardings,
+            stack_seq_shards,
+        )
+
+        if lanes % n_dev or cfg.batch_size % n_dev or capacity % n_dev:
+            raise ValueError(
+                f"fused R2D2 anakin over {n_dev} devices needs lanes "
+                f"({lanes}), batch ({cfg.batch_size}) and sequence capacity "
+                f"({capacity}) divisible by the device count"
+            )
+        mesh = Mesh(np.array(jax.devices()[:n_dev]), ("dp",))
+        local_replay = DeviceSequenceReplay(
+            capacity=capacity // n_dev, seq_len=seq_total,
+            frame_shape=(h, w), lstm_size=cfg.lstm_size,
+            lanes=lanes // n_dev, stride=stride,
+            priority_exponent=cfg.priority_exponent,
+            priority_eps=cfg.priority_eps,
+        )
+        replay = local_replay
+        learn_fn = build_device_r2d2_learn_sharded(
+            cfg, game.num_actions, local_replay, mesh
+        )
+        append_fn = build_sharded_seq_append(local_replay, mesh)
+        ss0 = jax.device_put(
+            stack_seq_shards(local_replay.init_state(), n_dev),
+            device_seq_shardings(mesh),
+        )
+        _lane = NamedSharding(mesh, P("dp"))
+        _rep = NamedSharding(mesh, P())
+
+        def place(carry):
+            ts, ss, env_s, ep, stack, frame, keep, c, hh, frames = carry
+            lane_tree = jax.tree.map(
+                lambda x: jax.device_put(x, _lane),
+                (env_s, ep, stack, frame, keep, c, hh),
+            )
+            return (
+                jax.device_put(ts, _rep),
+                jax.device_put(ss, device_seq_shardings(mesh)),
+                *lane_tree,
+                jax.device_put(frames, _rep),
+            )
+    else:
+        replay = DeviceSequenceReplay(
+            capacity=capacity, seq_len=seq_total, frame_shape=(h, w),
+            lstm_size=cfg.lstm_size, lanes=lanes, stride=stride,
+            priority_exponent=cfg.priority_exponent,
+            priority_eps=cfg.priority_eps,
+        )
+        learn_fn = build_device_r2d2_learn(cfg, game.num_actions, replay)
+        append_fn = None
+        ss0 = replay.init_state()
+        place = lambda carry: carry  # noqa: E731
+
+    segment = build_fused_r2d2_segment(cfg, game, replay, learn_fn, append_fn)
+
+    run_dir = os.path.join(cfg.results_dir, cfg.run_id)
+    metrics = MetricsLogger(os.path.join(run_dir, "metrics.jsonl"), cfg.run_id)
+    ckpt = Checkpointer(os.path.join(cfg.checkpoint_dir, cfg.run_id))
+
+    frames = 0
+    ss = ss0
+    if cfg.resume and ckpt.latest_step() is not None:
+        ts, extra = ckpt.restore(ts)
+        frames = int(extra.get("frames", 0))
+        ss = _maybe_restore_replay(cfg, ss)
+        metrics.log("resume", step=int(ts.step), frames=frames)
+    learn_steps = int(ts.step)
+
+    carry = place(init_fused_r2d2_carry(cfg, game, ts, ss, k_env, frames))
+
+    eval_fn = build_fused_r2d2_eval(
+        cfg, game, cfg.eval_episodes, max_ticks=tick_budget(game_name, 1024)
+    )
+
+    def run_eval(params, step_no: int) -> Dict[str, Any]:
+        from rainbow_iqn_apex_tpu.train_anakin import fused_eval_scores
+
+        k = jax.random.fold_in(jax.random.PRNGKey(cfg.seed + 977), step_no)
+        return fused_eval_scores(eval_fn, params, k)
+
+    returns: collections.deque = collections.deque(maxlen=100)
+
+    def crossed(interval: int, before: int, after: int) -> bool:
+        return interval > 0 and before // interval != after // interval
+
+    while frames < total_frames:
+        key, k = jax.random.split(key)
+        carry, (out_ret, loss, q_mean, grad_norm) = segment(carry, k)
+        ts, ss = carry[0], carry[1]
+        frames += T * lanes
+        prev_steps = learn_steps
+        learn_steps = int(ts.step)
+        for r in np.asarray(out_ret)[~np.isnan(np.asarray(out_ret))]:
+            returns.append(float(r))
+
+        if crossed(cfg.metrics_interval, prev_steps, learn_steps):
+            l = np.asarray(loss)
+            metrics.log(
+                "train",
+                step=learn_steps,
+                frames=frames,
+                fps=metrics.fps(frames),
+                loss=float(np.nanmean(l)) if np.any(~np.isnan(l)) else float("nan"),
+                q_mean=float(np.nanmean(np.asarray(q_mean)))
+                if np.any(~np.isnan(np.asarray(q_mean))) else float("nan"),
+                grad_norm=float(np.nanmean(np.asarray(grad_norm)))
+                if np.any(~np.isnan(np.asarray(grad_norm))) else float("nan"),
+                mean_return=float(np.mean(returns)) if returns else float("nan"),
+            )
+        if crossed(cfg.eval_interval, prev_steps, learn_steps):
+            metrics.log("eval", step=learn_steps,
+                        **run_eval(carry[0].params, learn_steps))
+        if crossed(cfg.checkpoint_interval, prev_steps, learn_steps):
+            ckpt.save(learn_steps, ts, {"frames": frames})
+            _save_replay(cfg, ss)
+
+    final_eval = run_eval(carry[0].params, learn_steps)
+    metrics.log("eval", step=learn_steps, **final_eval)
+    ckpt.save(learn_steps, ts, {"frames": frames})
+    _save_replay(cfg, ss)
+    ckpt.wait()
+    metrics.close()
+    return {
+        "frames": frames,
+        "learn_steps": learn_steps,
+        "train_return_mean": float(np.mean(returns)) if returns else float("nan"),
+        **{f"eval_{k}": v for k, v in final_eval.items()},
+    }

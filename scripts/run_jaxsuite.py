@@ -31,9 +31,21 @@ def main() -> int:
                     help="subset of games (default: all)")
     ap.add_argument("--results-dir", default="results/jaxsuite")
     ap.add_argument("--baseline-episodes", type=int, default=64)
+    ap.add_argument("--generalization", action="store_true",
+                    help="instead of the score sweep, run the seeded-variant "
+                         "train/held-out level split (writes "
+                         "generalization.json)")
     args, passthrough = ap.parse_known_args()
     if passthrough and passthrough[0] == "--":
         passthrough = passthrough[1:]
+    if args.generalization:
+        from rainbow_iqn_apex_tpu.jaxsuite import run_generalization
+
+        out = run_generalization(passthrough, games=args.games,
+                                 results_dir=args.results_dir,
+                                 episodes=args.baseline_episodes)
+        print(json.dumps(out))
+        return 0
     agg = run_sweep(passthrough, games=args.games,
                     results_dir=args.results_dir,
                     baseline_episodes=args.baseline_episodes)

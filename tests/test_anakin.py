@@ -47,6 +47,7 @@ def _cfg(tmp_path, **kw):
     return Config(**base)
 
 
+@pytest.mark.slow
 def test_anakin_smoke_end_to_end(tmp_path):
     """Runs, learns steps on schedule, logs metrics, evals, checkpoints."""
     cfg = _cfg(tmp_path, checkpoint_interval=100)
@@ -63,6 +64,7 @@ def test_anakin_smoke_end_to_end(tmp_path):
     assert all(np.isfinite(r["loss"]) for r in train_rows)
 
 
+@pytest.mark.slow
 def test_anakin_resume_continues_counters(tmp_path):
     cfg = _cfg(tmp_path, checkpoint_interval=50, snapshot_replay=True)
     first = train_anakin(cfg, max_frames=1_200)

@@ -48,6 +48,7 @@ def _cfg(tmp_path, **kw):
     return Config(**base)
 
 
+@pytest.mark.slow
 def test_fused_smoke_end_to_end(tmp_path):
     """Dispatches through train_anakin (fused_env default), learns on the
     in-graph cadence, logs metrics, evals, checkpoints."""
@@ -80,6 +81,7 @@ def test_fused_host_loop_flag(tmp_path):
     assert summary["learn_steps"] > 0
 
 
+@pytest.mark.slow
 def test_fused_resume_continues_counters(tmp_path):
     cfg = _cfg(tmp_path, checkpoint_interval=50, snapshot_replay=True)
     first = train_anakin(cfg, max_frames=1_200)

@@ -131,6 +131,7 @@ def test_apex_r2d2_short_run_with_device_stack(tmp_path):
     assert np.isfinite(summary["eval_score_mean"])
 
 
+@pytest.mark.slow
 def test_apex_r2d2_kill_and_resume(tmp_path):
     """Resumed mesh R2D2 continues step/frame counters from the checkpoint
     and restores the sequence-replay snapshot (builder windows included)."""

@@ -77,6 +77,7 @@ def _spawn_pair(mode: str, *extra: str, timeout: float = 420.0):
     raise AssertionError(f"no JSON from process 0:\n{outs[0][-4000:]}")
 
 
+@pytest.mark.slow
 def test_two_process_learn_matches_single_process():
     """3 learn steps over a 2-process dp mesh == the same steps single-
     process on the full batch (same config/seed => same init and keys)."""
@@ -122,6 +123,7 @@ def test_two_process_learn_matches_single_process():
     np.testing.assert_allclose(result["checksum"], checksum, rtol=1e-5)
 
 
+@pytest.mark.slow
 def test_two_process_r2d2_learn_matches_single_process():
     """The recurrent learn step under the same 2-process topology: losses,
     local priority rows and the param checksum must match a single-process

@@ -277,6 +277,7 @@ def test_apex_short_run_with_host_stacker(tmp_path):
     assert np.isfinite(summary["eval_score_mean"])
 
 
+@pytest.mark.slow
 def test_apex_kill_and_resume(tmp_path):
     """Kill-and-resume: a second train_apex run with resume=True continues
     the step/frame counters exactly from the last checkpoint and restores

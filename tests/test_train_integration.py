@@ -65,6 +65,7 @@ def test_beta_anneal():
     assert priority_beta(cfg, 1000) == pytest.approx(1.0)  # clamped
 
 
+@pytest.mark.slow
 def test_short_run_checkpoint_resume(tmp_path):
     """A short run writes metrics + checkpoint; resume restores step/frames."""
     cfg = _cfg(tmp_path, learn_start=128, checkpoint_interval=0, eval_episodes=2)
@@ -83,6 +84,7 @@ def test_short_run_checkpoint_resume(tmp_path):
     assert extra["frames"] == s1["frames"]
 
 
+@pytest.mark.slow
 def test_eval_cli_roundtrips_both_architectures(tmp_path, capsys):
     """test_agent.py (the reference's eval entry point) must load and
     evaluate checkpoints from BOTH model families."""

@@ -1,0 +1,98 @@
+"""Seeded level variants (jaxgame:<g>@var / @var-test): the Procgen-class
+generalization stand-in (BASELINE.md config 5).  Levels are deterministic
+functions of their id; train and held-out pools are disjoint."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from rainbow_iqn_apex_tpu.envs.device_games import (
+    N_TRAIN_LEVELS,
+    BreakoutVarGame,
+    FreewayVarGame,
+    make_device_game,
+)
+
+
+def test_factory_parses_variants():
+    g = make_device_game("breakout@var")
+    assert isinstance(g, BreakoutVarGame)
+    assert (g.pool_base, g.pool_size) == (0, N_TRAIN_LEVELS)
+    t = make_device_game("freeway@var-test")
+    assert isinstance(t, FreewayVarGame)
+    assert t.pool_base == N_TRAIN_LEVELS
+    with pytest.raises(ValueError, match="no seeded-variant"):
+        make_device_game("catch@var")
+    with pytest.raises(ValueError, match="unknown variant"):
+        make_device_game("breakout@nope")
+
+
+def test_levels_are_deterministic_and_pools_disjoint():
+    """Same episode key -> same layout; train and test pools draw from
+    disjoint level ids, so their layout SETS differ."""
+    train = make_device_game("breakout@var")
+    test = make_device_game("breakout@var-test")
+    s1 = train.init(jax.random.PRNGKey(5))
+    s2 = train.init(jax.random.PRNGKey(5))
+    assert np.array_equal(np.asarray(s1.wall), np.asarray(s2.wall))
+
+    def walls(game, n=64):
+        return {
+            np.asarray(game.init(jax.random.PRNGKey(i)).wall).tobytes()
+            for i in range(n)
+        }
+
+    tr, te = walls(train), walls(test)
+    assert len(tr) > 4  # the train pool really varies layouts
+    assert not (tr & te)  # disjoint level pools -> disjoint layouts
+
+
+def test_breakout_var_respawns_its_own_wall():
+    game = make_device_game("breakout@var")
+    s = game.init(jax.random.PRNGKey(3))
+    wall = np.asarray(s.wall)
+    # clear all bricks but one, then hit it: respawn must be THIS level's
+    # wall, not the dense default
+    rows, cols = np.nonzero(wall)
+    keep_r, keep_c = int(rows[0]), int(cols[0])
+    bricks = jnp.zeros_like(s.bricks).at[keep_r, keep_c].set(True)
+    s = s._replace(
+        bricks=bricks,
+        ball_r=jnp.int32(keep_r + 1),
+        ball_c=jnp.int32(keep_c),
+        dr=jnp.int32(-1),
+        dc=jnp.int32(0),
+    )
+    s2, reward, term, _ = game.step(s, jnp.int32(0), jax.random.PRNGKey(0))
+    assert float(reward) == 1.0
+    assert np.array_equal(np.asarray(s2.bricks), wall)
+
+
+def test_freeway_var_uses_level_dynamics():
+    game = make_device_game("freeway@var")
+    s = game.init(jax.random.PRNGKey(11))
+    speeds = np.asarray(s.speeds)
+    dirs = np.asarray(s.dirs)
+    assert speeds.min() >= 2 and speeds.max() <= 4
+    assert set(np.unique(dirs)) <= {-1, 1}
+    # cars advance exactly on their per-level beat
+    s = s._replace(t=jnp.int32(0))
+    s2, *_ = game.step(s, jnp.int32(0), jax.random.PRNGKey(0))
+    moved = (np.asarray(s2.cars) - np.asarray(s.cars)) % 10
+    expect = np.where((0 % speeds) == 0, dirs % 10, 0)
+    assert np.array_equal(moved, expect % 10)
+
+
+def test_variant_games_run_in_fused_rollout():
+    """Variant states flow through the shared rollout core (vmap + scan +
+    auto-reset) — the path the fused trainer and eval use."""
+    from rainbow_iqn_apex_tpu.jaxsuite import _p_random, rollout_returns
+
+    rets = rollout_returns("breakout@var", _p_random, episodes=8, seed=0,
+                           max_ticks=64)
+    assert rets.shape == (8,)
+    assert np.isfinite(rets).all()
+    rets = rollout_returns("freeway@var-test", _p_random, episodes=8, seed=0,
+                           max_ticks=64)
+    assert np.isfinite(rets).all()

@@ -63,10 +63,10 @@ def main(argv=None) -> int:
         from rainbow_iqn_apex_tpu.train_anakin import train_anakin
 
         summary = train_anakin(cfg)
-    elif cfg.role == "anakin":
-        print("--role anakin supports --architecture iqn only (for now)",
-              file=sys.stderr)
-        return 2
+    elif cfg.role == "anakin" and cfg.architecture == "r2d2":
+        from rainbow_iqn_apex_tpu.train_anakin_r2d2 import train_anakin_r2d2
+
+        summary = train_anakin_r2d2(cfg)
     else:
         print(
             f"unknown --role '{cfg.role}' (want 'single', 'apex' or 'anakin'; "
