@@ -48,6 +48,7 @@ from rainbow_iqn_apex_tpu.replay.device_sequence import (
     DeviceSequenceReplay,
     build_device_r2d2_learn,
 )
+from rainbow_iqn_apex_tpu.train import priority_beta
 from rainbow_iqn_apex_tpu.utils.checkpoint import Checkpointer
 from rainbow_iqn_apex_tpu.utils.logging import MetricsLogger
 
@@ -228,19 +229,16 @@ def _maybe_restore_replay(cfg: Config, ss: DeviceSeqState) -> DeviceSeqState:
 
 def train_anakin_r2d2(cfg: Config,
                       max_frames: Optional[int] = None) -> Dict[str, Any]:
-    """Fused R2D2 Anakin training loop (jaxgame:* envs only — the env must
-    compile into the graph)."""
+    """R2D2 Anakin: HBM sequence replay either fully fused (jaxgame:* envs,
+    the flagship) or host-fed (any Env — the lag-one loop of
+    train_anakin.train_anakin with an LSTM actor)."""
     from rainbow_iqn_apex_tpu.envs.device_games import (
         make_device_game,
         tick_budget,
     )
 
     if not (cfg.fused_env and cfg.env_id.startswith("jaxgame:")):
-        raise ValueError(
-            "anakin+r2d2 is the fused trainer: it needs --env-id jaxgame:* "
-            "with fused_env on (host-fed envs: use --role single/apex with "
-            "--architecture r2d2)"
-        )
+        return _train_anakin_r2d2_hostfed(cfg, max_frames)
     total_frames = max_frames or cfg.t_max
     lanes = cfg.num_envs_per_actor
     T = cfg.anakin_segment_ticks
@@ -379,6 +377,154 @@ def train_anakin_r2d2(cfg: Config,
             _save_replay(cfg, ss)
 
     final_eval = run_eval(carry[0].params, learn_steps)
+    metrics.log("eval", step=learn_steps, **final_eval)
+    ckpt.save(learn_steps, ts, {"frames": frames})
+    _save_replay(cfg, ss)
+    ckpt.wait()
+    metrics.close()
+    return {
+        "frames": frames,
+        "learn_steps": learn_steps,
+        "train_return_mean": float(np.mean(returns)) if returns else float("nan"),
+        **{f"eval_{k}": v for k, v in final_eval.items()},
+    }
+
+
+def _train_anakin_r2d2_hostfed(cfg: Config,
+                               max_frames: Optional[int] = None) -> Dict[str, Any]:
+    """Host-fed R2D2 Anakin: env on host, everything else in HBM — sequence
+    ring, builders, LSTM state and frame stack all device-resident across
+    ticks; per tick the host ships one [L, H, W] frame tensor and reads back
+    actions (the exact lag-one staging of train_anakin.train_anakin, with
+    the recurrent actor).  This is the trainer real ALE Atari will use once
+    ROMs exist (SURVEY.md §2 native-dep row: ALE stays host-side)."""
+    from rainbow_iqn_apex_tpu.agents.agent import put_frames
+    from rainbow_iqn_apex_tpu.envs import make_vector_env
+
+    total_frames = max_frames or cfg.t_max
+    lanes = cfg.num_envs_per_actor
+    env = make_vector_env(cfg.env_id, lanes, seed=cfg.seed)
+    h, w = env.frame_shape
+    seq_total, stride, capacity, learn_start_seqs = _seq_geometry(cfg)
+    replay = DeviceSequenceReplay(
+        capacity=capacity, seq_len=seq_total, frame_shape=(h, w),
+        lstm_size=cfg.lstm_size, lanes=lanes, stride=stride,
+        priority_exponent=cfg.priority_exponent,
+        priority_eps=cfg.priority_eps,
+    )
+    key = jax.random.PRNGKey(cfg.seed)
+    key, k_init = jax.random.split(key)
+    ts = init_r2d2_state(cfg, env.num_actions, k_init, frame_shape=(h, w))
+    act_fn = build_r2d2_act_step(cfg, env.num_actions, use_noise=True)
+
+    @functools.partial(jax.jit, donate_argnums=(1, 2, 3))
+    def act_append(params, stack, ss, lstm, frame, keep, prev, key):
+        """Append LAST tick's completed transition (lag-one: reward/cut are
+        only known after env.step), zero-reset cut lanes' stack + LSTM, act.
+        Returns the pre-act LSTM state for the NEXT append (stored-state
+        replay keeps the state the actor had BEFORE seeing each frame)."""
+        if prev is not None:
+            ss = replay.append(ss, *prev)
+        stack = shift_stack(stack, frame, keep)
+        kf = keep.astype(jnp.float32)[:, None]
+        c, h2 = lstm[0] * kf, lstm[1] * kf
+        pre = (c, h2)
+        a, _q, lstm = act_fn(params, stack, (c, h2), key)
+        return a, stack, ss, lstm, pre
+
+    learn = jax.jit(
+        build_device_r2d2_learn(cfg, env.num_actions, replay),
+        donate_argnums=(0, 1),
+    )
+
+    run_dir = os.path.join(cfg.results_dir, cfg.run_id)
+    metrics = MetricsLogger(os.path.join(run_dir, "metrics.jsonl"), cfg.run_id)
+    ckpt = Checkpointer(os.path.join(cfg.checkpoint_dir, cfg.run_id))
+
+    frames = 0
+    ss = replay.init_state()
+    if cfg.resume and ckpt.latest_step() is not None:
+        ts, extra = ckpt.restore(ts)
+        frames = int(extra.get("frames", 0))
+        ss = _maybe_restore_replay(cfg, ss)
+        metrics.log("resume", step=int(ts.step), frames=frames)
+    learn_steps = int(ts.step)
+
+    stack = jnp.zeros((lanes, h, w, cfg.history_length), jnp.uint8)
+    z1 = jnp.zeros((lanes, cfg.lstm_size), jnp.float32)
+    z2 = jnp.zeros((lanes, cfg.lstm_size), jnp.float32)
+    lstm = (z1, z2)
+    obs = env.reset()
+    prev_cuts = np.zeros(lanes, bool)
+    prev = None
+    returns: collections.deque = collections.deque(maxlen=100)
+    device = jax.devices()[0]
+    frames_per_step = cfg.replay_ratio * cfg.r2d2_seq_len
+    warm = False  # latches: filled is monotone, so stop syncing once open
+
+    # one eval agent for the whole run (rebuilding it per eval would redo
+    # init + jit of the act step every interval)
+    from rainbow_iqn_apex_tpu.train_r2d2 import R2D2Agent, evaluate_r2d2
+
+    eval_agent = R2D2Agent(cfg, env.num_actions, env.frame_shape,
+                           jax.random.PRNGKey(cfg.seed + 31), train=False)
+
+    def run_eval(ts):
+        eval_agent.state = ts
+        return evaluate_r2d2(cfg, eval_agent, seed=cfg.seed + 977)
+
+    while frames < total_frames:
+        frame_d = put_frames(obs)
+        keep_d = jax.device_put((~prev_cuts).astype(np.uint8), device)
+        key, k = jax.random.split(key)
+        actions_d, stack, ss, lstm, pre = act_append(
+            ts.params, stack, ss, lstm, frame_d, keep_d, prev, k
+        )
+        actions = np.asarray(actions_d)
+        new_obs, rewards, terminals, truncs, ep_returns = env.step(actions)
+        prev = (
+            frame_d,
+            actions_d,
+            jax.device_put(rewards.astype(np.float32), device),
+            jax.device_put(terminals, device),
+            jax.device_put(truncs, device),
+            pre[0],
+            pre[1],
+        )
+        prev_cuts = terminals | truncs
+        obs = new_obs
+        frames += lanes
+        for r in ep_returns[~np.isnan(ep_returns)]:
+            returns.append(float(r))
+
+        # warm gate on the ring's own sequence count (one scalar readback
+        # per tick until it opens — the fused path avoids even this)
+        warm = warm or int(jax.device_get(ss.filled)) >= learn_start_seqs
+        if warm:
+            steps_due = frames // frames_per_step - learn_steps
+            for _ in range(max(steps_due, 0)):
+                key, k = jax.random.split(key)
+                ts, ss, info = learn(
+                    ts, ss, k, jnp.float32(priority_beta(cfg, frames))
+                )
+                learn_steps += 1
+                if learn_steps % cfg.metrics_interval == 0:
+                    metrics.log(
+                        "train", step=learn_steps, frames=frames,
+                        fps=metrics.fps(frames), loss=float(info["loss"]),
+                        q_mean=float(info["q_mean"]),
+                        grad_norm=float(info["grad_norm"]),
+                        mean_return=float(np.mean(returns))
+                        if returns else float("nan"),
+                    )
+                if cfg.eval_interval and learn_steps % cfg.eval_interval == 0:
+                    metrics.log("eval", step=learn_steps, **run_eval(ts))
+                if (cfg.checkpoint_interval
+                        and learn_steps % cfg.checkpoint_interval == 0):
+                    ckpt.save(learn_steps, ts, {"frames": frames})
+                    _save_replay(cfg, ss)
+
+    final_eval = run_eval(ts)
     metrics.log("eval", step=learn_steps, **final_eval)
     ckpt.save(learn_steps, ts, {"frames": frames})
     _save_replay(cfg, ss)

@@ -229,6 +229,79 @@ def main() -> None:
         except Exception as e:
             emit(phase="fused_anakin", error=repr(e)[:200])
 
+    # ---- phase 3d: fused R2D2 anakin (recurrent flagship) ----------------
+    if left() > BUDGET * 0.15:
+        try:
+            import numpy as _np2
+
+            from rainbow_iqn_apex_tpu.envs.device_games import (
+                make_device_game as _mk2,
+            )
+            from rainbow_iqn_apex_tpu.ops.r2d2 import init_r2d2_state
+            from rainbow_iqn_apex_tpu.replay.device_sequence import (
+                DeviceSequenceReplay,
+                build_device_r2d2_learn,
+            )
+            from rainbow_iqn_apex_tpu.train_anakin_r2d2 import (
+                _learn_cadence,
+                _seq_geometry,
+                build_fused_r2d2_segment,
+                init_fused_r2d2_carry,
+            )
+
+            game2 = _mk2("breakout")
+            lanes2 = int(os.environ.get("TPUS_R2_LANES", "16"))
+            T2 = int(os.environ.get("TPUS_R2_TICKS", "32"))
+            r2cfg = cfg.replace(
+                architecture="r2d2",
+                num_envs_per_actor=lanes2,
+                anakin_segment_ticks=T2,
+                r2d2_burn_in=8, r2d2_seq_len=16, r2d2_overlap=8,
+                replay_ratio=lanes2 // 16 or 1,  # fps 16 vs lanes: learn ~1/tick
+                memory_capacity=512 * 24,  # 512 sequences of burn_in+seq_len
+                learn_start=8 * 24,
+            )
+            h2, w2 = game2.frame_shape
+            # one source of truth for ring geometry: the trainer's own rule
+            seq_total, stride2, cap2, _ = _seq_geometry(r2cfg)
+            rep2 = DeviceSequenceReplay(
+                capacity=cap2, seq_len=seq_total, frame_shape=(h2, w2),
+                lstm_size=r2cfg.lstm_size, lanes=lanes2, stride=stride2,
+                priority_exponent=r2cfg.priority_exponent,
+                priority_eps=r2cfg.priority_eps,
+            )
+            rts = init_r2d2_state(r2cfg, game2.num_actions,
+                                  jax.random.PRNGKey(0), (h2, w2))
+            seg2 = build_fused_r2d2_segment(
+                r2cfg, game2, rep2,
+                build_device_r2d2_learn(r2cfg, game2.num_actions, rep2),
+            )
+            carry2 = init_fused_r2d2_carry(r2cfg, game2, rts,
+                                           rep2.init_state(),
+                                           jax.random.PRNGKey(1))
+            kk2 = jax.random.PRNGKey(2)
+            for _ in range(2):  # compile + warm past learn_start
+                kk2, k2 = jax.random.split(kk2)
+                carry2, (_, l2, _, _) = seg2(carry2, k2)
+            jax.block_until_ready(l2)
+            n2 = 0
+            t = time.perf_counter()
+            while n2 < 8 and (n2 < 1 or left() > BUDGET * 0.08):
+                kk2, k2 = jax.random.split(kk2)
+                carry2, (_, l2, _, _) = seg2(carry2, k2)
+                jax.block_until_ready(l2)
+                n2 += 1
+            dt = time.perf_counter() - t
+            period2, lpt2 = _learn_cadence(r2cfg)
+            warm2 = int(_np2.isfinite(_np2.asarray(l2)[:, -1]).sum())
+            emit(phase="fused_r2d2_anakin",
+                 env_frames_per_sec=round(n2 * T2 * lanes2 / dt, 1),
+                 learn_steps_per_sec=round(n2 * T2 * lpt2 / period2 / dt, 1),
+                 warm_ticks_last_seg=warm2, ticks_per_seg=T2, lanes=lanes2,
+                 note="jaxgame:breakout 80x80, lstm512 seq16+8, fused graph")
+        except Exception as e:
+            emit(phase="fused_r2d2_anakin", error=repr(e)[:200])
+
     # ---- phase 4: pallas sweep (riskiest compile, deliberately last) -----
     if left() > 60:
         try:

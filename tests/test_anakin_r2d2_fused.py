@@ -82,10 +82,22 @@ def test_fused_r2d2_smoke_end_to_end(tmp_path):
     assert all(np.isfinite(r["loss"]) for r in train_rows)
 
 
-def test_fused_r2d2_requires_jaxgame(tmp_path):
-    cfg = _cfg(tmp_path, env_id="toy:catch")
-    with pytest.raises(ValueError, match="jaxgame"):
-        train_anakin_r2d2(cfg, max_frames=100)
+def test_hostfed_anakin_r2d2_smoke(tmp_path):
+    """Non-jaxgame envs dispatch to the host-fed loop: env on host, sequence
+    ring + LSTM + stack device-resident, lag-one appends."""
+    cfg = _cfg(
+        tmp_path,
+        env_id="toy:catch",
+        hidden_size=32,
+        lstm_size=16,
+        memory_capacity=2_000,
+        learn_start=200,
+        anakin_segment_ticks=8,
+    )
+    summary = train_anakin_r2d2(cfg, max_frames=1_200)
+    assert summary["frames"] >= 1_200
+    assert summary["learn_steps"] > 20
+    assert np.isfinite(summary["eval_score_mean"])
 
 
 @pytest.mark.slow
