@@ -197,3 +197,137 @@ def test_fused_r2d2_learn_runs():
     assert np.isfinite(float(info["loss"]))
     assert (np.asarray(ds.priority) != before).any()
     assert int(ts.step) == 1
+
+
+# --------------------------------------------------------------------------
+# cold-ring guard + dp-sharded variant (per-shard rings under shard_map)
+# --------------------------------------------------------------------------
+
+
+def test_cold_ring_draw_degrades_to_uniform():
+    """Zero-priority rings must not collapse every draw to slot 0: with a
+    filled prefix the guard draws uniformly over it; dead-empty rings keep
+    returning slot 0 but with finite weights (the trainers' warm gate is
+    the real protection — this bounds the damage if one forgets it)."""
+    _, dev = _make_pair()
+    ds = dev.init_state()
+    # dead-empty: slot 0, finite IS weights
+    idx = dev.draw(ds, jax.random.PRNGKey(0), 32)
+    assert set(np.asarray(idx).tolist()) == {0}
+    batch, prob = dev.assemble(ds, idx, jnp.float32(0.5))
+    assert np.isfinite(np.asarray(batch.weight)).all()
+    # filled prefix with zeroed priorities: uniform over the prefix
+    ds = ds._replace(filled=jnp.int32(5))
+    idx = np.asarray(dev.draw(ds, jax.random.PRNGKey(1), 64))
+    assert idx.max() < 5
+    assert len(set(idx.tolist())) > 1
+
+
+@pytest.mark.slow
+class TestShardedSequenceLearn:
+    """Per-shard sequence rings: the stacked-shard append equals independent
+    per-shard rings, and IS weights follow the psum/pmax mixture math."""
+
+    N_DEV = 4
+    LANES_PER = 2
+
+    def _mesh(self):
+        from jax.sharding import Mesh
+
+        return Mesh(np.array(jax.devices()[: self.N_DEV]), ("dp",))
+
+    def _local(self):
+        return DeviceSequenceReplay(
+            capacity=CAP, seq_len=L, frame_shape=(H, W), lstm_size=LSTM,
+            lanes=self.LANES_PER, stride=STRIDE, priority_exponent=OMEGA,
+            priority_eps=EPS,
+        )
+
+    def _fill(self, ticks=40, seed=3):
+        """Drive the shard_map'd append and, in parallel, N independent
+        local rings fed the same lane slices — they must agree."""
+        import jax as _jax
+
+        from rainbow_iqn_apex_tpu.replay.device_sequence import (
+            build_sharded_seq_append,
+            device_seq_shardings,
+            stack_seq_shards,
+        )
+
+        if len(_jax.devices()) < self.N_DEV:
+            pytest.skip("needs 4 devices")
+        mesh = self._mesh()
+        local = self._local()
+        append_sh = _jax.jit(build_sharded_seq_append(local, mesh))
+        gs = _jax.device_put(
+            stack_seq_shards(local.init_state(), self.N_DEV),
+            device_seq_shardings(mesh),
+        )
+        refs = [local.init_state() for _ in range(self.N_DEV)]
+        ref_append = _jax.jit(local.append)
+        rng = np.random.default_rng(seed)
+        Lt = self.N_DEV * self.LANES_PER
+        for _ in range(ticks):
+            term = rng.random(Lt) < 0.1
+            t = dict(
+                frames=rng.integers(0, 255, (Lt, H, W), dtype=np.uint8),
+                actions=rng.integers(0, 4, Lt).astype(np.int32),
+                rewards=rng.normal(size=Lt).astype(np.float32),
+                terminals=term,
+                truncations=(rng.random(Lt) < 0.07) & ~term,
+                lstm_c=rng.normal(size=(Lt, LSTM)).astype(np.float32),
+                lstm_h=rng.normal(size=(Lt, LSTM)).astype(np.float32),
+            )
+            gs = append_sh(gs, *(jnp.asarray(v) for v in t.values()))
+            for d in range(self.N_DEV):
+                sl = slice(d * self.LANES_PER, (d + 1) * self.LANES_PER)
+                refs[d] = ref_append(
+                    refs[d], *(jnp.asarray(v[sl]) for v in t.values())
+                )
+        return mesh, local, gs, refs
+
+    def test_stacked_append_equals_independent_shards(self):
+        _, _, gs, refs = self._fill()
+        for d, ref in enumerate(refs):
+            got = jax.tree.map(lambda x: np.asarray(x)[d], gs)
+            for field in ("frames", "actions", "priority", "pos", "filled",
+                          "init_c", "valids"):
+                assert np.allclose(
+                    np.asarray(getattr(got, field)),
+                    np.asarray(getattr(ref, field)),
+                ), (d, field)
+
+    def test_sharded_is_weights_match_mixture_math(self):
+        from rainbow_iqn_apex_tpu.config import Config
+        from rainbow_iqn_apex_tpu.replay.device_sequence import (
+            build_device_r2d2_learn_sharded,
+        )
+
+        mesh, local, gs, refs = self._fill()
+        cfg = Config(
+            compute_dtype="float32", history_length=1, hidden_size=32,
+            num_cosines=8, lstm_size=LSTM, r2d2_burn_in=2,
+            r2d2_seq_len=L - 2, batch_size=8, multi_step=1, gamma=0.9,
+        )
+        fused = build_device_r2d2_learn_sharded(cfg, 4, local, mesh)
+        beta = jnp.float32(0.6)
+        idx, batch = jax.jit(fused.draw_assemble)(
+            gs, jax.random.PRNGKey(9), beta
+        )
+        idx = np.asarray(idx)
+        w = np.asarray(batch.weight)
+        # host recomputation of the mixture formula from the shard states
+        b_loc = cfg.batch_size // self.N_DEV
+        n_global = sum(int(r.filled) for r in refs)
+        want = []
+        for d, ref in enumerate(refs):
+            p = np.asarray(ref.priority)
+            # cold shards would use the uniform guard; these are warm
+            assert p.sum() > 0
+            prob = np.maximum(p[idx[d * b_loc:(d + 1) * b_loc]] / p.sum(),
+                              1e-12)
+            nq = np.maximum(n_global * prob / self.N_DEV, 1e-12)
+            want.append(nq ** (-float(beta)))
+        want = np.concatenate(want)
+        want = want / want.max()
+        assert np.allclose(w, want, rtol=1e-5), (w, want)
