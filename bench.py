@@ -66,6 +66,23 @@ def measure() -> None:
     def left() -> float:
         return CHILD_BUDGET_SECS - (time.monotonic() - t_start)
 
+    # Backend init can block for many minutes against a DEAD relay (round-3
+    # observation: ~15 min then UNAVAILABLE), which the soft budget cannot
+    # interrupt from Python.  A SIGALRM self-exit bounds it: the process
+    # exits itself (same OS-level socket close the parent's watchdog kill
+    # would eventually cause) minutes earlier, so the parent reaches the CPU
+    # fallback while the driver is still listening.
+    import signal
+
+    def _init_deadline(signum, frame):  # pragma: no cover — timing-dependent
+        print("bench child: backend init exceeded deadline, giving up",
+              file=sys.stderr, flush=True)
+        os._exit(3)
+
+    if hasattr(signal, "SIGALRM"):
+        signal.signal(signal.SIGALRM, _init_deadline)
+        signal.alarm(max(int(CHILD_BUDGET_SECS * 0.5), 30))
+
     import jax
     import numpy as np
 
@@ -78,6 +95,8 @@ def measure() -> None:
     )
 
     platform = jax.devices()[0].platform
+    if hasattr(signal, "SIGALRM"):
+        signal.alarm(0)  # backend is up; soft-budget checks take over
     print(f"bench child: platform={platform} t_import={time.monotonic()-t_start:.1f}s",
           file=sys.stderr, flush=True)
     cfg = Config()  # reference defaults: 84x84x4, N=N'=64, K=32, batch 32
