@@ -533,7 +533,10 @@ class BreakoutVarGame(BreakoutGame):
             ball_c=jax.random.randint(kc, (), 0, G, jnp.int32),
             dr=jnp.int32(1),
             dc=jnp.where(jax.random.bernoulli(kd), 1, -1).astype(jnp.int32),
-            bricks=wall,
+            # distinct buffers: bricks and wall both ride the (donated)
+            # fused-trainer carry, and donating one buffer twice is a
+            # runtime error
+            bricks=jnp.array(wall),
             wall=wall,
             t=jnp.int32(0),
         )

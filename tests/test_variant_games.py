@@ -84,6 +84,15 @@ def test_freeway_var_uses_level_dynamics():
     assert np.array_equal(moved, expect % 10)
 
 
+def test_variant_state_buffers_are_distinct():
+    """bricks/wall must not alias: the fused trainer donates its carry, and
+    a state with one buffer in two fields fails Execute() with 'donate the
+    same buffer twice' (the phase-3 generalization crash)."""
+    s = make_device_game("breakout@var").init(jax.random.PRNGKey(0))
+    assert (s.bricks.unsafe_buffer_pointer()
+            != s.wall.unsafe_buffer_pointer())
+
+
 def test_variant_games_run_in_fused_rollout():
     """Variant states flow through the shared rollout core (vmap + scan +
     auto-reset) — the path the fused trainer and eval use."""
