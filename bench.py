@@ -67,11 +67,12 @@ def measure() -> None:
         return CHILD_BUDGET_SECS - (time.monotonic() - t_start)
 
     # Backend init can block for many minutes against a DEAD relay (round-3
-    # observation: ~15 min then UNAVAILABLE), which the soft budget cannot
-    # interrupt from Python.  A SIGALRM self-exit bounds it: the process
-    # exits itself (same OS-level socket close the parent's watchdog kill
-    # would eventually cause) minutes earlier, so the parent reaches the CPU
-    # fallback while the driver is still listening.
+    # observation: ~15 min then UNAVAILABLE).  A SIGALRM self-exit bounds it
+    # WHEN the blocking call releases the GIL; measured round-3, this
+    # particular hang holds the GIL so the handler cannot run and the
+    # parent's 480s watchdog is the real bound (it fired and the CPU
+    # fallback completed with ~4 min to spare).  The alarm stays: it costs
+    # nothing and catches any GIL-releasing variant of the hang.
     import signal
 
     def _init_deadline(signum, frame):  # pragma: no cover — timing-dependent
