@@ -38,6 +38,38 @@ bench.main()
 
 
 @pytest.mark.slow
+def test_bench_parent_emits_cpu_row_before_device_attempt():
+    """Round-4 restructure: a dead relay must cost ~1 minute, not the watchdog.
+
+    The parent runs an env-stripped JAX_PLATFORMS=cpu child FIRST, so the
+    labelled fallback row is on stdout before the device child (which hangs
+    in GIL-held backend init when the relay is dead) is even launched.
+    Simulated dead relay: PALLAS_AXON_POOL_IPS points at a blackhole address;
+    phase 1 must still produce the CPU row because its child strips the hook.
+    """
+    env = dict(os.environ)
+    env["PALLAS_AXON_POOL_IPS"] = "240.0.0.1"  # RFC 5735 blackhole
+    env.pop("JAX_PLATFORMS", None)  # parent must not skip the device phase
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    # device-phase backstop = max(10, 40-elapsed) + min(120, 40) grace = ~50s;
+    # CPU phase keeps its 300s floor, so worst case is well inside the 480s
+    # outer timeout even on a contended box
+    env["BENCH_WATCHDOG_SECS"] = "40"
+    p = subprocess.run(
+        [sys.executable, os.path.join(REPO, "bench.py")],
+        env=env, capture_output=True, text=True, timeout=480,
+    )
+    rows = [json.loads(l) for l in p.stdout.strip().splitlines()
+            if l.startswith("{")]
+    assert rows, (p.stdout, p.stderr[-2000:])
+    # phase 1's relay-immune CPU row is first and is a real measurement
+    assert rows[0]["path"] == "host_feed" and "cpu" in rows[0]["unit"]
+    assert rows[0]["value"] > 0
+    # whatever the device phase did, the last line is still parseable
+    assert "learn_steps/s" in rows[-1]["unit"]
+
+
+@pytest.mark.slow
 def test_bench_child_hard_exits_despite_hung_teardown():
     env = dict(os.environ)
     env.pop("PALLAS_AXON_POOL_IPS", None)
