@@ -58,6 +58,13 @@ def _upscale(grid: jnp.ndarray, cell: int) -> jnp.ndarray:
     return jnp.repeat(jnp.repeat(grid, cell, axis=0), cell, axis=1)
 
 
+def _rand_signs(key, shape=()) -> jnp.ndarray:
+    """Uniform ±1 i32 draw — the shared direction-sampling convention."""
+    return jnp.where(jax.random.bernoulli(key, 0.5, shape), 1, -1).astype(
+        jnp.int32
+    )
+
+
 class DeviceGame:
     """Base: a pure-functional game.  Subclasses define init/step/render as
     jit-safe single-instance functions; batching is the caller's vmap."""
@@ -164,7 +171,7 @@ class BreakoutGame(DeviceGame):
             ball_r=jnp.int32(4),
             ball_c=jax.random.randint(kc, (), 0, G, jnp.int32),
             dr=jnp.int32(1),
-            dc=jnp.where(jax.random.bernoulli(kd), 1, -1).astype(jnp.int32),
+            dc=_rand_signs(kd),
             bricks=self._wall(),
             t=jnp.int32(0),
         )
@@ -312,6 +319,19 @@ class AsterixGame(DeviceGame):
     SPAWN_P = 0.25  # per empty lane per tick
     MOVE_EVERY = 2  # entities advance every 2nd tick
 
+    def _lane_speeds(self, s):
+        """[8] i32 per-lane entity beat (advance every `speed` ticks) — the
+        variant subclass reads it from the per-level state."""
+        return jnp.full((8,), self.MOVE_EVERY, jnp.int32)
+
+    def _spawn_dirs(self, s, key):
+        """[8] i32 direction a spawn in each lane would take."""
+        return _rand_signs(key, (8,))
+
+    def _gold_probs(self, s):
+        """[8] f32 per-lane gold probability (base: MinAtar's 1-in-3)."""
+        return jnp.full((8,), 1.0 / 3.0, jnp.float32)
+
     def init(self, key) -> AsterixState:
         return AsterixState(
             pr=jnp.int32(G // 2),
@@ -330,7 +350,7 @@ class AsterixGame(DeviceGame):
         pc = jnp.clip(s.pc + dmove[action, 1], 0, G - 1)
 
         # advance entities on their beat; deactivate on exit
-        advance = s.active & ((s.t % self.MOVE_EVERY) == 0)
+        advance = s.active & ((s.t % self._lane_speeds(s)) == 0)
         col = s.col + jnp.where(advance, s.dirn, 0)
         exited = (col < 0) | (col > G - 1)
         active = s.active & ~exited
@@ -339,10 +359,8 @@ class AsterixGame(DeviceGame):
         # spawn into empty lanes (left edge moving right / right edge moving
         # left), 1-in-3 gold — MinAtar's treasure ratio
         spawn = (~active) & (jax.random.uniform(k_spawn, (8,)) < self.SPAWN_P)
-        new_dir = jnp.where(jax.random.bernoulli(k_dir, 0.5, (8,)), 1, -1).astype(
-            jnp.int32
-        )
-        new_gold = jax.random.uniform(k_gold, (8,)) < (1.0 / 3.0)
+        new_dir = self._spawn_dirs(s, k_dir)
+        new_gold = jax.random.uniform(k_gold, (8,)) < self._gold_probs(s)
         dirn = jnp.where(spawn, new_dir, s.dirn)
         col = jnp.where(spawn, jnp.where(new_dir > 0, 0, G - 1), col)
         gold = jnp.where(spawn, new_gold, s.gold)
@@ -356,7 +374,8 @@ class AsterixGame(DeviceGame):
         reward = jnp.where(hit_gold, 1.0, 0.0).astype(jnp.float32)
         active = active.at[lane].set(jnp.where(hit_gold, False, active[lane]))
 
-        ns = AsterixState(pr, pc, active, col, dirn, gold, s.t + 1)
+        ns = s._replace(pr=pr, pc=pc, active=active, col=col, dirn=dirn,
+                        gold=gold, t=s.t + 1)
         return ns, reward, terminal, jnp.bool_(False)
 
     def render(self, s: AsterixState) -> jnp.ndarray:
@@ -399,6 +418,18 @@ class InvadersGame(DeviceGame):
         a = jnp.zeros((G, G), bool)
         return a.at[1:5, 2:8].set(True)
 
+    def _march_every(self, s):
+        """Fleet march beat — the variant subclass reads it per-level."""
+        return jnp.int32(self.MARCH_EVERY)
+
+    def _bomb_every(self, s):
+        """Bomb release beat — the variant subclass reads it per-level."""
+        return jnp.int32(self.BOMB_EVERY)
+
+    def _respawn_fleet(self, s) -> jnp.ndarray:
+        """Fleet pattern a cleared wave respawns with."""
+        return self._fleet()
+
     def init(self, key) -> InvadersState:
         return InvadersState(
             pc=jnp.int32(G // 2),
@@ -431,7 +462,7 @@ class InvadersGame(DeviceGame):
         shot_r = jnp.where(hit, jnp.int32(-1), shot_r)
 
         # fleet march: sideways on the beat, down + reverse at an edge
-        march = (s.t % self.MARCH_EVERY) == 0
+        march = (s.t % self._march_every(s)) == 0
         cols_occ = aliens.any(axis=0)
         leftmost = jnp.argmax(cols_occ)
         rightmost = G - 1 - jnp.argmax(cols_occ[::-1])
@@ -444,7 +475,7 @@ class InvadersGame(DeviceGame):
 
         # bombing: a pseudorandom occupied column releases a bomb from its
         # lowest alien on the bomb beat
-        bomb_due = ((s.t % self.BOMB_EVERY) == 0) & (s.bomb_r < 0) & aliens.any()
+        bomb_due = ((s.t % self._bomb_every(s)) == 0) & (s.bomb_r < 0) & aliens.any()
         occ = aliens.any(axis=0)
         pick = jax.random.randint(key, (), 0, G, jnp.int32)
         # nearest occupied column to `pick` (static-shape argmin trick)
@@ -462,9 +493,10 @@ class InvadersGame(DeviceGame):
 
         # cleared fleet respawns
         cleared = ~aliens.any()
-        aliens = jnp.where(cleared, self._fleet(), aliens)
+        aliens = jnp.where(cleared, self._respawn_fleet(s), aliens)
 
-        ns = InvadersState(pc, aliens, adir, shot_r, shot_c, bomb_r, bomb_c, s.t + 1)
+        ns = s._replace(pc=pc, aliens=aliens, adir=adir, shot_r=shot_r,
+                        shot_c=shot_c, bomb_r=bomb_r, bomb_c=bomb_c, t=s.t + 1)
         return ns, reward, terminal, jnp.bool_(False)
 
     def render(self, s: InvadersState) -> jnp.ndarray:
@@ -532,7 +564,7 @@ class BreakoutVarGame(BreakoutGame):
             ball_r=jnp.int32(4),
             ball_c=jax.random.randint(kc, (), 0, G, jnp.int32),
             dr=jnp.int32(1),
-            dc=jnp.where(jax.random.bernoulli(kd), 1, -1).astype(jnp.int32),
+            dc=_rand_signs(kd),
             # distinct buffers: bricks and wall both ride the (donated)
             # fused-trainer carry, and donating one buffer twice is a
             # runtime error
@@ -569,9 +601,7 @@ class FreewayVarGame(FreewayGame):
             chicken=jnp.int32(G - 1),
             cars=jax.random.randint(kc, (8,), 0, G, jnp.int32),
             speeds=jax.random.randint(ks, (8,), 2, 5, jnp.int32),
-            dirs=jnp.where(jax.random.bernoulli(kd, 0.5, (8,)), 1, -1).astype(
-                jnp.int32
-            ),
+            dirs=_rand_signs(kd, (8,)),
             t=jnp.int32(0),
         )
 
@@ -579,9 +609,120 @@ class FreewayVarGame(FreewayGame):
         return s.speeds, s.dirs
 
 
+class AsterixVarState(NamedTuple):
+    pr: jnp.ndarray
+    pc: jnp.ndarray
+    active: jnp.ndarray
+    col: jnp.ndarray
+    dirn: jnp.ndarray
+    gold: jnp.ndarray
+    speeds: jnp.ndarray  # [8] i32 — this level's per-lane entity beat
+    lane_dir: jnp.ndarray  # [8] i32 — this level's fixed per-lane stream dir
+    gold_p: jnp.ndarray  # [8] f32 — this level's per-lane gold probability
+    t: jnp.ndarray
+
+
+class AsterixVarGame(AsterixGame):
+    """Level-randomized asterix: the level id fixes per-lane entity speeds
+    (beat 1..3 — some lanes faster than the base game's 2), a fixed stream
+    direction per lane, and a per-lane gold probability (the 'gold layout');
+    spawn timing and which lanes fire remain per-episode randomness."""
+
+    def __init__(self, pool_base: int, pool_size: int):
+        self.pool_base = pool_base
+        self.pool_size = pool_size
+
+    def init(self, key) -> AsterixVarState:
+        ks, kd, kg = jax.random.split(
+            _level_key(self.pool_base, self.pool_size, key), 3
+        )
+        return AsterixVarState(
+            pr=jnp.int32(G // 2),
+            pc=jnp.int32(G // 2),
+            active=jnp.zeros(8, bool),
+            col=jnp.zeros(8, jnp.int32),
+            dirn=jnp.ones(8, jnp.int32),
+            gold=jnp.zeros(8, bool),
+            speeds=jax.random.randint(ks, (8,), 1, 4, jnp.int32),
+            lane_dir=_rand_signs(kd, (8,)),
+            gold_p=jax.random.uniform(kg, (8,), minval=0.15, maxval=0.5),
+            t=jnp.int32(0),
+        )
+
+    def _lane_speeds(self, s):
+        return s.speeds
+
+    def _spawn_dirs(self, s, key):
+        return s.lane_dir
+
+    def _gold_probs(self, s):
+        return s.gold_p
+
+
+class InvadersVarState(NamedTuple):
+    pc: jnp.ndarray
+    aliens: jnp.ndarray
+    adir: jnp.ndarray
+    shot_r: jnp.ndarray
+    shot_c: jnp.ndarray
+    bomb_r: jnp.ndarray
+    bomb_c: jnp.ndarray
+    fleet: jnp.ndarray  # [G, G] bool — this level's respawn template
+    march_every: jnp.ndarray  # i32 — this level's march beat
+    bomb_every: jnp.ndarray  # i32 — this level's bomb beat
+    t: jnp.ndarray
+
+
+class InvadersVarGame(InvadersGame):
+    """Level-randomized invaders: the level id fixes the initial fleet
+    pattern (~4/5-density mask over the 4x6 block), the march beat (3..5)
+    and the bomb beat (4..8), plus the starting march direction; bomb column
+    choice stays per-episode randomness.  The fleet template rides in the
+    state so cleared waves respawn THIS level's pattern."""
+
+    def __init__(self, pool_base: int, pool_size: int):
+        self.pool_base = pool_base
+        self.pool_size = pool_size
+
+    def init(self, key) -> InvadersVarState:
+        kf, km, kb, kd = jax.random.split(
+            _level_key(self.pool_base, self.pool_size, key), 4
+        )
+        mask = jax.random.uniform(kf, (4, 6)) < 0.8
+        mask = mask.at[0, 3].set(True)  # a level can never start alien-less
+        fleet = jnp.zeros((G, G), bool).at[1:5, 2:8].set(mask)
+        return InvadersVarState(
+            pc=jnp.int32(G // 2),
+            # distinct buffers: aliens and fleet both ride the (donated)
+            # fused-trainer carry, and donating one buffer twice is a
+            # runtime error
+            aliens=jnp.array(fleet),
+            adir=_rand_signs(kd),
+            shot_r=jnp.int32(-1),
+            shot_c=jnp.int32(0),
+            bomb_r=jnp.int32(-1),
+            bomb_c=jnp.int32(0),
+            fleet=fleet,
+            march_every=jax.random.randint(km, (), 3, 6, jnp.int32),
+            bomb_every=jax.random.randint(kb, (), 4, 9, jnp.int32),
+            t=jnp.int32(0),
+        )
+
+    def _march_every(self, s):
+        return s.march_every
+
+    def _bomb_every(self, s):
+        return s.bomb_every
+
+    def _respawn_fleet(self, s) -> jnp.ndarray:
+        return s.fleet
+
+
 VARIANT_GAMES = {
     "breakout": BreakoutVarGame,
     "freeway": FreewayVarGame,
+    "asterix": AsterixVarGame,
+    "invaders": InvadersVarGame,
 }
 
 

@@ -114,13 +114,17 @@ def _p_freeway(game):
     def _danger(state, row):
         """Will the lane at `row` (chicken rows 1..8) be dangerous next
         tick?  A car within 2 cells and approaching, or parked on the
-        crossing column."""
+        crossing column.  Lane dynamics come from the game's
+        `_lane_dynamics(state)` hook, NOT the class constants, so the script
+        stays a valid ceiling for '@var' levels whose speeds/dirs ride in
+        the state."""
         lane = row - 1
         on_road = (lane >= 0) & (lane < 8)
         li = jnp.clip(lane, 0, 7)
         car = state.cars[li]
+        _speeds, dirs = game._lane_dynamics(state)
         gap = car - COL  # signed distance to the crossing column
-        approaching = jnp.sign(-gap) == jnp.sign(game.DIRS[li])
+        approaching = jnp.sign(-gap) == jnp.sign(dirs[li])
         near = jnp.abs(gap) <= 2
         return on_road & ((gap == 0) | (near & approaching))
 

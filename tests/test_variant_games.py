@@ -9,8 +9,10 @@ import pytest
 
 from rainbow_iqn_apex_tpu.envs.device_games import (
     N_TRAIN_LEVELS,
+    AsterixVarGame,
     BreakoutVarGame,
     FreewayVarGame,
+    InvadersVarGame,
     make_device_game,
 )
 
@@ -22,6 +24,8 @@ def test_factory_parses_variants():
     t = make_device_game("freeway@var-test")
     assert isinstance(t, FreewayVarGame)
     assert t.pool_base == N_TRAIN_LEVELS
+    assert isinstance(make_device_game("asterix@var"), AsterixVarGame)
+    assert isinstance(make_device_game("invaders@var-test"), InvadersVarGame)
     with pytest.raises(ValueError, match="no seeded-variant"):
         make_device_game("catch@var")
     with pytest.raises(ValueError, match="unknown variant"):
@@ -93,6 +97,107 @@ def test_variant_state_buffers_are_distinct():
             != s.wall.unsafe_buffer_pointer())
 
 
+def test_asterix_var_uses_level_dynamics():
+    game = make_device_game("asterix@var")
+    s = game.init(jax.random.PRNGKey(9))
+    speeds = np.asarray(s.speeds)
+    assert speeds.min() >= 1 and speeds.max() <= 3
+    assert set(np.unique(np.asarray(s.lane_dir))) <= {-1, 1}
+    gp = np.asarray(s.gold_p)
+    assert (gp >= 0.15).all() and (gp <= 0.5).all()
+    # entities advance exactly on their per-level beat: for each tick t in
+    # 1..6, every speed in {1,2,3} has at least one t where it fires and one
+    # where it doesn't, so a beat regression in any speed class is caught
+    dirs = np.asarray(s.lane_dir)
+    for t in range(1, 7):
+        st = s._replace(active=jnp.ones(8, bool),
+                        col=jnp.full(8, 5, jnp.int32), dirn=s.lane_dir,
+                        pr=jnp.int32(1), pc=jnp.int32(0), t=jnp.int32(t))
+        s2, *_ = game.step(st, jnp.int32(0), jax.random.PRNGKey(0))
+        moved = np.asarray(s2.col) - 5
+        expect = np.where((t % speeds) == 0, dirs, 0)
+        assert np.array_equal(moved, expect), (t, moved, expect)
+
+
+def test_asterix_var_levels_deterministic_and_disjoint():
+    train = make_device_game("asterix@var")
+    test = make_device_game("asterix@var-test")
+    a = train.init(jax.random.PRNGKey(4))
+    b = train.init(jax.random.PRNGKey(4))
+    for f in ("speeds", "lane_dir", "gold_p"):
+        assert np.array_equal(np.asarray(getattr(a, f)),
+                              np.asarray(getattr(b, f)))
+
+    def levels(game, n=64):
+        return {
+            np.asarray(game.init(jax.random.PRNGKey(i)).gold_p).tobytes()
+            for i in range(n)
+        }
+
+    tr, te = levels(train), levels(test)
+    assert len(tr) > 4
+    assert not (tr & te)
+
+
+def test_invaders_var_levels_deterministic_and_disjoint():
+    train = make_device_game("invaders@var")
+    test = make_device_game("invaders@var-test")
+    a = train.init(jax.random.PRNGKey(4))
+    b = train.init(jax.random.PRNGKey(4))
+    assert np.array_equal(np.asarray(a.fleet), np.asarray(b.fleet))
+    assert 3 <= int(a.march_every) <= 5
+    assert 4 <= int(a.bomb_every) <= 8
+
+    def fleets(game, n=64):
+        return {
+            np.asarray(game.init(jax.random.PRNGKey(i)).fleet).tobytes()
+            for i in range(n)
+        }
+
+    tr, te = fleets(train), fleets(test)
+    assert len(tr) > 4
+    assert not (tr & te)
+
+
+def test_invaders_var_respawns_its_own_fleet():
+    game = make_device_game("invaders@var")
+    s = game.init(jax.random.PRNGKey(3))
+    fleet = np.asarray(s.fleet)
+    # one alien left, player bullet one row below it: the kill clears the
+    # wave and the respawn must be THIS level's pattern, not the dense block
+    rows, cols = np.nonzero(fleet)
+    kr, kc = int(rows[0]), int(cols[0])
+    aliens = jnp.zeros_like(s.aliens).at[kr, kc].set(True)
+    s = s._replace(aliens=aliens, shot_r=jnp.int32(kr + 1),
+                   shot_c=jnp.int32(kc), t=jnp.int32(1))
+    s2, reward, term, _ = game.step(s, jnp.int32(0), jax.random.PRNGKey(0))
+    assert float(reward) == 1.0
+    assert np.array_equal(np.asarray(s2.aliens), fleet)
+
+
+def test_invaders_var_state_buffers_are_distinct():
+    s = make_device_game("invaders@var").init(jax.random.PRNGKey(0))
+    assert (s.aliens.unsafe_buffer_pointer()
+            != s.fleet.unsafe_buffer_pointer())
+
+
+def test_freeway_script_reads_level_dynamics():
+    """ADVICE r3: the scripted freeway ceiling must read speeds/dirs via
+    game._lane_dynamics(state), not class constants, so baselining a
+    'freeway@var' id uses the level's real lane dynamics."""
+    from rainbow_iqn_apex_tpu.jaxsuite import _p_freeway, rollout_returns
+
+    rets = rollout_returns("freeway@var", _p_freeway, episodes=8, seed=0,
+                           max_ticks=200)
+    assert np.isfinite(rets).all()
+    # the gap-aware crosser must stay clearly above random on variant levels
+    from rainbow_iqn_apex_tpu.jaxsuite import _p_random
+
+    rnd = rollout_returns("freeway@var", _p_random, episodes=8, seed=0,
+                          max_ticks=200)
+    assert rets.mean() > rnd.mean()
+
+
 def test_variant_games_run_in_fused_rollout():
     """Variant states flow through the shared rollout core (vmap + scan +
     auto-reset) — the path the fused trainer and eval use."""
@@ -105,3 +210,8 @@ def test_variant_games_run_in_fused_rollout():
     rets = rollout_returns("freeway@var-test", _p_random, episodes=8, seed=0,
                            max_ticks=64)
     assert np.isfinite(rets).all()
+    for gid in ("asterix@var", "invaders@var-test"):
+        rets = rollout_returns(gid, _p_random, episodes=8, seed=0,
+                               max_ticks=64)
+        assert rets.shape == (8,)
+        assert np.isfinite(rets).all()
