@@ -291,9 +291,16 @@ def aggregate(per_game_raw: Dict[str, float],
 
 def run_sweep(base_args: List[str], games: Optional[List[str]] = None,
               results_dir: str = "results/jaxsuite",
-              baseline_episodes: int = 64) -> Dict[str, float]:
+              baseline_episodes: int = 64,
+              per_game_args: Optional[Dict[str, List[str]]] = None
+              ) -> Dict[str, float]:
     """Train+eval each jax game via the training CLI (mirror of
-    atari57.run_sweep), then aggregate against measured baselines."""
+    atari57.run_sweep), then aggregate against measured baselines.
+
+    ``per_game_args`` appends extra CLI flags for specific games (e.g. a
+    bigger ``--t-max`` for the games whose scripted ceilings encode
+    trajectory-level skill).  per_game.csv and aggregate.json are rewritten
+    after EVERY game, so an interrupted sweep keeps its completed rows."""
     from rainbow_iqn_apex_tpu.atari57 import train_one_game, write_results_csv
 
     games = games or JAXSUITE
@@ -301,8 +308,20 @@ def run_sweep(base_args: List[str], games: Optional[List[str]] = None,
     baselines: Dict[str, Dict] = {}
     rows = []
     failed = []
+
+    def flush():
+        write_results_csv(os.path.join(results_dir, "per_game.csv"), rows)
+        agg = aggregate(per_game, baselines)
+        agg["games_failed"] = len(failed)
+        if failed:
+            agg["failed_games"] = failed
+        with open(os.path.join(results_dir, "aggregate.json"), "w") as f:
+            json.dump(agg, f, indent=2)
+        return agg
+
     for game in games:
-        summary = train_one_game(f"jaxgame:{game}", f"jaxsuite_{game}", base_args)
+        args = [*base_args, *(per_game_args or {}).get(game, [])]
+        summary = train_one_game(f"jaxgame:{game}", f"jaxsuite_{game}", args)
         raw = summary.get("eval_score_mean")
         if raw is None:
             # a failed/summary-less run must still leave a visible row —
@@ -310,6 +329,7 @@ def run_sweep(base_args: List[str], games: Optional[List[str]] = None,
             failed.append(game)
             rows.append({"game": game, "score_mean": None,
                          "error": "no eval summary from training run"})
+            flush()
             continue
         baselines[game] = measure_baselines(game, episodes=baseline_episodes)
         per_game[game] = raw
@@ -319,16 +339,11 @@ def run_sweep(base_args: List[str], games: Optional[List[str]] = None,
             "random_baseline": baselines[game].get("random"),
             "scripted_baseline": baselines[game].get("scripted"),
             "script_normalized": normalized_score(raw, baselines[game]),
+            "train_frames": summary.get("frames"),
             **{k: v for k, v in summary.items() if k.startswith("eval_")},
         })
-    write_results_csv(os.path.join(results_dir, "per_game.csv"), rows)
-    agg = aggregate(per_game, baselines)
-    agg["games_failed"] = len(failed)
-    if failed:
-        agg["failed_games"] = failed
-    with open(os.path.join(results_dir, "aggregate.json"), "w") as f:
-        json.dump(agg, f, indent=2)
-    return agg
+        flush()
+    return flush()
 
 
 # ------------------------------------------------- generalization (Procgen)
@@ -375,13 +390,20 @@ def eval_checkpoint_fused(base_args: List[str], run_id: str, game_name: str,
 def run_generalization(base_args: List[str],
                        games: Optional[List[str]] = None,
                        results_dir: str = "results/jaxsuite",
-                       episodes: int = 64) -> Dict:
+                       episodes: int = 64,
+                       per_game_args: Optional[Dict[str, List[str]]] = None
+                       ) -> Dict:
     """Procgen-class generalization check (BASELINE.md config 5 stand-in):
     train each variant game on its 16-seed TRAIN level pool
     (jaxgame:<g>@var), then eval the SAME checkpoint on train levels and on
     the 16 held-out levels (@var-test).  Writes
-    results_dir/generalization.json with per-game train/test scores and the
-    generalization gap."""
+    results_dir/generalization.json with per-game train/test scores, the
+    generalization gap, and the TRAIN-pool random baseline (a train score
+    that does not clearly beat random makes the gap meaningless — VERDICT
+    r3: such rows are reported with ``off_random: false`` so consumers can
+    filter them).  The JSON is rewritten after every game, and
+    ``per_game_args`` appends per-game flags (e.g. bigger ``--t-max`` for
+    slower-learning games)."""
     from rainbow_iqn_apex_tpu.atari57 import train_one_game
     from rainbow_iqn_apex_tpu.envs.device_games import VARIANT_GAMES
 
@@ -393,25 +415,40 @@ def run_generalization(base_args: List[str],
             f"{sorted(VARIANT_GAMES)})"
         )
     rows = []
+    os.makedirs(results_dir, exist_ok=True)
+
+    def flush():
+        out = {"episodes_per_split": episodes, "per_game": rows}
+        with open(os.path.join(results_dir, "generalization.json"), "w") as f:
+            json.dump(out, f, indent=2)
+        return out
+
     for g in games:
         run_id = f"jaxsuite_{g}_var"
-        summary = train_one_game(f"jaxgame:{g}@var", run_id, base_args)
+        args = [*base_args, *(per_game_args or {}).get(g, [])]
+        summary = train_one_game(f"jaxgame:{g}@var", run_id, args)
         if summary.get("eval_score_mean") is None:
             rows.append({"game": g, "error": "training run failed"})
+            flush()
             continue
-        train_score = eval_checkpoint_fused(base_args, run_id, f"{g}@var",
+        train_score = eval_checkpoint_fused(args, run_id, f"{g}@var",
                                             episodes)
-        test_score = eval_checkpoint_fused(base_args, run_id, f"{g}@var-test",
+        test_score = eval_checkpoint_fused(args, run_id, f"{g}@var-test",
                                            episodes)
+        rnd = float(np.mean(rollout_returns(f"{g}@var", _p_random, episodes,
+                                            seed=99)))
+        # the "clearly off-random" bar: 3x the random baseline's distance
+        # from zero, or +0.5 absolute when random is ~0 (freeway-style
+        # all-positive scores vs catch-style symmetric ones)
+        bar = rnd + max(2.0 * abs(rnd), 0.5)
         rows.append({
             "game": g,
             "train_levels_score": train_score,
             "heldout_levels_score": test_score,
             "generalization_gap": train_score - test_score,
+            "train_random_baseline": rnd,
+            "off_random": bool(train_score >= bar),
             "train_frames": summary.get("frames"),
         })
-    out = {"episodes_per_split": episodes, "per_game": rows}
-    os.makedirs(results_dir, exist_ok=True)
-    with open(os.path.join(results_dir, "generalization.json"), "w") as f:
-        json.dump(out, f, indent=2)
-    return out
+        flush()
+    return flush()

@@ -35,20 +35,39 @@ def main() -> int:
                     help="instead of the score sweep, run the seeded-variant "
                          "train/held-out level split (writes "
                          "generalization.json)")
+    ap.add_argument("--per-game-t-max", nargs="*", default=[],
+                    metavar="GAME=FRAMES",
+                    help="per-game --t-max override, e.g. breakout=65536 "
+                         "(slow-to-learn games get a bigger budget than the "
+                         "shared flags)")
     args, passthrough = ap.parse_known_args()
     if passthrough and passthrough[0] == "--":
         passthrough = passthrough[1:]
+    per_game_args = {}
+    for spec in args.per_game_t_max:
+        game, _, frames = spec.partition("=")
+        if not frames.isdigit():
+            ap.error(f"--per-game-t-max wants GAME=FRAMES, got {spec!r}")
+        if game not in JAXSUITE:
+            # fail fast: a typo'd name would otherwise silently train the
+            # game at the shared budget for hours (overrides are keyed by
+            # BASE name in both modes — no '@var' suffix)
+            ap.error(f"--per-game-t-max: unknown game {game!r} "
+                     f"(have: {', '.join(JAXSUITE)})")
+        per_game_args[game] = ["--t-max", frames]
     if args.generalization:
         from rainbow_iqn_apex_tpu.jaxsuite import run_generalization
 
         out = run_generalization(passthrough, games=args.games,
                                  results_dir=args.results_dir,
-                                 episodes=args.baseline_episodes)
+                                 episodes=args.baseline_episodes,
+                                 per_game_args=per_game_args)
         print(json.dumps(out))
         return 0
     agg = run_sweep(passthrough, games=args.games,
                     results_dir=args.results_dir,
-                    baseline_episodes=args.baseline_episodes)
+                    baseline_episodes=args.baseline_episodes,
+                    per_game_args=per_game_args)
     print(json.dumps(agg))
     return 0
 

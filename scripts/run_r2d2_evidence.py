@@ -1,0 +1,77 @@
+#!/usr/bin/env python
+"""Committed learning evidence for the fused R2D2 Anakin (VERDICT r3 item 3).
+
+Runs the exact config of tests/test_anakin_r2d2_fused.py::test_fused_r2d2_learns_catch
+(seed included) with an in-training eval cadence, writing the full
+metrics.jsonl curve and a final summary to results/r2d2_fused_learning/ so
+the learning claim is backed by a committed artifact rather than a partial
+log.  The host R2D2 baseline on the same game class (toy catch) is the
+committed test_r2d2.py result (eval 1.0 at 20k frames / 2000 learn steps);
+this run is the fused side of that A/B.
+
+CPU-sized: hidden 64 / lstm 32 / batch 16 / 12k frames — the quarter-cost
+config the slow test uses (its docstring records why the first cut was
+unfinishable on this 1-core sandbox).
+
+Usage: env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
+           PYTHONPATH=/root/repo python scripts/run_r2d2_evidence.py
+"""
+
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from rainbow_iqn_apex_tpu.config import Config
+from rainbow_iqn_apex_tpu.train_anakin_r2d2 import train_anakin_r2d2
+
+OUT = os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+                   "results", "r2d2_fused_learning")
+
+
+def main() -> None:
+    max_frames = int(sys.argv[1]) if len(sys.argv) > 1 else 12_000
+    cfg = Config(
+        env_id="jaxgame:catch",
+        architecture="r2d2",
+        role="anakin",
+        run_id="fused_catch",
+        compute_dtype="float32",
+        history_length=2,
+        hidden_size=64,
+        lstm_size=32,
+        r2d2_burn_in=2,
+        r2d2_seq_len=8,
+        r2d2_overlap=4,
+        batch_size=16,
+        learning_rate=2e-3,
+        multi_step=2,
+        gamma=0.9,
+        memory_capacity=12_000,
+        learn_start=512,
+        replay_ratio=1,
+        target_update_period=100,
+        num_envs_per_actor=8,
+        anakin_segment_ticks=32,
+        learner_devices=1,
+        metrics_interval=50,
+        eval_interval=200,  # learn steps between in-training evals -> curve
+        checkpoint_interval=0,
+        eval_episodes=40,
+        results_dir=OUT,
+        checkpoint_dir=os.path.join(OUT, "ckpt"),
+        seed=7,
+    )
+    summary = train_anakin_r2d2(cfg, max_frames=max_frames)
+    with open(os.path.join(OUT, "summary.json"), "w") as f:
+        json.dump({"config": "test_fused_r2d2_learns_catch (seed 7)",
+                   "max_frames": max_frames,
+                   "host_r2d2_baseline_eval": 1.0,
+                   **{k: v for k, v in summary.items()}}, f, indent=1,
+                  default=float)
+    print(json.dumps(summary, default=float))
+
+
+if __name__ == "__main__":
+    main()
