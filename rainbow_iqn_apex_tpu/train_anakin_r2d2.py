@@ -72,10 +72,20 @@ def _learn_cadence(cfg: Config):
         return fps // lanes, 1
     if lanes % fps == 0:
         return 1, lanes // fps
+    # suggest the nearest valid lane counts (ADVICE r3: the reference's
+    # cadence is a free parameter; make the constraint cheap to satisfy)
+    valid = sorted(
+        {d for d in range(1, max(fps, lanes) * 2 + 1)
+         if fps % d == 0 or d % fps == 0}
+    )
+    below = max((d for d in valid if d < lanes), default=None)
+    above = min((d for d in valid if d > lanes), default=None)
+    near = " or ".join(str(d) for d in (below, above) if d is not None)
     raise ValueError(
         f"fused R2D2 anakin needs lanes ({lanes}) and replay_ratio * "
         f"r2d2_seq_len ({fps}) to divide one another — the learn cadence "
-        "is compiled into the graph"
+        f"is compiled into the graph.  Nearest valid --num-envs-per-actor: "
+        f"{near}"
     )
 
 
@@ -499,9 +509,21 @@ def _train_anakin_r2d2_hostfed(cfg: Config,
 
         # warm gate on the ring's own sequence count (one scalar readback
         # per tick until it opens — the fused path avoids even this)
-        warm = warm or int(jax.device_get(ss.filled)) >= learn_start_seqs
+        if not warm and int(jax.device_get(ss.filled)) >= learn_start_seqs:
+            warm = True
+            # cadence counts from the warm-open point: without this, the
+            # first tick would owe ~learn_start/frames_per_step catch-up
+            # steps against a minimally-filled ring (heavy early sample
+            # reuse, ADVICE r3) — the fused path's static cadence has no
+            # such burst, and A/B parity with it matters more than parity
+            # with train_r2d2's cold-start spike.  Both counters are
+            # latched so a resumed run (restored frames/learn_steps) keeps
+            # its cadence instead of stalling against the old totals.
+            warm_open_frames = frames
+            warm_open_steps = learn_steps
         if warm:
-            steps_due = frames // frames_per_step - learn_steps
+            steps_due = ((frames - warm_open_frames) // frames_per_step
+                         - (learn_steps - warm_open_steps))
             for _ in range(max(steps_due, 0)):
                 key, k = jax.random.split(key)
                 ts, ss, info = learn(
