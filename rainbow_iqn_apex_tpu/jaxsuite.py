@@ -286,6 +286,12 @@ def aggregate(per_game_raw: Dict[str, float],
     if norm:
         out["median_script_normalized"] = _median(norm.values())
         out["mean_script_normalized"] = sum(norm.values()) / len(norm)
+        # the median alone flatters a sweep where some games sit at the
+        # floor (VERDICT r3): ship the per-game map and the floor count so
+        # the headline can't be quoted without its caveat
+        out["per_game_normalized"] = {g: round(n, 4)
+                                      for g, n in sorted(norm.items())}
+        out["games_below_0.2"] = sum(1 for n in norm.values() if n < 0.2)
     return out
 
 

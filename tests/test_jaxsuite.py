@@ -58,6 +58,12 @@ def test_normalized_score_and_aggregate():
     agg = aggregate({"catch": 1.0, "asterix": 2.0}, baselines)
     assert agg["games"] == 2 and agg["games_normalized"] == 1
     assert agg["median_script_normalized"] == pytest.approx(1.0)
+    # the caveat fields ride with the headline (VERDICT r3: a median over a
+    # sweep with floor-sitting games must be quotable only with its caveat)
+    assert agg["per_game_normalized"] == {"catch": pytest.approx(1.0)}
+    assert agg["games_below_0.2"] == 0
+    floor = aggregate({"catch": -0.7}, baselines)
+    assert floor["games_below_0.2"] == 1
 
 
 def test_degenerate_script_gives_none():
