@@ -275,7 +275,10 @@ def normalized_score(raw: float, baselines: Dict) -> Optional[float]:
 
 
 def aggregate(per_game_raw: Dict[str, float],
-              baselines: Dict[str, Dict]) -> Dict[str, float]:
+              baselines: Dict[str, Dict]) -> Dict[str, object]:
+    """Suite aggregate: counts, median/mean script-normalized scores, the
+    per-game normalized map and the below-0.2 floor count (mixed value
+    types — treat as a JSON object, not a float map)."""
     norm = {
         g: n
         for g, s in per_game_raw.items()

@@ -154,22 +154,36 @@ def test_entry_point_dispatches_anakin_r2d2(tmp_path):
 
 @pytest.mark.slow
 def test_fused_r2d2_learns_catch(tmp_path):
-    """Learning proof sized to this 1-core sandbox: the first cut of this
-    test (hidden 128 / lstm 64 / batch 32 / 16k frames) ran at 0.4 fps on
-    CPU — unfinishable — while its return curve was already climbing
-    (-0.8 -> -0.58 at 4k frames).  This config keeps the same algorithm at
-    a quarter the step cost; the host R2D2 test (test_r2d2.py) holds the
-    same >0.3 bar."""
+    """Learning proof at the recipe the committed evidence run measured
+    (results/r2d2_fused_learning/, scripts/run_r2d2_evidence.py, round 4):
+    hidden 64 / lstm 64 / history 1 / seq 10 / batch 16, seed 7 — full
+    curve eval -0.9 at 5k frames, 0.0 at 6.8k, 0.7 at 8.1k, 0.85 at 11.3k,
+    **1.0 (40/40) from 12.6k through the 16k finish** — A/B parity with
+    the host R2D2's perfect solve (test_r2d2.py: 1.0 at 20k frames).
+    Config history: the round-3 cut (hidden 128 / lstm 64 / history 2) ran
+    at 0.4 fps — unfinishable here — and a quarter-cost lstm-32 /
+    history-2 variant stayed AT RANDOM through 4k frames; lstm 64 (the
+    host-proven memory size) with history 1 is the working recipe — catch
+    is positionally observable per frame, so the frame stack is the right
+    cost to shed, not the LSTM.  10k frames at ~1.5 fps ≈ 1.9 h on this
+    1-core sandbox: long but completable, and the measured curve puts the
+    >0.3 bar well inside the 8.1k-frame measurement (0.7)."""
     cfg = _cfg(
         tmp_path,
+        history_length=1,
+        hidden_size=64,
+        lstm_size=64,
+        r2d2_seq_len=10,
         learning_rate=2e-3,
-        memory_capacity=12_000,
+        memory_capacity=16_000,
         learn_start=512,
-        replay_ratio=1,  # 8 frames/step = 1 tick -> dense updates
+        replay_ratio=1,  # 10 frames/step = 1 tick -> dense updates
+        num_envs_per_actor=10,  # lanes must equal replay_ratio * seq_len
         anakin_segment_ticks=32,
+        target_update_period=100,
         eval_episodes=40,
         seed=7,
     )
-    summary = train_anakin_r2d2(cfg, max_frames=12_000)
+    summary = train_anakin_r2d2(cfg, max_frames=10_000)
     assert summary["eval_score_mean"] > 0.3, summary
-    assert summary["learn_steps"] > 1_000
+    assert summary["learn_steps"] > 900
