@@ -418,8 +418,19 @@ def main() -> None:
             bool(os.environ.get("PALLAS_AXON_POOL_IPS"))  # sandbox relay hook
             or jp != ""                                    # pinned non-cpu
             or os.path.exists("/dev/accel0")               # real TPU VM
+            or os.path.exists("/dev/nvidia0")              # GPU host
+            or os.environ.get("BENCH_FORCE_DEVICE") == "1"  # explicit override
         )
     )
+    if not device_expected and jp != "cpu":
+        # ADVICE r4: a silently-skipped device phase looks like a CPU-only
+        # machine; say why so an unexpected CPU headline is diagnosable
+        print(
+            "bench: no accelerator signal (no relay hook, no JAX_PLATFORMS "
+            "pin, no /dev/accel0 or /dev/nvidia0) — device phase skipped; "
+            "set BENCH_FORCE_DEVICE=1 to attempt it anyway",
+            file=sys.stderr,
+        )
     device_line = None
     if device_expected:
         # leave the device child whatever watchdog budget phase 1 didn't use,

@@ -71,6 +71,11 @@ class Config:
     gamma: float = 0.99
     multi_step: int = 3  # n-step return length
     batch_size: int = 32
+    sample_groups: int = 1  # anakin learner: stratified draws of batch_size
+    # consumed per learn step (one [G*B] GEMM, per-group IS normalisation,
+    # G-sequential priority write-back order) — the batch-64/128 TPU knob
+    # that keeps the reference's batch-32 PER stratum width (SURVEY §7
+    # "prioritized sampling throughput"; docs/SCALING.md)
     learning_rate: float = 6.25e-5
     adam_eps: float = 1.5e-4
     max_grad_norm: float = 10.0  # 0 disables clipping

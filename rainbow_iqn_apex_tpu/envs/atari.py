@@ -45,17 +45,26 @@ def _resize(frame: np.ndarray, hw: Tuple[int, int]) -> np.ndarray:
         return cv2.resize(frame, (hw[1], hw[0]), interpolation=cv2.INTER_AREA).astype(
             np.uint8
         )
-    # NumPy area-mean fallback (exact when shapes divide evenly)
+    # NumPy area-mean fallback (exact when shapes divide evenly), fully
+    # vectorised via two cumulative-sum passes — the previous per-pixel
+    # Python double loop cost ~ms/frame, a silent preprocessing tax on the
+    # actor hot path of any ALE box without cv2 (VERDICT r4).  Bin [i, j]
+    # averages frame[ys[i]:ye[i], xs[j]:xe[j]] (ends forced >= 1 wide), and
+    # the float->uint8 cast truncates, matching the old loop bit-for-bit.
     h, w = frame.shape
     th, tw = hw
     ys = (np.arange(th + 1) * h // th).astype(int)
     xs = (np.arange(tw + 1) * w // tw).astype(int)
-    out = np.empty((th, tw), np.uint8)
-    for i in range(th):
-        rows = frame[ys[i] : max(ys[i + 1], ys[i] + 1)]
-        for j in range(tw):
-            out[i, j] = rows[:, xs[j] : max(xs[j + 1], xs[j] + 1)].mean()
-    return out
+    ye = np.maximum(ys[1:], ys[:-1] + 1)
+    xe = np.maximum(xs[1:], xs[:-1] + 1)
+    c = np.zeros((h + 1, w), np.float64)
+    np.cumsum(frame, axis=0, out=c[1:])
+    rowsum = c[ye] - c[ys[:-1]]  # [th, w] — per-bin row sums
+    c2 = np.zeros((th, w + 1), np.float64)
+    np.cumsum(rowsum, axis=1, out=c2[:, 1:])
+    s = c2[:, xe] - c2[:, xs[:-1]]  # [th, tw] — per-bin area sums
+    area = (ye - ys[:-1])[:, None] * (xe - xs[:-1])[None, :]
+    return (s / area).astype(np.uint8)
 
 
 class AtariEnv(Env):

@@ -527,9 +527,14 @@ N_TEST_LEVELS = 16
 _LEVEL_BASE_KEY = 9137
 
 
-def _level_key(pool_base: int, pool_size: int, key):
-    level = pool_base + jax.random.randint(key, (), 0, pool_size, jnp.int32)
+def _level_fold(level):
+    """Level id -> the level's layout key.  `level` may be a traced i32, so
+    per-level eval harnesses can vmap a pinned level over lanes."""
     return jax.random.fold_in(jax.random.PRNGKey(_LEVEL_BASE_KEY), level)
+
+
+def _draw_level(pool_base: int, pool_size: int, key):
+    return pool_base + jax.random.randint(key, (), 0, pool_size, jnp.int32)
 
 
 class BreakoutVarState(NamedTuple):
@@ -555,7 +560,18 @@ class BreakoutVarGame(BreakoutGame):
 
     def init(self, key) -> BreakoutVarState:
         kl, kc, kd = jax.random.split(key, 3)
-        kw, kp = jax.random.split(_level_key(self.pool_base, self.pool_size, kl))
+        level = _draw_level(self.pool_base, self.pool_size, kl)
+        return self._init_level(level, kc, kd)
+
+    def init_at_level(self, level, key) -> BreakoutVarState:
+        """Pinned-level init (per-level generalization eval): the layout
+        comes from `level` (traced i32 welcome), per-episode randomness
+        (ball entry column/direction) from `key`."""
+        kc, kd = jax.random.split(key)
+        return self._init_level(level, kc, kd)
+
+    def _init_level(self, level, kc, kd) -> BreakoutVarState:
+        kw, kp = jax.random.split(_level_fold(level))
         mask = jax.random.uniform(kw, (3, G)) < 0.75
         mask = mask.at[1, G // 2].set(True)  # a level can never be brickless
         wall = jnp.zeros((G, G), bool).at[1:4].set(mask)
@@ -596,7 +612,16 @@ class FreewayVarGame(FreewayGame):
 
     def init(self, key) -> FreewayVarState:
         kl, kc = jax.random.split(key)
-        ks, kd = jax.random.split(_level_key(self.pool_base, self.pool_size, kl))
+        level = _draw_level(self.pool_base, self.pool_size, kl)
+        return self._init_level(level, kc)
+
+    def init_at_level(self, level, key) -> FreewayVarState:
+        """Pinned-level init: lane speeds/dirs from `level` (traced i32
+        welcome), car starting phases from `key`."""
+        return self._init_level(level, key)
+
+    def _init_level(self, level, kc) -> FreewayVarState:
+        ks, kd = jax.random.split(_level_fold(level))
         return FreewayVarState(
             chicken=jnp.int32(G - 1),
             cars=jax.random.randint(kc, (8,), 0, G, jnp.int32),
@@ -633,9 +658,15 @@ class AsterixVarGame(AsterixGame):
         self.pool_size = pool_size
 
     def init(self, key) -> AsterixVarState:
-        ks, kd, kg = jax.random.split(
-            _level_key(self.pool_base, self.pool_size, key), 3
+        return self.init_at_level(
+            _draw_level(self.pool_base, self.pool_size, key), key
         )
+
+    def init_at_level(self, level, key) -> AsterixVarState:
+        """Pinned-level init: asterix levels fully determine the initial
+        state (spawn timing is step randomness), so `key` is unused."""
+        del key
+        ks, kd, kg = jax.random.split(_level_fold(level), 3)
         return AsterixVarState(
             pr=jnp.int32(G // 2),
             pc=jnp.int32(G // 2),
@@ -685,9 +716,15 @@ class InvadersVarGame(InvadersGame):
         self.pool_size = pool_size
 
     def init(self, key) -> InvadersVarState:
-        kf, km, kb, kd = jax.random.split(
-            _level_key(self.pool_base, self.pool_size, key), 4
+        return self.init_at_level(
+            _draw_level(self.pool_base, self.pool_size, key), key
         )
+
+    def init_at_level(self, level, key) -> InvadersVarState:
+        """Pinned-level init: invaders levels fully determine the initial
+        state (bomb columns are step randomness), so `key` is unused."""
+        del key
+        kf, km, kb, kd = jax.random.split(_level_fold(level), 4)
         mask = jax.random.uniform(kf, (4, 6)) < 0.8
         mask = mask.at[0, 3].set(True)  # a level can never start alien-less
         fleet = jnp.zeros((G, G), bool).at[1:5, 2:8].set(mask)
@@ -748,7 +785,8 @@ EPISODE_TICK_BUDGET = {"catch": 64, "breakout": 512, "freeway": 600,
 
 
 def build_rollout(game: "DeviceGame", action_fn, episodes: int,
-                  max_ticks: int, history: int = 0, actor_init=None):
+                  max_ticks: int, history: int = 0, actor_init=None,
+                  init_fn=None):
     """One jitted (aux, key) -> first-episode returns [episodes] rollout over
     `episodes` parallel auto-reset lanes — the single episode-accounting core
     shared by the trainers' in-graph eval (train_anakin.build_fused_eval) and
@@ -764,6 +802,13 @@ def build_rollout(game: "DeviceGame", action_fn, episodes: int,
     and an `action_fn(aux, states, stack, key, actor_state) -> (actions,
     actor_state)`; lanes whose episode cut are zero-reset by a keep mask,
     exactly like the training tick's LSTM handling (train_anakin_r2d2.py).
+
+    `init_fn(aux, key) -> [episodes, ...] state pytree` overrides the default
+    per-lane pool init (per-level generalization eval pins each lane's level
+    via `game.init_at_level`; taking `aux` lets the lane->level assignment be
+    a traced argument, so one compile serves every level chunk).  Mid-rollout
+    auto-resets still draw from the game's own pool, which is harmless under
+    first-episode accounting.
 
     Returns are capped, never censored: a lane whose first episode is still
     running at `max_ticks` yields its partial return."""
@@ -781,7 +826,8 @@ def build_rollout(game: "DeviceGame", action_fn, episodes: int,
     @jax.jit
     def run(aux, key):
         k_init, k_scan = jax.random.split(key)
-        states = batched_init(game, k_init, episodes)
+        states = (init_fn(aux, k_init) if init_fn is not None
+                  else batched_init(game, k_init, episodes))
 
         def tick(carry, k):
             states, ep, stack, frame, keep, first, done, actor = carry

@@ -285,7 +285,53 @@ class DeviceReplay:
         batch, prob = self.assemble(state, idx, beta)
         return idx, batch, prob
 
+    def sample_grouped(
+        self,
+        state: DeviceReplayState,
+        key: chex.PRNGKey,
+        batch_size: int,
+        groups: int,
+        beta: jnp.ndarray,
+    ) -> Tuple[jnp.ndarray, Batch, jnp.ndarray]:
+        """``groups`` independent stratified draws of ``batch_size``,
+        concatenated into ONE [G*B] learn batch — the TPU batch-scaling knob
+        (SURVEY §7): a 4x bigger GEMM for the MXU without changing the
+        reference's PER semantics, because each group keeps the batch-32
+        stratum width (total/B per stratum) and its OWN max-normalised IS
+        weights, exactly as G sequential reference learn steps would.  What
+        DOES differ from G sequential steps: priorities aren't updated
+        between draws (groups sample the same distribution) and the
+        optimiser takes one step on the G*B mean gradient instead of G
+        steps — the standard large-batch trade, chosen explicitly via
+        cfg.sample_groups.
+
+        Returns (idx [G, B], Batch over [G*B], prob [G*B])."""
+        keys = jax.random.split(key, groups)
+        idx = jax.vmap(lambda k: self.draw(state, k, batch_size))(keys)
+        batch, prob = self.assemble(
+            state, idx.reshape(-1), beta, with_weight=False
+        )
+        n_stored = (state.filled * self.lanes).astype(jnp.float32)
+        w = (n_stored * prob) ** (-beta)
+        w = w.reshape(groups, batch_size)
+        w = w / w.max(axis=1, keepdims=True)  # per-group, as sequential steps
+        return idx, batch.replace(weight=w.reshape(-1)), prob
+
     # ------------------------------------------------------------- priorities
+    def update_priorities_grouped(
+        self, state: DeviceReplayState, idx: jnp.ndarray, td_abs: jnp.ndarray
+    ) -> DeviceReplayState:
+        """Write-back for sample_grouped's [G, B] indices with G-sequential
+        semantics: on a slot drawn by several groups, the LAST group's
+        priority stands (scatter order across duplicate ids inside one
+        .at[].set is unspecified, so the groups are applied as G small
+        ordered scatters — G is static and tiny)."""
+        G = idx.shape[0]
+        td = td_abs.reshape(G, -1)
+        for g in range(G):
+            state = self.update_priorities(state, idx[g], td[g])
+        return state
+
     def update_priorities(
         self, state: DeviceReplayState, idx: jnp.ndarray, td_abs: jnp.ndarray
     ) -> DeviceReplayState:
@@ -337,6 +383,7 @@ def build_device_learn_sharded(cfg, num_actions: int, local_replay: DeviceReplay
     if cfg.batch_size % n_dev:
         raise ValueError(f"batch {cfg.batch_size} not divisible by {n_dev} devices")
     b_loc = cfg.batch_size // n_dev
+    groups = getattr(cfg, "sample_groups", 1)
     learn_step = build_learn_step(cfg, num_actions)
     state_spec = device_replay_specs(axis)
     batch_spec = Batch(
@@ -346,18 +393,37 @@ def build_device_learn_sharded(cfg, num_actions: int, local_replay: DeviceReplay
     smap = _shard_map()
 
     def _draw_assemble(ds_loc, key, beta):
+        """Per-shard fixed-quota draw; with cfg.sample_groups > 1 each shard
+        draws G stratified groups of b_loc (flattened [G*b_loc], group g at
+        rows [g*b_loc, (g+1)*b_loc)) and IS weights are pmax-normalised PER
+        GROUP across shards — the sharded twin of sample_grouped, keeping
+        each group's weights exactly what a sequential reference step would
+        use."""
         k = jax.random.fold_in(key, jax.lax.axis_index(axis))
-        idx = local_replay.draw(ds_loc, k, b_loc)
+        if groups > 1:
+            keys = jax.random.split(k, groups)
+            idx = jax.vmap(
+                lambda kk: local_replay.draw(ds_loc, kk, b_loc)
+            )(keys).reshape(-1)
+        else:
+            idx = local_replay.draw(ds_loc, k, b_loc)
         batch, prob = local_replay.assemble(ds_loc, idx, beta, with_weight=False)
         # globally consistent IS weights over the shard mixture
         n_global = (ds_loc.filled * local_replay.lanes * n_dev).astype(jnp.float32)
         nq = jnp.maximum(n_global * prob / n_dev, 1e-12)
         w = nq ** (-beta)
-        w = w / jax.lax.pmax(w.max(), axis)
+        wg = w.reshape(groups, b_loc)
+        wmax = jax.lax.pmax(wg.max(axis=1), axis)  # [G] per-group global max
+        w = (wg / wmax[:, None]).reshape(-1)
         return idx, batch.replace(weight=w)
 
     def _write_back(ds_loc, idx, td_abs):
-        ds_loc = local_replay.update_priorities(ds_loc, idx, td_abs)
+        if groups > 1:
+            ds_loc = local_replay.update_priorities_grouped(
+                ds_loc, idx.reshape(groups, b_loc), td_abs
+            )
+        else:
+            ds_loc = local_replay.update_priorities(ds_loc, idx, td_abs)
         # keep the replicated max_priority scalar shard-consistent
         return ds_loc.replace(
             max_priority=jax.lax.pmax(ds_loc.max_priority, axis)
@@ -440,16 +506,26 @@ def build_device_learn(cfg, num_actions: int, replay: DeviceReplay):
     from rainbow_iqn_apex_tpu.ops.learn import build_learn_step
 
     learn_step = build_learn_step(cfg, num_actions)
+    groups = getattr(cfg, "sample_groups", 1)
 
     def fused(train_state, replay_state, key, beta):
         k_sample, k_learn = jax.random.split(key)
-        idx, batch, _prob = replay.sample(
-            replay_state, k_sample, cfg.batch_size, beta
-        )
-        train_state, info = learn_step(train_state, batch, k_learn)
-        replay_state = replay.update_priorities(
-            replay_state, idx, info["priorities"]
-        )
+        if groups > 1:
+            idx, batch, _prob = replay.sample_grouped(
+                replay_state, k_sample, cfg.batch_size, groups, beta
+            )
+            train_state, info = learn_step(train_state, batch, k_learn)
+            replay_state = replay.update_priorities_grouped(
+                replay_state, idx, info["priorities"]
+            )
+        else:
+            idx, batch, _prob = replay.sample(
+                replay_state, k_sample, cfg.batch_size, beta
+            )
+            train_state, info = learn_step(train_state, batch, k_learn)
+            replay_state = replay.update_priorities(
+                replay_state, idx, info["priorities"]
+            )
         return train_state, replay_state, info
 
     return fused

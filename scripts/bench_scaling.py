@@ -25,8 +25,22 @@ import time
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 BUDGET = float(sys.argv[1]) if len(sys.argv) > 1 else 420.0
-BATCHES = [int(b) for b in (sys.argv[2] if len(sys.argv) > 2
-                            else "32,64,128,256").split(",")]
+
+
+def _parse_point(tok: str):
+    """'128' -> (128, 1); '32x4' -> (32, 4): batch_size x sample_groups —
+    the grouped-draw learner (replay/device.sample_grouped) that keeps the
+    reference's batch-32 PER stratum width while feeding the MXU a G*B
+    GEMM."""
+    if "x" in tok:
+        b, g = tok.split("x", 1)
+        return int(b), int(g)
+    return int(tok), 1
+
+
+BATCHES = [_parse_point(b) for b in
+           (sys.argv[2] if len(sys.argv) > 2
+            else "32,64,128,256,32x2,32x4").split(",")]
 T0 = time.monotonic()
 
 # bf16 peak of the v5-lite (v5e) chip this sandbox tunnels to; override for
@@ -91,11 +105,12 @@ def main() -> None:
     jax.block_until_ready(ds0.priority)
     emit(phase="prefill", frames=lanes * seg, left_s=round(left(), 1))
 
-    for b in BATCHES:
+    for b, groups in BATCHES:
+        label = f"{b}x{groups}" if groups > 1 else str(b)
         if left() < 90:
-            emit(phase="scale", batch=b, skipped="budget exhausted")
+            emit(phase="scale", batch=label, skipped="budget exhausted")
             continue
-        cfg = base.replace(batch_size=b)
+        cfg = base.replace(batch_size=b, sample_groups=groups)
         ts = init_train_state(cfg, A, jax.random.PRNGKey(0))
         fused = build_device_learn(cfg, A, replay)
 
@@ -125,14 +140,15 @@ def main() -> None:
                 c0 = cost[0] if isinstance(cost, (list, tuple)) else cost
                 flops = float(c0.get("flops", 0.0)) or None
         except Exception as e:  # noqa: BLE001 — cost analysis is best-effort
-            emit(phase="cost_analysis", batch=b, error=repr(e)[:120])
+            emit(phase="cost_analysis", batch=label, error=repr(e)[:120])
 
         key = jax.random.PRNGKey(2)
         key, k = jax.random.split(key)
         ts, last = segment(ts, ds0, k)
         jax.block_until_ready(last)
         if left() < 30:
-            emit(phase="scale", batch=b, skipped="budget exhausted post-compile")
+            emit(phase="scale", batch=label,
+                 skipped="budget exhausted post-compile")
             continue
         n_seg = 0
         t0 = time.perf_counter()
@@ -145,9 +161,9 @@ def main() -> None:
         sps = n_seg * SCAN / dt
         row = {
             "phase": "scale",
-            "batch": b,
+            "batch": label,
             "steps_per_sec": round(sps, 2),
-            "samples_per_sec": round(sps * b, 1),
+            "samples_per_sec": round(sps * b * groups, 1),
             "ms_per_step": round(1e3 / sps, 3),
             "platform": platform,
         }

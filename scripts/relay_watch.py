@@ -1,0 +1,230 @@
+#!/usr/bin/env python
+"""Automated TPU relay watcher + self-capturing live window (VERDICT r4 item 1).
+
+Rounds 2-4 lost every TPU window to manual process: the builder probed the
+relay by hand (hourly), and the staged capture chain (tpu_session -> bench ->
+bench_scaling -> bench_pallas -> on-chip jaxsuite) required a human to notice
+the relay was up.  This watcher replaces the human:
+
+  * probe loop: a child process attempts axon backend init.  Against the
+    dead relay this blocks ~5-25 min and then exits cleanly with
+    ``UNAVAILABLE`` (the round-3/4 signature, docs/STATUS.md) — the probe IS
+    the detector in both states, so the loop's effective cadence is the
+    probe's own duration plus a short sleep.
+  * on the FIRST probe that reports a live TPU backend, the watcher runs the
+    capture chain phase by phase, redirecting each phase's stdout to
+    ``results/relay_watch/<phase>.jsonl`` and ``git commit``-ing after every
+    phase — a mid-window wedge loses only the remainder of the chain, never
+    a completed measurement.
+  * every probe outcome is appended to ``results/relay_watch/watch.jsonl``
+    and committed, so a dead-all-round relay still leaves a committed record
+    that the automation probed and would have fired.
+
+Relay discipline (docs/STATUS.md round-2 postmortem; the single-claim relay
+wedges if a client is SIGKILLed mid-RPC): this watcher NEVER kills a probe or
+a phase.  Probes self-bound with SIGALRM (best-effort: the known dead-relay
+hang holds the GIL, but it also self-resolves in ~25 min); phases carry their
+own soft internal budgets.  If a probe exceeds the alarm and the hang is
+GIL-held, the watcher keeps waiting — a hung probe still holds no claim and
+the wait costs nothing but this process's patience.
+
+Usage:
+    nohup python scripts/relay_watch.py > /tmp/relay_watch.out 2>&1 &
+Stop it by creating results/relay_watch/STOP (checked between probes).
+"""
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+OUTDIR = os.path.join(REPO, "results", "relay_watch")
+LOG = os.path.join(OUTDIR, "watch.jsonl")
+STOP = os.path.join(OUTDIR, "STOP")
+PIDFILE = os.path.join(OUTDIR, "watch.pid")
+SLEEP_BETWEEN_PROBES = 600  # the dead-relay probe itself takes ~25 min
+
+# Child body for one probe: init the backend under the relay env, classify.
+# SIGALRM is best-effort (the measured dead-relay hang holds the GIL and the
+# handler can't run — but the hang self-resolves with a clean UNAVAILABLE).
+PROBE_SRC = r"""
+import os, signal, sys, time
+t0 = time.monotonic()
+def bail(signum, frame):
+    print(f"PROBE_TIMEOUT after {time.monotonic()-t0:.0f}s", flush=True)
+    os._exit(9)
+if hasattr(signal, "SIGALRM"):
+    signal.signal(signal.SIGALRM, bail)
+    signal.alarm(2700)
+try:
+    import jax
+    devs = jax.devices()
+except Exception as e:
+    print(f"PROBE_FAIL {type(e).__name__}: {e}", flush=True)
+    os._exit(2)
+print(f"PROBE_OK {devs[0].platform} n={len(devs)} t={time.monotonic()-t0:.1f}s",
+      flush=True)
+os._exit(0)
+"""
+
+
+def log_event(**row) -> None:
+    row["t_wall"] = time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime())
+    with open(LOG, "a") as f:
+        f.write(json.dumps(row) + "\n")
+    print(json.dumps(row), flush=True)
+
+
+def git_commit(paths, msg) -> bool:
+    from _git_util import commit_paths
+
+    return commit_paths(REPO, paths, msg,
+                        log=lambda m: log_event(event="git_commit_failed",
+                                                msg=msg))
+
+
+def run_probe() -> dict:
+    """One backend-init probe.  Waits for the child to exit on its own —
+    NEVER kills it (single-claim relay discipline).  Output goes to a file,
+    not a pipe: a chatty backend init (repeated gRPC retry warnings over a
+    25-min dead-relay hang) could fill a 64KB pipe no one is draining and
+    deadlock a child the watcher refuses to kill."""
+    env = dict(os.environ)
+    env.pop("JAX_PLATFORMS", None)  # let the axon relay hook pick the backend
+    t0 = time.monotonic()
+    probe_out = os.path.join(OUTDIR, "probe_last.out")
+    with open(probe_out, "w") as out_f:
+        p = subprocess.Popen([sys.executable, "-c", PROBE_SRC], env=env,
+                             stdout=out_f, stderr=subprocess.STDOUT, text=True)
+        waited_note = 0.0
+        while p.poll() is None:
+            time.sleep(30)
+            dt = time.monotonic() - t0
+            if dt - waited_note >= 1800:  # heartbeat for very long probes
+                waited_note = dt
+                log_event(event="probe_still_running", elapsed_s=round(dt))
+    with open(probe_out) as f:
+        out = f.read().strip()
+    dt = time.monotonic() - t0
+    live = p.returncode == 0 and "PROBE_OK tpu" in out
+    return {"rc": p.returncode, "elapsed_s": round(dt, 1), "live": live,
+            "tail": out[-400:]}
+
+
+def run_phase(name: str, argv, out_name: str, extra_env=None,
+              strip_platform_pin: bool = True) -> int:
+    """Run one capture phase, stdout -> results/relay_watch/<out_name>,
+    wait without killing, commit the artifact."""
+    env = dict(os.environ)
+    if strip_platform_pin:
+        env.pop("JAX_PLATFORMS", None)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    env.update(extra_env or {})
+    out_path = os.path.join(OUTDIR, out_name)
+    err_path = out_path + ".stderr"
+    t0 = time.monotonic()
+    log_event(event="phase_start", phase=name, argv=argv)
+    with open(out_path, "a") as out_f, open(err_path, "a") as err_f:
+        p = subprocess.Popen(argv, cwd=REPO, env=env,
+                             stdout=out_f, stderr=err_f, text=True)
+        while p.poll() is None:
+            time.sleep(30)
+    dt = time.monotonic() - t0
+    log_event(event="phase_done", phase=name, rc=p.returncode,
+              elapsed_s=round(dt, 1))
+    git_commit([out_path, err_path, LOG],
+               f"relay_watch: {name} captured on live TPU window "
+               f"(rc={p.returncode}, {dt:.0f}s)")
+    return p.returncode
+
+
+def capture_chain() -> None:
+    """The staged live-window chain, safest-first (docs/STATUS.md), each
+    phase committed before the next starts."""
+    py = sys.executable
+    jaxsuite_dir = os.path.join("results", "jaxsuite_tpu")
+    # the round-3/4 CPU sweep config exactly (scripts/round5_queue.py
+    # SHARED), so on-chip rows are apples-to-apples with the committed
+    # 16k/64k CPU tables — only the budget (64k frames/game) changes
+    shared = ["--role", "anakin", "--compute-dtype", "float32",
+              "--history-length", "2", "--hidden-size", "128",
+              "--num-cosines", "32", "--num-tau-samples", "8",
+              "--num-tau-prime-samples", "8", "--num-quantile-samples", "4",
+              "--batch-size", "32", "--learning-rate", "1e-3",
+              "--multi-step", "3", "--gamma", "0.9",
+              "--memory-capacity", "8192", "--learn-start", "512",
+              "--replay-ratio", "2", "--target-update-period", "200",
+              "--num-envs-per-actor", "8", "--anakin-segment-ticks", "32",
+              "--learner-devices", "1", "--metrics-interval", "1000",
+              "--eval-interval", "0", "--checkpoint-interval", "2000",
+              "--eval-episodes", "32",
+              "--results-dir", f"{jaxsuite_dir}/runs",
+              "--checkpoint-dir", f"{jaxsuite_dir}/ckpt"]
+    phases = [
+        ("tpu_session", [py, "scripts/tpu_session.py", "420"],
+         "tpu_session.jsonl", None),
+        ("bench", [py, "bench.py"], "bench_live.jsonl", None),
+        ("bench_scaling",
+         [py, "scripts/bench_scaling.py", "420",
+          "32,64,128,256,32x2,32x4"],
+         "scaling.jsonl", None),
+        ("bench_pallas", [py, "scripts/bench_pallas.py"], "pallas.jsonl",
+         {"BENCH_ITERS": "50"}),
+        # on-chip score sweep at the budget the CPU box can't afford: at the
+        # round-2 device rate (~1890 learn-steps/s) 64k frames/game is minutes
+        ("jaxsuite_tpu",
+         [py, "scripts/run_jaxsuite.py",
+          "--games", "catch", "breakout", "freeway", "asterix", "invaders",
+          "--results-dir", jaxsuite_dir,
+          "--per-game-t-max", "catch=65536", "breakout=65536",
+          "freeway=65536", "asterix=65536", "invaders=65536",
+          "--", *shared],
+         "jaxsuite_tpu.jsonl", None),
+    ]
+    for name, argv, out_name, extra_env in phases:
+        run_phase(name, argv, out_name, extra_env)
+    # the sweep's own artifacts live outside OUTDIR — commit the benchmark
+    # files and metrics only, never ckpt/ binaries (results hygiene)
+    sweep_abs = os.path.join(REPO, jaxsuite_dir)
+    arts = [p for p in (os.path.join(sweep_abs, "per_game.csv"),
+                        os.path.join(sweep_abs, "aggregate.json"))
+            if os.path.exists(p)]
+    import glob
+    arts += glob.glob(os.path.join(sweep_abs, "runs", "*", "metrics.jsonl"))
+    if arts:
+        git_commit(arts, "relay_watch: on-chip jaxsuite sweep artifacts")
+
+
+def main() -> None:
+    os.makedirs(OUTDIR, exist_ok=True)
+    with open(PIDFILE, "w") as f:
+        f.write(str(os.getpid()))
+    log_event(event="watcher_start", pid=os.getpid(),
+              relay_hook=os.environ.get("PALLAS_AXON_POOL_IPS", ""))
+    git_commit([LOG], "relay_watch: watcher started")
+    n = 0
+    while not os.path.exists(STOP):
+        n += 1
+        res = run_probe()
+        log_event(event="probe", n=n, **res)
+        git_commit([LOG], f"relay_watch: probe {n} "
+                          f"{'LIVE' if res['live'] else 'dead'} "
+                          f"({res['elapsed_s']:.0f}s, rc={res['rc']})")
+        if res["live"]:
+            log_event(event="chain_start", probe_n=n)
+            capture_chain()
+            log_event(event="chain_done", probe_n=n)
+            git_commit([LOG], "relay_watch: capture chain complete")
+            break  # one full capture is the round's goal; builder takes over
+        for _ in range(SLEEP_BETWEEN_PROBES // 10):
+            if os.path.exists(STOP):
+                break
+            time.sleep(10)
+    log_event(event="watcher_exit", probes=n)
+    git_commit([LOG], f"relay_watch: watcher exit after {n} probes")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,144 @@
+#!/usr/bin/env python
+"""Round-5 serial background queue supervisor (VERDICT r4 items 2 + 3).
+
+Phase 1: breakout + asterix score-sweep rerun at 65536 frames/game (the
+budget at which the committed 16k sweep left both games at the floor),
+into results/jaxsuite_64k so the 5-game 16k artifacts stay intact.
+Phase 2: asterix@var generalization row at 65536 frames (round 4's 32.8k
+run landed below the off_random bar), into results/jaxsuite_var64k.
+
+While a phase runs, its benchmark ARTIFACTS (per_game.csv, aggregate.json,
+generalization.json, runs/*/metrics.jsonl — never ckpt/ binaries) are
+committed every 10 minutes; run_jaxsuite rewrites result files after every
+game, so an interrupted phase keeps its completed rows.  All training is
+relay-immune (env-stripped JAX_PLATFORMS=cpu, docs/STATUS.md probe
+etiquette).
+
+Usage:
+  python scripts/round5_queue.py [--adopt-pid PID]
+--adopt-pid: phase 1 is already running as PID (supervisor restart); poll it
+instead of launching a new sweep.
+"""
+
+import argparse
+import glob
+import os
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+# the EXACT round-3/4 sweep config (round-4 session's queue_r4.sh), so the
+# 64k rows are comparable with the committed 16k sweep and generalization
+# tables: CPU-sized IQN (hidden 128, cosines 32, tau 8/8/4), 8 lanes,
+# metrics every 1000 steps, no in-train eval, periodic checkpoints
+SHARED = ["--role", "anakin", "--compute-dtype", "float32",
+          "--history-length", "2", "--hidden-size", "128",
+          "--num-cosines", "32", "--num-tau-samples", "8",
+          "--num-tau-prime-samples", "8", "--num-quantile-samples", "4",
+          "--batch-size", "32", "--learning-rate", "1e-3",
+          "--multi-step", "3", "--gamma", "0.9",
+          "--memory-capacity", "8192", "--learn-start", "512",
+          "--replay-ratio", "2", "--target-update-period", "200",
+          "--num-envs-per-actor", "8", "--anakin-segment-ticks", "32",
+          "--learner-devices", "1", "--metrics-interval", "1000",
+          "--eval-interval", "0", "--checkpoint-interval", "2000",
+          "--eval-episodes", "32"]
+
+
+def log(msg: str) -> None:
+    print(f"queue[{time.strftime('%H:%M:%S', time.gmtime())}] {msg}",
+          flush=True)
+
+
+def artifacts(results_dir: str):
+    base = os.path.join(REPO, results_dir)
+    paths = [p for p in (os.path.join(base, "per_game.csv"),
+                         os.path.join(base, "aggregate.json"),
+                         os.path.join(base, "generalization.json"))
+             if os.path.exists(p)]
+    paths += glob.glob(os.path.join(base, "runs", "*", "metrics.jsonl"))
+    return paths
+
+
+def commit(results_dir: str, msg: str) -> None:
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    from _git_util import commit_paths
+
+    commit_paths(REPO, artifacts(results_dir), msg, log=log)
+
+
+def wait_and_commit(proc_or_pid, results_dir: str, prefix: str) -> None:
+    """Poll a phase (Popen or adopted pid) to completion, committing its
+    artifacts every 10 minutes."""
+    def alive() -> bool:
+        if isinstance(proc_or_pid, int):
+            try:
+                os.kill(proc_or_pid, 0)
+                return True
+            except OSError:
+                return False
+        return proc_or_pid.poll() is None
+
+    last = 0.0
+    while alive():
+        time.sleep(30)
+        if time.monotonic() - last >= 600:
+            last = time.monotonic()
+            commit(results_dir,
+                   f"{prefix}: incremental snapshot "
+                   f"({time.strftime('%H:%M', time.gmtime())} UTC)")
+    commit(results_dir, f"{prefix}: phase complete")
+
+
+def launch(argv, logfile: str):
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=8"
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    out = open(logfile, "a")
+    return subprocess.Popen(argv, cwd=REPO, env=env, stdout=out,
+                            stderr=subprocess.STDOUT)
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--adopt-pid", type=int, default=None)
+    args = ap.parse_args()
+    py = sys.executable
+
+    log("phase 1: breakout+asterix 64k sweep")
+    if args.adopt_pid is not None:
+        log(f"adopting running sweep pid {args.adopt_pid}")
+        wait_and_commit(args.adopt_pid, "results/jaxsuite_64k",
+                        "jaxsuite 64k rerun")
+    else:
+        p = launch(
+            [py, "scripts/run_jaxsuite.py", "--games", "breakout", "asterix",
+             "--results-dir", "results/jaxsuite_64k",
+             "--note",
+             "breakout+asterix floor rerun at 65536 frames/game on the "
+             "1-core CPU sandbox (VERDICT r4 item 2); the 5-game 16k sweep "
+             "in results/jaxsuite left both below 0.2 script-normalized",
+             "--per-game-t-max", "breakout=65536", "asterix=65536", "--",
+             *SHARED, "--results-dir", "results/jaxsuite_64k/runs",
+             "--checkpoint-dir", "results/jaxsuite_64k/ckpt"],
+            "/tmp/q5_sweep64k.log")
+        wait_and_commit(p, "results/jaxsuite_64k", "jaxsuite 64k rerun")
+
+    log("phase 2: asterix@var 64k generalization")
+    p = launch(
+        [py, "scripts/run_jaxsuite.py", "--generalization", "--games",
+         "asterix", "--results-dir", "results/jaxsuite_var64k",
+         "--per-game-t-max", "asterix=65536", "--", *SHARED,
+         "--results-dir", "results/jaxsuite_var64k/runs",
+         "--checkpoint-dir", "results/jaxsuite_var64k/ckpt"],
+        "/tmp/q5_gen_asterix.log")
+    wait_and_commit(p, "results/jaxsuite_var64k", "asterix@var 64k")
+    log("ALL DONE")
+
+
+if __name__ == "__main__":
+    main()

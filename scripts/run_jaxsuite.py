@@ -35,6 +35,14 @@ def main() -> int:
                     help="instead of the score sweep, run the seeded-variant "
                          "train/held-out level split (writes "
                          "generalization.json)")
+    ap.add_argument("--levels-eval", type=int, default=64,
+                    help="generalization mode: per-level eval over this many "
+                         "held-out levels (0 disables the per_level block)")
+    ap.add_argument("--eps-per-level", type=int, default=8,
+                    help="episodes per pinned level in the per-level eval")
+    ap.add_argument("--note", default=None,
+                    help="free-text caveat emitted into aggregate.json by the "
+                         "writer itself (survives reruns)")
     ap.add_argument("--per-game-t-max", nargs="*", default=[],
                     metavar="GAME=FRAMES",
                     help="per-game --t-max override, e.g. breakout=65536 "
@@ -61,13 +69,15 @@ def main() -> int:
         out = run_generalization(passthrough, games=args.games,
                                  results_dir=args.results_dir,
                                  episodes=args.baseline_episodes,
-                                 per_game_args=per_game_args)
+                                 per_game_args=per_game_args, note=args.note,
+                                 levels_eval=args.levels_eval,
+                                 episodes_per_level=args.eps_per_level)
         print(json.dumps(out))
         return 0
     agg = run_sweep(passthrough, games=args.games,
                     results_dir=args.results_dir,
                     baseline_episodes=args.baseline_episodes,
-                    per_game_args=per_game_args)
+                    per_game_args=per_game_args, note=args.note)
     print(json.dumps(agg))
     return 0
 

@@ -73,7 +73,8 @@ def main() -> None:
     summary = train_anakin_r2d2(cfg, max_frames=max_frames)
     with open(os.path.join(OUT, "summary.json"), "w") as f:
         json.dump({"config": "fused R2D2 anakin, jaxgame:catch, hidden 64 / "
-                             "lstm 64 / history 1 / seq 10 / batch 16 (seed 7)",
+                             "lstm 64 / history 1 / seq 10 / batch 16 (seed 7)"
+                             " — scripts/run_r2d2_evidence.py",
                    "max_frames": max_frames,
                    "host_r2d2_baseline_eval": 1.0,
                    **{k: v for k, v in summary.items()}}, f, indent=1,

@@ -220,3 +220,41 @@ def test_make_env_factory_errors():
         make_env("toy:nothing")
     with pytest.raises(ImportError):
         make_env("atari:Pong")  # no ale_py in this sandbox: clear error
+
+
+def test_resize_fallback_matches_reference_loop_and_cv2():
+    """The vectorised NumPy area-mean fallback must reproduce the original
+    per-pixel loop bit-for-bit on every shape class (downscale, ragged bins,
+    upscale), and track cv2.INTER_AREA within rounding on evenly-dividing
+    shapes (cv2 rounds to nearest; the fallback truncates)."""
+    from rainbow_iqn_apex_tpu.envs.atari import _resize
+
+    def loop_ref(frame, hw):
+        h, w = frame.shape
+        th, tw = hw
+        ys = (np.arange(th + 1) * h // th).astype(int)
+        xs = (np.arange(tw + 1) * w // tw).astype(int)
+        out = np.empty((th, tw), np.uint8)
+        for i in range(th):
+            rows = frame[ys[i]: max(ys[i + 1], ys[i] + 1)]
+            for j in range(tw):
+                out[i, j] = rows[:, xs[j]: max(xs[j + 1], xs[j] + 1)].mean()
+        return out
+
+    rng = np.random.default_rng(0)
+    for src, dst in [((210, 160), (84, 84)), ((100, 70), (84, 84)),
+                     ((50, 40), (84, 84)), ((168, 168), (84, 84))]:
+        frame = rng.integers(0, 256, src, dtype=np.uint8)
+        # call the numpy path directly regardless of cv2 presence
+        import rainbow_iqn_apex_tpu.envs.atari as atari_mod
+        have_cv2 = atari_mod._HAVE_CV2
+        try:
+            atari_mod._HAVE_CV2 = False
+            got = _resize(frame, dst)
+        finally:
+            atari_mod._HAVE_CV2 = have_cv2
+        np.testing.assert_array_equal(got, loop_ref(frame, dst), err_msg=str(src))
+        assert got.dtype == np.uint8 and got.shape == dst
+        if have_cv2 and src == (168, 168):
+            want = _resize(frame, dst)  # cv2 path
+            assert np.abs(got.astype(int) - want.astype(int)).max() <= 1

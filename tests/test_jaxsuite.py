@@ -106,3 +106,95 @@ def test_run_sweep_writes_rows_incrementally_and_honors_per_game_args(
     # the override was appended after the shared flags, for catch only
     assert calls[0][1][-2:] == ["--t-max", "128"]
     assert calls[1][1][-2:] == ["--t-max", "64"]
+
+
+def test_bootstrap_gap_separates_signal_from_noise():
+    from rainbow_iqn_apex_tpu.jaxsuite import bootstrap_gap
+
+    rng = np.random.default_rng(0)
+    # clear gap: train levels uniformly better -> sign stable under resample
+    out = bootstrap_gap(10 + rng.normal(size=16), 5 + rng.normal(size=64))
+    assert out["gap"] > 4
+    assert out["gap_boot_frac_positive"] > 0.99
+    assert out["gap_boot_ci90"][0] > 0
+    # no gap: same distribution -> the sign must NOT look stable
+    out = bootstrap_gap(rng.normal(size=16) * 3, rng.normal(size=64) * 3)
+    assert 0.05 < out["gap_boot_frac_positive"] < 0.95
+
+
+def test_eval_checkpoint_per_level(tmp_path):
+    """End-to-end per-level eval of a (saved, untrained) checkpoint: one
+    compile serves multiple level chunks, shapes come back [n_levels, eps],
+    and pinned levels make the per-level axis meaningful (same level, same
+    layout)."""
+    import jax
+
+    from rainbow_iqn_apex_tpu.config import parse_config
+    from rainbow_iqn_apex_tpu.envs.device_games import make_device_game
+    from rainbow_iqn_apex_tpu.jaxsuite import (
+        eval_checkpoint_per_level,
+        per_level_fields,
+    )
+    from rainbow_iqn_apex_tpu.ops.learn import init_train_state
+    from rainbow_iqn_apex_tpu.utils.checkpoint import Checkpointer
+
+    args = ["--role", "anakin", "--history-length", "2",
+            "--compute-dtype", "float32", "--checkpoint-dir", str(tmp_path)]
+    cfg = parse_config([*args, "--env-id", "jaxgame:breakout@var",
+                        "--run-id", "pl0"])
+    game = make_device_game("breakout@var")
+    h, w = game.frame_shape
+    ts = init_train_state(cfg, game.num_actions, jax.random.PRNGKey(0),
+                          state_shape=(h, w, cfg.history_length))
+    ck = Checkpointer(str(tmp_path / "pl0"))
+    ck.save(1, ts)
+    ck.wait()
+
+    scores = eval_checkpoint_per_level(
+        args, "pl0", "breakout", levels=range(5), episodes_per_level=2,
+        chunk_levels=3, max_ticks=24)
+    assert scores.shape == (5, 2)
+    assert np.isfinite(scores).all()
+    fields = per_level_fields(scores, scores, 16)
+    assert fields["n_train_levels"] == 5
+    assert len(fields["train_level_means"]) == 5
+    assert fields["gap"] == 0.0
+
+
+def test_run_sweep_emits_note_and_frame_budgets(tmp_path, monkeypatch):
+    """ADVICE r4: caveats must come from the writer — flush() itself emits
+    `note` and `train_frames_per_game`, so a rerun can't drop them."""
+    import rainbow_iqn_apex_tpu.atari57 as atari57
+    from rainbow_iqn_apex_tpu.jaxsuite import run_sweep
+
+    frames = {"jaxgame:catch": 100, "jaxgame:freeway": 200}
+
+    def fake_train(env_id, run_id, base_args):
+        return {"frames": frames[env_id], "eval_score_mean": 1.0,
+                "eval_episodes": 2}
+
+    monkeypatch.setattr(atari57, "train_one_game", fake_train)
+    monkeypatch.setattr(
+        "rainbow_iqn_apex_tpu.jaxsuite.measure_baselines",
+        lambda name, episodes=64, seed=0: {"random": -0.8, "scripted": 1.0},
+    )
+    run_sweep(["--t-max", "64"], games=["catch", "freeway"],
+              results_dir=str(tmp_path), note="budget caveat rides along")
+    agg = json.loads((tmp_path / "aggregate.json").read_text())
+    assert agg["note"] == "budget caveat rides along"
+    assert agg["train_frames_per_game"] == {"catch": 100, "freeway": 200}
+
+
+def test_run_generalization_emits_note(tmp_path, monkeypatch):
+    import rainbow_iqn_apex_tpu.atari57 as atari57
+    from rainbow_iqn_apex_tpu.jaxsuite import run_generalization
+
+    monkeypatch.setattr(
+        atari57, "train_one_game",
+        lambda env_id, run_id, base_args: {"eval_score_mean": None},
+    )
+    run_generalization([], games=["freeway"], results_dir=str(tmp_path),
+                       note="gen caveat", levels_eval=0)
+    out = json.loads((tmp_path / "generalization.json").read_text())
+    assert out["note"] == "gen caveat"
+    assert out["per_game"][0]["error"] == "training run failed"

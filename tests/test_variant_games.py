@@ -215,3 +215,78 @@ def test_variant_games_run_in_fused_rollout():
                                max_ticks=64)
         assert rets.shape == (8,)
         assert np.isfinite(rets).all()
+
+
+def test_init_at_level_pins_layout_and_spans_pool():
+    """init_at_level must (a) fix the layout regardless of the episode key,
+    (b) vary it across levels, (c) reproduce exactly the pool init's layout
+    set — i.e. init() is still 'draw a pool level, then init_at_level', so
+    committed rows keep their meaning — and (d) accept traced levels under
+    vmap+jit (the per-level eval's access pattern)."""
+    layout_fields = {
+        "breakout@var": ("wall",),
+        "freeway@var": ("speeds", "dirs"),
+        "asterix@var": ("speeds", "lane_dir", "gold_p"),
+        "invaders@var": ("fleet", "march_every", "bomb_every"),
+    }
+    for name, fields in layout_fields.items():
+        g = make_device_game(name)
+
+        def layout(s):
+            return tuple(np.asarray(getattr(s, f)).tobytes() for f in fields)
+
+        # (a) same level, different episode keys -> same layout
+        a = g.init_at_level(jnp.int32(7), jax.random.PRNGKey(0))
+        b = g.init_at_level(jnp.int32(7), jax.random.PRNGKey(99))
+        assert layout(a) == layout(b), name
+        # (b) levels differ (16 levels; any fixed pair could collide, but
+        # the full set must vary)
+        per_level = {layout(g.init_at_level(jnp.int32(l),
+                                            jax.random.PRNGKey(1)))
+                     for l in range(N_TRAIN_LEVELS)}
+        assert len(per_level) > 4, name
+        # (c) every pool-drawn layout is one of the 16 level layouts
+        pool = {layout(g.init(jax.random.PRNGKey(i))) for i in range(48)}
+        assert pool <= per_level, name
+        # (d) traced levels vmap under jit
+        levels = jnp.arange(4, dtype=jnp.int32)
+        keys = jax.random.split(jax.random.PRNGKey(2), 4)
+        states = jax.jit(jax.vmap(g.init_at_level))(levels, keys)
+        got = np.asarray(getattr(states, fields[0]))
+        want = np.stack([
+            np.asarray(getattr(g.init_at_level(l, k), fields[0]))
+            for l, k in zip(levels, keys)
+        ])
+        assert np.array_equal(got, want), name
+
+
+def test_rollout_init_fn_pins_lane_levels():
+    """build_rollout's init_fn hook: lanes get the levels the aux argument
+    assigns (one compile serves any level chunk), and the rollout completes
+    with per-lane returns."""
+    from rainbow_iqn_apex_tpu.envs.device_games import build_rollout
+
+    g = make_device_game("freeway@var")
+    eps, levels = 2, jnp.asarray([0, 5, 21], jnp.int32)
+    lanes = eps * len(levels)
+
+    def action_fn(aux, states, stack, key):
+        return jnp.ones(lanes, jnp.int32)  # always up
+
+    def init_fn(aux, key):
+        return jax.vmap(g.init_at_level)(
+            jnp.repeat(aux, eps), jax.random.split(key, lanes)
+        )
+
+    # the init states really carry the pinned levels' dynamics
+    states = init_fn(levels, jax.random.PRNGKey(0))
+    sp = np.asarray(states.speeds)
+    for i, l in enumerate(np.repeat(np.asarray(levels), eps)):
+        want = np.asarray(
+            g.init_at_level(jnp.int32(l), jax.random.PRNGKey(7)).speeds
+        )
+        assert np.array_equal(sp[i], want)
+
+    run = build_rollout(g, action_fn, lanes, 16, init_fn=init_fn)
+    r1 = np.asarray(run(levels, jax.random.PRNGKey(3)))
+    assert r1.shape == (lanes,)
