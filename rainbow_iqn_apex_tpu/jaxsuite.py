@@ -295,6 +295,11 @@ def aggregate(per_game_raw: Dict[str, float],
         out["per_game_normalized"] = {g: round(n, 4)
                                       for g, n in sorted(norm.items())}
         out["games_below_0.2"] = sum(1 for n in norm.values() if n < 0.2)
+        # scripted ceilings are asymmetric (VERDICT r4): where the agent
+        # BEATS its script (n > 1) the script was floor-quality and "1.0 =
+        # plays like the script" understates the agent; the count makes the
+        # two meanings of the median separable at a glance
+        out["games_above_script"] = sum(1 for n in norm.values() if n > 1.0)
     return out
 
 
@@ -327,6 +332,12 @@ def run_sweep(base_args: List[str], games: Optional[List[str]] = None,
         agg["games_failed"] = len(failed)
         if failed:
             agg["failed_games"] = failed
+        # partial-budget (salvaged) scores sit in the same median — the
+        # aggregate must say so itself (writer-emits-caveats rule)
+        salvaged = sorted(r["game"] for r in rows if r.get("salvaged"))
+        if salvaged:
+            agg["games_salvaged"] = len(salvaged)
+            agg["salvaged_games"] = salvaged
         frames = {r["game"]: r["train_frames"] for r in rows
                   if r.get("train_frames") is not None}
         if frames:
@@ -341,27 +352,52 @@ def run_sweep(base_args: List[str], games: Optional[List[str]] = None,
 
     for game in games:
         args = [*base_args, *(per_game_args or {}).get(game, [])]
-        summary = train_one_game(f"jaxgame:{game}", f"jaxsuite_{game}", args)
+        run_id = f"jaxsuite_{game}"
+        summary = train_one_game(f"jaxgame:{game}", run_id, args)
         raw = summary.get("eval_score_mean")
+        extra = dict(summary)
+        salvaged = False
         if raw is None:
-            # a failed/summary-less run must still leave a visible row —
-            # a silently shrunken suite would inflate the aggregate
-            failed.append(game)
-            rows.append({"game": game, "score_mean": None,
-                         "error": "no eval summary from training run"})
-            flush()
-            continue
+            # an interrupted/killed training still leaves periodic
+            # checkpoints — score the latest one rather than dropping hours
+            # of training (a wind-down cut mid-sweep is a normal event on
+            # budgeted boxes); ANY salvage failure becomes an error row so
+            # one broken game can never abort the remaining sweep
+            try:
+                raw, ck_extra = eval_checkpoint_fused(
+                    args, run_id, game, episodes=baseline_episodes,
+                    with_extra=True)
+                salvaged = True
+                extra = {"eval_episodes": baseline_episodes,
+                         "frames": ck_extra.get("frames")}
+            except FileNotFoundError:
+                failed.append(game)
+                rows.append({"game": game, "score_mean": None,
+                             "error": "training run failed "
+                                      "(no checkpoint to salvage)"})
+                flush()
+                continue
+            except Exception as e:  # noqa: BLE001 — keep the sweep alive
+                failed.append(game)
+                rows.append({"game": game, "score_mean": None,
+                             "error": f"salvage eval failed: {e!r}"})
+                flush()
+                continue
         baselines[game] = measure_baselines(game, episodes=baseline_episodes)
         per_game[game] = raw
-        rows.append({
+        row = {
             "game": game,
             "score_mean": raw,
             "random_baseline": baselines[game].get("random"),
             "scripted_baseline": baselines[game].get("scripted"),
             "script_normalized": normalized_score(raw, baselines[game]),
-            "train_frames": summary.get("frames"),
-            **{k: v for k, v in summary.items() if k.startswith("eval_")},
-        })
+            "train_frames": extra.get("frames"),
+            **{k: v for k, v in extra.items() if k.startswith("eval_")},
+        }
+        if salvaged:
+            row["salvaged"] = True  # scored from the latest periodic
+            # checkpoint of an interrupted run, at its true frame count
+        rows.append(row)
         flush()
     return flush()
 
@@ -384,19 +420,14 @@ def eval_checkpoint_per_level(base_args: List[str], run_id: str,
 
     The lane->level assignment rides through the rollout's `aux` argument,
     so every chunk of ``chunk_levels`` levels reuses ONE compiled rollout.
-    Feedforward checkpoints only (the generalization suite trains the fused
-    IQN Anakin)."""
+    Works for feedforward AND r2d2 checkpoints (greedy LSTM lanes with
+    cut-reset, mirroring build_fused_r2d2_eval)."""
     from rainbow_iqn_apex_tpu.config import parse_config
     from rainbow_iqn_apex_tpu.envs.device_games import build_rollout
-    from rainbow_iqn_apex_tpu.ops.learn import build_act_step, init_train_state
     from rainbow_iqn_apex_tpu.utils.checkpoint import Checkpointer
 
     cfg = parse_config([*base_args, "--env-id", f"jaxgame:{base_game}@var",
                         "--run-id", run_id])
-    if cfg.architecture == "r2d2":
-        raise NotImplementedError(
-            "per-level eval supports the feedforward fused eval only"
-        )
     levels = list(levels)
     game = make_device_game(f"{base_game}@var")
     h, w = game.frame_shape
@@ -404,11 +435,6 @@ def eval_checkpoint_per_level(base_args: List[str], run_id: str,
     eps = episodes_per_level
     C = min(chunk_levels, len(levels))
     lanes = C * eps
-    act_fn = build_act_step(cfg, game.num_actions, use_noise=False)
-
-    def action_fn(aux, states, stack, key):
-        actions, _q = act_fn(aux[0], stack, key)
-        return actions
 
     def init_fn(aux, key):
         lane_levels = jnp.repeat(aux[1], eps)
@@ -416,10 +442,44 @@ def eval_checkpoint_per_level(base_args: List[str], run_id: str,
             lane_levels, jax.random.split(key, lanes)
         )
 
-    run = build_rollout(game, action_fn, lanes, T,
-                        history=cfg.history_length, init_fn=init_fn)
-    ts = init_train_state(cfg, game.num_actions, jax.random.PRNGKey(0),
-                          state_shape=(h, w, cfg.history_length))
+    if cfg.architecture == "r2d2":
+        from rainbow_iqn_apex_tpu.ops.r2d2 import (
+            build_r2d2_act_step,
+            init_r2d2_state,
+        )
+
+        act_fn = build_r2d2_act_step(cfg, game.num_actions,
+                                     use_noise=cfg.eval_noisy)
+
+        def action_fn(aux, states, stack, key, lstm):
+            a, _q, lstm = act_fn(aux[0], stack, lstm, key)
+            return a, lstm
+
+        def actor_init(n):
+            z = jnp.zeros((n, cfg.lstm_size), jnp.float32)
+            return (z, z)
+
+        run = build_rollout(game, action_fn, lanes, T,
+                            history=cfg.history_length,
+                            actor_init=actor_init, init_fn=init_fn)
+        ts = init_r2d2_state(cfg, game.num_actions, jax.random.PRNGKey(0),
+                             (h, w))
+    else:
+        from rainbow_iqn_apex_tpu.ops.learn import (
+            build_act_step,
+            init_train_state,
+        )
+
+        act_fn = build_act_step(cfg, game.num_actions, use_noise=False)
+
+        def action_fn(aux, states, stack, key):
+            actions, _q = act_fn(aux[0], stack, key)
+            return actions
+
+        run = build_rollout(game, action_fn, lanes, T,
+                            history=cfg.history_length, init_fn=init_fn)
+        ts = init_train_state(cfg, game.num_actions, jax.random.PRNGKey(0),
+                              state_shape=(h, w, cfg.history_length))
     ckpt = Checkpointer(os.path.join(cfg.checkpoint_dir, cfg.run_id))
     if ckpt.latest_step() is None:
         raise FileNotFoundError(
@@ -479,10 +539,14 @@ def per_level_fields(train_scores: np.ndarray, heldout_scores: np.ndarray,
 
 
 def eval_checkpoint_fused(base_args: List[str], run_id: str, game_name: str,
-                          episodes: int = 64, seed: int = 1234) -> float:
+                          episodes: int = 64, seed: int = 1234,
+                          with_extra: bool = False):
     """Mean first-episode return of a trained checkpoint on `game_name`
     (variant ids welcome), via the in-graph fused eval — the measurement
-    half of the train/test generalization split."""
+    half of the train/test generalization split.  ``with_extra=True``
+    returns ``(score, extra)`` where extra is the checkpoint's JSON side-car
+    (frames counter etc.) — the salvage paths need it and the restore has it
+    in hand anyway."""
     from rainbow_iqn_apex_tpu.config import parse_config
     from rainbow_iqn_apex_tpu.utils.checkpoint import Checkpointer
 
@@ -511,9 +575,10 @@ def eval_checkpoint_fused(base_args: List[str], run_id: str, game_name: str,
         raise FileNotFoundError(
             f"no checkpoint under {cfg.checkpoint_dir}/{cfg.run_id}"
         )
-    ts, _ = ckpt.restore(ts)
+    ts, ck_extra = ckpt.restore(ts)
     scores = np.asarray(eval_fn(ts.params, jax.random.PRNGKey(seed)))
-    return float(scores.mean())
+    score = float(scores.mean())
+    return (score, ck_extra) if with_extra else score
 
 
 def run_generalization(base_args: List[str],
@@ -569,14 +634,30 @@ def run_generalization(base_args: List[str],
         run_id = f"jaxsuite_{g}_var"
         args = [*base_args, *(per_game_args or {}).get(g, [])]
         summary = train_one_game(f"jaxgame:{g}@var", run_id, args)
-        if summary.get("eval_score_mean") is None:
-            rows.append({"game": g, "error": "training run failed"})
+        trained_ok = summary.get("eval_score_mean") is not None
+        try:
+            # both splits are scored from the checkpoint anyway, so an
+            # interrupted/killed training salvages for free — the row just
+            # carries `salvaged` and the checkpoint's true frame count
+            train_score, ck_extra = eval_checkpoint_fused(
+                args, run_id, f"{g}@var", episodes, with_extra=True)
+            test_score = eval_checkpoint_fused(args, run_id, f"{g}@var-test",
+                                               episodes)
+        except FileNotFoundError:
+            # distinguish the mislabel: a COMPLETED training with no
+            # checkpoint is a misconfiguration, not a failed run
+            rows.append({"game": g, "error":
+                         "trained but no checkpoint found (checkpointing "
+                         "misconfigured?)" if trained_ok else
+                         "training run failed (no checkpoint to salvage)"})
             flush()
             continue
-        train_score = eval_checkpoint_fused(args, run_id, f"{g}@var",
-                                            episodes)
-        test_score = eval_checkpoint_fused(args, run_id, f"{g}@var-test",
-                                           episodes)
+        except Exception as e:  # noqa: BLE001 — keep remaining games alive
+            rows.append({"game": g, "error": f"checkpoint eval failed: {e!r}"})
+            flush()
+            continue
+        train_frames = (summary.get("frames") if trained_ok
+                        else ck_extra.get("frames"))
         rnd = float(np.mean(rollout_returns(f"{g}@var", _p_random, episodes,
                                             seed=99)))
         # the "clearly off-random" bar: random plus 2x its magnitude (i.e.
@@ -591,11 +672,14 @@ def run_generalization(base_args: List[str],
             "generalization_gap": train_score - test_score,
             "train_random_baseline": rnd,
             "off_random": bool(train_score >= bar),
-            "train_frames": summary.get("frames"),
+            "train_frames": train_frames,
         }
+        if not trained_ok:
+            row["salvaged"] = True  # scored from the latest periodic
+            # checkpoint of an interrupted run
         # the two-pool row is hours of training — it goes to disk BEFORE the
-        # per-level eval can fail (compile OOM, corrupted checkpoint, the
-        # r2d2 NotImplementedError); the block is added by a re-flush
+        # per-level eval can fail (compile OOM, corrupted checkpoint); the
+        # block is added by a re-flush
         rows.append(row)
         flush()
         if levels_eval > 0:

@@ -45,6 +45,18 @@ class Checkpointer:
     def latest_step(self) -> Optional[int]:
         return self._mngr.latest_step()
 
+    def restore_extra(self, step: Optional[int] = None) -> Dict[str, Any]:
+        """The JSON side-car alone (frames counter etc.) without needing an
+        abstract TrainState — used by salvage paths that score interrupted
+        runs from their latest periodic checkpoint."""
+        step = self.latest_step() if step is None else step
+        if step is None:
+            raise FileNotFoundError(f"no checkpoint under {self.directory}")
+        out = self._mngr.restore(
+            step, args=ocp.args.Composite(extra=ocp.args.JsonRestore())
+        )
+        return dict(out["extra"] or {})
+
     def restore(
         self, abstract_state: TrainState, step: Optional[int] = None
     ) -> Tuple[TrainState, Dict[str, Any]]:

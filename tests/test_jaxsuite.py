@@ -197,4 +197,84 @@ def test_run_generalization_emits_note(tmp_path, monkeypatch):
                        note="gen caveat", levels_eval=0)
     out = json.loads((tmp_path / "generalization.json").read_text())
     assert out["note"] == "gen caveat"
-    assert out["per_game"][0]["error"] == "training run failed"
+    assert out["per_game"][0]["error"] == (
+        "training run failed (no checkpoint to salvage)")
+
+
+def test_sweep_and_generalization_salvage_interrupted_runs(
+        tmp_path, monkeypatch):
+    """A training killed mid-run (wind-down on a budgeted box) must still
+    yield a scored row — from the latest periodic checkpoint, marked
+    `salvaged`, at the checkpoint's true frame count — in BOTH harness
+    modes; only a checkpoint-less failure becomes an error row."""
+    import rainbow_iqn_apex_tpu.atari57 as atari57
+    import rainbow_iqn_apex_tpu.jaxsuite as js
+
+    monkeypatch.setattr(atari57, "train_one_game",
+                        lambda env_id, run_id, base_args: {})  # killed run
+    monkeypatch.setattr(
+        js, "measure_baselines",
+        lambda name, episodes=64, seed=0: {"random": 0.1, "scripted": 2.0},
+    )
+    def fake_eval(args, run_id, game_name, episodes=64, seed=1234,
+                  with_extra=False):
+        return (1.5, {"frames": 12345}) if with_extra else 1.5
+
+    monkeypatch.setattr(js, "eval_checkpoint_fused", fake_eval)
+
+    agg = js.run_sweep([], games=["catch"], results_dir=str(tmp_path / "s"))
+    import csv as _csv
+    with open(tmp_path / "s" / "per_game.csv") as f:
+        rows = list(_csv.DictReader(f))
+    assert rows[0]["salvaged"] == "True"
+    assert rows[0]["train_frames"] == "12345"
+    assert float(rows[0]["score_mean"]) == 1.5
+    assert agg["games_failed"] == 0
+    # the aggregate itself must carry the partial-budget caveat
+    assert agg["games_salvaged"] == 1 and agg["salvaged_games"] == ["catch"]
+
+    monkeypatch.setattr(
+        js, "rollout_returns",
+        lambda *a, **k: np.array([0.1, 0.1]),
+    )
+    out = js.run_generalization([], games=["freeway"],
+                                results_dir=str(tmp_path / "g"),
+                                levels_eval=0)
+    g = out["per_game"][0]
+    assert g["salvaged"] is True
+    assert g["train_frames"] == 12345
+    assert g["train_levels_score"] == 1.5
+
+
+def test_eval_checkpoint_per_level_r2d2(tmp_path):
+    """Per-level eval works for recurrent checkpoints too: greedy LSTM
+    lanes with cut-reset, levels pinned the same way."""
+    import jax
+
+    from rainbow_iqn_apex_tpu.config import parse_config
+    from rainbow_iqn_apex_tpu.jaxsuite import eval_checkpoint_per_level
+    from rainbow_iqn_apex_tpu.ops.r2d2 import init_r2d2_state
+    from rainbow_iqn_apex_tpu.utils.checkpoint import Checkpointer
+
+    args = ["--role", "anakin", "--architecture", "r2d2",
+            "--history-length", "1", "--hidden-size", "32",
+            "--lstm-size", "16", "--num-cosines", "8",
+            "--num-tau-samples", "4", "--num-tau-prime-samples", "4",
+            "--num-quantile-samples", "2",
+            "--compute-dtype", "float32", "--checkpoint-dir", str(tmp_path)]
+    cfg = parse_config([*args, "--env-id", "jaxgame:freeway@var",
+                        "--run-id", "plr0"])
+    from rainbow_iqn_apex_tpu.envs.device_games import make_device_game
+
+    game = make_device_game("freeway@var")
+    ts = init_r2d2_state(cfg, game.num_actions, jax.random.PRNGKey(0),
+                         game.frame_shape)
+    ck = Checkpointer(str(tmp_path / "plr0"))
+    ck.save(1, ts)
+    ck.wait()
+
+    scores = eval_checkpoint_per_level(
+        args, "plr0", "freeway", levels=range(3), episodes_per_level=2,
+        chunk_levels=3, max_ticks=16)
+    assert scores.shape == (3, 2)
+    assert np.isfinite(scores).all()

@@ -91,3 +91,27 @@ def test_concurrent_append_sample_stress(use_native):
     stop.set()
     w.join(timeout=5)
     assert not errors, errors
+
+
+def test_checkpointer_restore_extra_without_state(tmp_path):
+    """restore_extra reads the JSON side-car alone (salvage paths score
+    interrupted runs without building an abstract TrainState first)."""
+    import jax
+
+    from rainbow_iqn_apex_tpu.config import Config
+    from rainbow_iqn_apex_tpu.ops.learn import init_train_state
+    from rainbow_iqn_apex_tpu.utils.checkpoint import Checkpointer
+
+    cfg = Config(compute_dtype="float32", frame_height=44, frame_width=44,
+                 history_length=2, hidden_size=32, num_cosines=8,
+                 num_tau_samples=4, num_tau_prime_samples=4,
+                 num_quantile_samples=2)
+    ts = init_train_state(cfg, 4, jax.random.PRNGKey(0))
+    ck = Checkpointer(str(tmp_path / "run"))
+    ck.save(7, ts, {"frames": 4242})
+    ck.wait()
+    fresh = Checkpointer(str(tmp_path / "run"))
+    assert fresh.restore_extra() == {"frames": 4242}
+    import pytest as _pytest
+    with _pytest.raises(FileNotFoundError):
+        Checkpointer(str(tmp_path / "empty")).restore_extra()
