@@ -46,9 +46,11 @@ class Checkpointer:
         return self._mngr.latest_step()
 
     def restore_extra(self, step: Optional[int] = None) -> Dict[str, Any]:
-        """The JSON side-car alone (frames counter etc.) without needing an
-        abstract TrainState — used by salvage paths that score interrupted
-        runs from their latest periodic checkpoint."""
+        """The JSON side-car alone (frames counter etc.) without building an
+        abstract TrainState — for tooling that inspects a run (frame count,
+        resume point) without paying a params restore.  The in-harness
+        salvage paths use eval_checkpoint_fused(with_extra=True) instead,
+        which gets the side-car from the full restore they do anyway."""
         step = self.latest_step() if step is None else step
         if step is None:
             raise FileNotFoundError(f"no checkpoint under {self.directory}")

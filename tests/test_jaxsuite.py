@@ -193,7 +193,8 @@ def test_run_generalization_emits_note(tmp_path, monkeypatch):
         atari57, "train_one_game",
         lambda env_id, run_id, base_args: {"eval_score_mean": None},
     )
-    run_generalization([], games=["freeway"], results_dir=str(tmp_path),
+    run_generalization(["--checkpoint-dir", str(tmp_path / "ck")],
+                       games=["freeway"], results_dir=str(tmp_path),
                        note="gen caveat", levels_eval=0)
     out = json.loads((tmp_path / "generalization.json").read_text())
     assert out["note"] == "gen caveat"
