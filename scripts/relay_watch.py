@@ -31,6 +31,11 @@ the wait costs nothing but this process's patience.
 Usage:
     nohup python scripts/relay_watch.py > /tmp/relay_watch.out 2>&1 &
 Stop it by creating results/relay_watch/STOP (checked between probes).
+
+`--dry-run` rehearses the CAPTURE CHAIN itself (skipping the probe loop):
+every phase runs on CPU with tiny budgets into a scratch outdir, with
+commits disabled — proving the argv/log/redirect plumbing end-to-end so the
+first real live window can't be lost to a harness typo.
 """
 
 import json
@@ -40,7 +45,13 @@ import sys
 import time
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-OUTDIR = os.path.join(REPO, "results", "relay_watch")
+_unknown = [a for a in sys.argv[1:] if a != "--dry-run"]
+if _unknown:  # a typo'd --dryrun must not silently start the REAL watcher
+    raise SystemExit(f"relay_watch: unknown args {_unknown} "
+                     "(only --dry-run is accepted)")
+DRY_RUN = "--dry-run" in sys.argv[1:]
+OUTDIR = (os.path.join("/tmp", "relay_watch_dryrun") if DRY_RUN
+          else os.path.join(REPO, "results", "relay_watch"))
 LOG = os.path.join(OUTDIR, "watch.jsonl")
 STOP = os.path.join(OUTDIR, "STOP")
 PIDFILE = os.path.join(OUTDIR, "watch.pid")
@@ -78,11 +89,14 @@ def log_event(**row) -> None:
 
 
 def git_commit(paths, msg) -> bool:
+    if DRY_RUN:
+        log_event(event="dry_run_commit_skipped", msg=msg)
+        return True
     from _git_util import commit_paths
 
     return commit_paths(REPO, paths, msg,
                         log=lambda m: log_event(event="git_commit_failed",
-                                                msg=msg))
+                                                msg=msg, err=m))
 
 
 def run_probe() -> dict:
@@ -118,6 +132,10 @@ def run_phase(name: str, argv, out_name: str, extra_env=None,
     """Run one capture phase, stdout -> results/relay_watch/<out_name>,
     wait without killing, commit the artifact."""
     env = dict(os.environ)
+    if DRY_RUN:  # CPU rehearsal: the relay env must not leak in
+        env["JAX_PLATFORMS"] = "cpu"
+        env.pop("PALLAS_AXON_POOL_IPS", None)
+        strip_platform_pin = False
     if strip_platform_pin:
         env.pop("JAX_PLATFORMS", None)
     env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
@@ -142,9 +160,12 @@ def run_phase(name: str, argv, out_name: str, extra_env=None,
 
 def capture_chain() -> None:
     """The staged live-window chain, safest-first (docs/STATUS.md), each
-    phase committed before the next starts."""
+    phase committed before the next starts.  Under --dry-run every phase
+    gets a tiny budget and the sweep shrinks to one short catch run, so the
+    whole chain rehearses on CPU in minutes."""
     py = sys.executable
-    jaxsuite_dir = os.path.join("results", "jaxsuite_tpu")
+    jaxsuite_dir = (os.path.join(OUTDIR, "jaxsuite") if DRY_RUN
+                    else os.path.join("results", "jaxsuite_tpu"))
     # the round-3/4 CPU sweep config exactly (scripts/round5_queue.py
     # SHARED), so on-chip rows are apples-to-apples with the committed
     # 16k/64k CPU tables — only the budget (64k frames/game) changes
@@ -162,27 +183,50 @@ def capture_chain() -> None:
               "--eval-episodes", "32",
               "--results-dir", f"{jaxsuite_dir}/runs",
               "--checkpoint-dir", f"{jaxsuite_dir}/ckpt"]
-    phases = [
-        ("tpu_session", [py, "scripts/tpu_session.py", "420"],
-         "tpu_session.jsonl", None),
-        ("bench", [py, "bench.py"], "bench_live.jsonl", None),
-        ("bench_scaling",
-         [py, "scripts/bench_scaling.py", "420",
-          "32,64,128,256,32x2,32x4"],
-         "scaling.jsonl", None),
-        ("bench_pallas", [py, "scripts/bench_pallas.py"], "pallas.jsonl",
-         {"BENCH_ITERS": "50"}),
-        # on-chip score sweep at the budget the CPU box can't afford: at the
-        # round-2 device rate (~1890 learn-steps/s) 64k frames/game is minutes
-        ("jaxsuite_tpu",
-         [py, "scripts/run_jaxsuite.py",
-          "--games", "catch", "breakout", "freeway", "asterix", "invaders",
-          "--results-dir", jaxsuite_dir,
-          "--per-game-t-max", "catch=65536", "breakout=65536",
-          "freeway=65536", "asterix=65536", "invaders=65536",
-          "--", *shared],
-         "jaxsuite_tpu.jsonl", None),
-    ]
+    if DRY_RUN:
+        # tiny budgets / one short game: exercises every argv, redirect and
+        # log path the real window will use, in minutes on CPU
+        phases = [
+            ("tpu_session", [py, "scripts/tpu_session.py", "45"],
+             "tpu_session.jsonl", None),
+            ("bench", [py, "bench.py"], "bench_live.jsonl",
+             {"BENCH_WATCHDOG_SECS": "120"}),
+            ("bench_scaling",
+             [py, "scripts/bench_scaling.py", "45", "2,2x2"],
+             "scaling.jsonl",
+             {"SCALE_LANES": "4", "SCALE_SEG": "64", "SCALE_SCAN": "4"}),
+            ("bench_pallas", [py, "scripts/bench_pallas.py"], "pallas.jsonl",
+             {"BENCH_ITERS": "2"}),
+            ("jaxsuite_tpu",
+             [py, "scripts/run_jaxsuite.py", "--games", "catch",
+              "--results-dir", jaxsuite_dir, "--baseline-episodes", "8",
+              "--per-game-t-max", "catch=768", "--", *shared],
+             "jaxsuite_tpu.jsonl", None),
+        ]
+    else:
+        phases = [
+            ("tpu_session", [py, "scripts/tpu_session.py", "420"],
+             "tpu_session.jsonl", None),
+            ("bench", [py, "bench.py"], "bench_live.jsonl", None),
+            ("bench_scaling",
+             [py, "scripts/bench_scaling.py", "420",
+              "32,64,128,256,32x2,32x4"],
+             "scaling.jsonl", None),
+            ("bench_pallas", [py, "scripts/bench_pallas.py"], "pallas.jsonl",
+             {"BENCH_ITERS": "50"}),
+            # on-chip score sweep at the budget the CPU box can't afford: at
+            # the round-2 device rate (~1890 learn-steps/s) 64k frames/game
+            # is minutes
+            ("jaxsuite_tpu",
+             [py, "scripts/run_jaxsuite.py",
+              "--games", "catch", "breakout", "freeway", "asterix",
+              "invaders",
+              "--results-dir", jaxsuite_dir,
+              "--per-game-t-max", "catch=65536", "breakout=65536",
+              "freeway=65536", "asterix=65536", "invaders=65536",
+              "--", *shared],
+             "jaxsuite_tpu.jsonl", None),
+        ]
     for name, argv, out_name, extra_env in phases:
         run_phase(name, argv, out_name, extra_env)
     # the sweep's own artifacts live outside OUTDIR — commit the benchmark
@@ -199,6 +243,11 @@ def capture_chain() -> None:
 
 def main() -> None:
     os.makedirs(OUTDIR, exist_ok=True)
+    if DRY_RUN:
+        log_event(event="dry_run_chain_start")
+        capture_chain()
+        log_event(event="dry_run_chain_done")
+        return
     with open(PIDFILE, "w") as f:
         f.write(str(os.getpid()))
     log_event(event="watcher_start", pid=os.getpid(),
