@@ -261,6 +261,26 @@ class DeviceSequenceReplay:
         )
         return batch, prob
 
+    def sample_grouped(
+        self, s: DeviceSeqState, key: chex.PRNGKey, batch_size: int,
+        groups: int, beta: jnp.ndarray,
+    ) -> Tuple[jnp.ndarray, SequenceBatch, jnp.ndarray]:
+        """``groups`` independent stratified draws of ``batch_size``
+        sequences concatenated into one [G*B] learn batch — the sequence
+        twin of replay/device.DeviceReplay.sample_grouped (cfg.sample_groups,
+        the TPU batch-scaling knob): per-group stratum width and per-group
+        max-normalised IS weights, exactly as G sequential reference steps.
+
+        Returns (idx [G, B], SequenceBatch over [G*B], prob [G*B])."""
+        keys = jax.random.split(key, groups)
+        idx = jax.vmap(lambda k: self.draw(s, k, batch_size))(keys)
+        batch, prob = self.assemble(s, idx.reshape(-1), beta,
+                                    with_weight=False)
+        w = (jnp.maximum(s.filled, 1).astype(jnp.float32) * prob) ** (-beta)
+        w = w.reshape(groups, batch_size)
+        w = w / w.max(axis=1, keepdims=True)
+        return idx, batch.replace(weight=w.reshape(-1)), prob
+
     # ------------------------------------------------------------- priorities
     def update_priorities(
         self, s: DeviceSeqState, idx: jnp.ndarray, td_mix: jnp.ndarray
@@ -272,6 +292,17 @@ class DeviceSequenceReplay:
             priority=s.priority.at[idx].set(pri),
             max_priority=jnp.maximum(s.max_priority, pri.max()),
         )
+
+    def update_priorities_grouped(
+        self, s: DeviceSeqState, idx: jnp.ndarray, td_mix: jnp.ndarray
+    ) -> DeviceSeqState:
+        """Write-back for sample_grouped's [G, B] indices in group order
+        (last group wins on duplicates, as G sequential steps would)."""
+        G = idx.shape[0]
+        td = td_mix.reshape(G, -1)
+        for g in range(G):
+            s = self.update_priorities(s, idx[g], td[g])
+        return s
 
 
 def build_device_r2d2_learn(cfg, num_actions: int,
@@ -290,15 +321,25 @@ def build_device_r2d2_learn(cfg, num_actions: int,
     from rainbow_iqn_apex_tpu.ops.r2d2 import build_r2d2_learn_step
 
     learn_step = build_r2d2_learn_step(cfg, num_actions)
+    groups = getattr(cfg, "sample_groups", 1)
 
     def fused(train_state, replay_state, key, beta):
         k_sample, k_learn = jax.random.split(key)
-        idx = replay.draw(replay_state, k_sample, cfg.batch_size)
-        batch, _prob = replay.assemble(replay_state, idx, beta)
-        train_state, info = learn_step(train_state, batch, k_learn)
-        replay_state = replay.update_priorities(
-            replay_state, idx, info["priorities"]
-        )
+        if groups > 1:
+            idx, batch, _prob = replay.sample_grouped(
+                replay_state, k_sample, cfg.batch_size, groups, beta
+            )
+            train_state, info = learn_step(train_state, batch, k_learn)
+            replay_state = replay.update_priorities_grouped(
+                replay_state, idx, info["priorities"]
+            )
+        else:
+            idx = replay.draw(replay_state, k_sample, cfg.batch_size)
+            batch, _prob = replay.assemble(replay_state, idx, beta)
+            train_state, info = learn_step(train_state, batch, k_learn)
+            replay_state = replay.update_priorities(
+                replay_state, idx, info["priorities"]
+            )
         return train_state, replay_state, info
 
     return fused
@@ -402,6 +443,7 @@ def build_device_r2d2_learn_sharded(cfg, num_actions: int,
             f"batch {cfg.batch_size} not divisible by {n_dev} devices"
         )
     b_loc = cfg.batch_size // n_dev
+    groups = getattr(cfg, "sample_groups", 1)
     learn_step = build_r2d2_learn_step(cfg, num_actions)
     state_spec = device_seq_specs(axis)
     batch_spec = SequenceBatch(
@@ -411,20 +453,38 @@ def build_device_r2d2_learn_sharded(cfg, num_actions: int,
     smap = _shard_map()
 
     def _draw_assemble(gs, key, beta):
+        """Per-shard fixed-quota draw; cfg.sample_groups > 1 draws G groups
+        of b_loc per shard (flattened, group g contiguous) with IS weights
+        pmax-normalised PER GROUP — the grouped pattern of
+        replay/device.build_device_learn_sharded over the psum'd sequence
+        fill counts."""
         s = _unstack(gs)
         k = jax.random.fold_in(key, jax.lax.axis_index(axis))
-        idx = local_replay.draw(s, k, b_loc)
+        if groups > 1:
+            keys = jax.random.split(k, groups)
+            idx = jax.vmap(
+                lambda kk: local_replay.draw(s, kk, b_loc)
+            )(keys).reshape(-1)
+        else:
+            idx = local_replay.draw(s, k, b_loc)
         batch, prob = local_replay.assemble(s, idx, beta, with_weight=False)
         n_global = jax.lax.psum(s.filled, axis).astype(jnp.float32)
         nq = jnp.maximum(jnp.maximum(n_global, 1.0) * prob / n_dev, 1e-12)
         w = nq ** (-beta)
-        w = w / jax.lax.pmax(w.max(), axis)
+        wg = w.reshape(groups, b_loc)
+        wmax = jax.lax.pmax(wg.max(axis=1), axis)
+        w = (wg / wmax[:, None]).reshape(-1)
         return idx, batch.replace(weight=w)
 
     def _write_back(gs, idx, td_mix):
-        return _restack(
-            local_replay.update_priorities(_unstack(gs), idx, td_mix)
-        )
+        s = _unstack(gs)
+        if groups > 1:
+            s = local_replay.update_priorities_grouped(
+                s, idx.reshape(groups, b_loc), td_mix
+            )
+        else:
+            s = local_replay.update_priorities(s, idx, td_mix)
+        return _restack(s)
 
     draw_assemble = smap(
         _draw_assemble, mesh=mesh,
