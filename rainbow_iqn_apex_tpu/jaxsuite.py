@@ -303,11 +303,56 @@ def aggregate(per_game_raw: Dict[str, float],
     return out
 
 
+def _csv_scalar(text: str):
+    """Invert csv.DictWriter's stringification for prior-row reload: ints,
+    floats and bools come back typed; everything else stays a string."""
+    if text in ("True", "False"):
+        return text == "True"
+    for cast in (int, float):
+        try:
+            return cast(text)
+        except ValueError:
+            continue
+    return text
+
+
+def load_prior_rows(results_dir: str, skip_games: List[str]):
+    """Reload completed per_game.csv rows for games NOT in this run, so a
+    partial rerun (crash-resume, or topping up one game's budget) keeps the
+    other games' committed rows instead of overwriting them.  Returns
+    (rows, per_game_raw, baselines, failed) in run_sweep's working shapes;
+    rows with an error marker reload as rows + a `failed` entry — never into
+    the aggregate's score maps — so the rewritten aggregate keeps the
+    games_failed caveat the first run's flush() wrote (writer-emits-caveats
+    rule: dropping it on resume would un-declare a recorded failure)."""
+    import csv as _csv
+
+    path = os.path.join(results_dir, "per_game.csv")
+    rows, per_game, baselines, failed = [], {}, {}, []
+    if not os.path.exists(path):
+        return rows, per_game, baselines, failed
+    with open(path, newline="") as f:
+        for raw in _csv.DictReader(f):
+            game = raw.get("game")
+            if not game or game in skip_games:
+                continue
+            row = {k: _csv_scalar(v) for k, v in raw.items() if v != ""}
+            rows.append(row)
+            if "error" in row or row.get("score_mean") is None:
+                failed.append(game)
+            else:
+                per_game[game] = row["score_mean"]
+                baselines[game] = {"random": row.get("random_baseline"),
+                                   "scripted": row.get("scripted_baseline")}
+    return rows, per_game, baselines, failed
+
+
 def run_sweep(base_args: List[str], games: Optional[List[str]] = None,
               results_dir: str = "results/jaxsuite",
               baseline_episodes: int = 64,
               per_game_args: Optional[Dict[str, List[str]]] = None,
-              note: Optional[str] = None) -> Dict[str, object]:
+              note: Optional[str] = None,
+              resume_rows: bool = False) -> Dict[str, object]:
     """Train+eval each jax game via the training CLI (mirror of
     atari57.run_sweep), then aggregate against measured baselines.
 
@@ -317,7 +362,10 @@ def run_sweep(base_args: List[str], games: Optional[List[str]] = None,
     after EVERY game, so an interrupted sweep keeps its completed rows.
     ``note`` rides into aggregate.json verbatim (ADVICE r4: caveats must be
     emitted by the writer, not hand-patched into the artifact, or a rerun
-    silently drops them); per-game frame budgets are emitted the same way."""
+    silently drops them); per-game frame budgets are emitted the same way.
+    ``resume_rows`` seeds from the existing per_game.csv (games being rerun
+    excluded), so restarting a killed sweep with only its unfinished games
+    cannot overwrite the finished ones."""
     from rainbow_iqn_apex_tpu.atari57 import train_one_game, write_results_csv
 
     games = games or JAXSUITE
@@ -325,6 +373,9 @@ def run_sweep(base_args: List[str], games: Optional[List[str]] = None,
     baselines: Dict[str, Dict] = {}
     rows = []
     failed = []
+    if resume_rows:
+        rows, per_game, baselines, failed = load_prior_rows(results_dir,
+                                                            games)
 
     def flush():
         write_results_csv(os.path.join(results_dir, "per_game.csv"), rows)

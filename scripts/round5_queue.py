@@ -106,18 +106,25 @@ def launch(argv, logfile: str):
 def main() -> None:
     ap = argparse.ArgumentParser()
     ap.add_argument("--adopt-pid", type=int, default=None)
+    ap.add_argument("--phase1-games", nargs="+",
+                    default=["breakout", "asterix"],
+                    help="subset restart: rerun only these phase-1 games; "
+                         "--resume-rows keeps the other games' finished rows")
+    ap.add_argument("--skip-phase1", action="store_true")
     args = ap.parse_args()
     py = sys.executable
 
-    log("phase 1: breakout+asterix 64k sweep")
-    if args.adopt_pid is not None:
+    if args.skip_phase1:
+        log("phase 1 skipped by flag")
+    elif args.adopt_pid is not None:
         log(f"adopting running sweep pid {args.adopt_pid}")
         wait_and_commit(args.adopt_pid, "results/jaxsuite_64k",
                         "jaxsuite 64k rerun")
     else:
+        log(f"phase 1: 64k sweep over {' '.join(args.phase1_games)}")
         p = launch(
-            [py, "scripts/run_jaxsuite.py", "--games", "breakout", "asterix",
-             "--results-dir", "results/jaxsuite_64k",
+            [py, "scripts/run_jaxsuite.py", "--games", *args.phase1_games,
+             "--resume-rows", "--results-dir", "results/jaxsuite_64k",
              "--note",
              "breakout+asterix floor rerun at 65536 frames/game on the "
              "1-core CPU sandbox (VERDICT r4 item 2); the 5-game 16k sweep "

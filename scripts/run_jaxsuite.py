@@ -43,6 +43,11 @@ def main() -> int:
     ap.add_argument("--note", default=None,
                     help="free-text caveat emitted into aggregate.json by the "
                          "writer itself (survives reruns)")
+    ap.add_argument("--resume-rows", action="store_true",
+                    help="score sweep: seed per_game.csv/aggregate.json from "
+                         "the existing rows of games NOT in --games, so "
+                         "rerunning a killed sweep's unfinished games keeps "
+                         "the finished games' committed rows")
     ap.add_argument("--per-game-t-max", nargs="*", default=[],
                     metavar="GAME=FRAMES",
                     help="per-game --t-max override, e.g. breakout=65536 "
@@ -77,7 +82,8 @@ def main() -> int:
     agg = run_sweep(passthrough, games=args.games,
                     results_dir=args.results_dir,
                     baseline_episodes=args.baseline_episodes,
-                    per_game_args=per_game_args, note=args.note)
+                    per_game_args=per_game_args, note=args.note,
+                    resume_rows=args.resume_rows)
     print(json.dumps(agg))
     return 0
 

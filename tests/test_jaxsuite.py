@@ -185,6 +185,74 @@ def test_run_sweep_emits_note_and_frame_budgets(tmp_path, monkeypatch):
     assert agg["train_frames_per_game"] == {"catch": 100, "freeway": 200}
 
 
+def test_run_sweep_resume_rows_keeps_other_games(tmp_path, monkeypatch):
+    """Restarting a killed sweep with only its unfinished games must keep
+    the finished games' rows (round 5: the box died mid-sweep with breakout
+    committed and asterix half-trained; a plain rerun would have overwritten
+    breakout's row with an asterix-only csv)."""
+    import rainbow_iqn_apex_tpu.atari57 as atari57
+    from rainbow_iqn_apex_tpu.jaxsuite import load_prior_rows, run_sweep
+
+    def fake_train(env_id, run_id, base_args):
+        return {"frames": 100, "eval_score_mean": 1.0, "eval_episodes": 2}
+
+    monkeypatch.setattr(atari57, "train_one_game", fake_train)
+    monkeypatch.setattr(
+        "rainbow_iqn_apex_tpu.jaxsuite.measure_baselines",
+        lambda name, episodes=64, seed=0: {"random": -0.8, "scripted": 1.0},
+    )
+    run_sweep(["--t-max", "64"], games=["catch"], results_dir=str(tmp_path),
+              note="first run")
+
+    # rerun freeway only, with a different score, resuming catch's row
+    def fake_train2(env_id, run_id, base_args):
+        return {"frames": 200, "eval_score_mean": 0.1, "eval_episodes": 2}
+
+    monkeypatch.setattr(atari57, "train_one_game", fake_train2)
+    agg = run_sweep(["--t-max", "64"], games=["freeway"],
+                    results_dir=str(tmp_path), note="resumed run",
+                    resume_rows=True)
+    assert agg["games"] == 2 and agg["games_normalized"] == 2
+    assert agg["per_game_normalized"]["catch"] == 1.0
+    assert agg["per_game_normalized"]["freeway"] == 0.5
+    # both games' frame budgets survive, typed (csv reload returns ints)
+    assert agg["train_frames_per_game"] == {"catch": 100, "freeway": 200}
+    assert agg["note"] == "resumed run"
+    csv = (tmp_path / "per_game.csv").read_text()
+    assert "catch" in csv and "freeway" in csv
+
+    # reloading with the game in skip drops it (a rerun of the same game
+    # must not duplicate its row)
+    rows, pg, bl, failed = load_prior_rows(str(tmp_path), ["catch",
+                                                           "freeway"])
+    assert rows == [] and pg == {} and bl == {} and failed == []
+    rows, pg, _, _ = load_prior_rows(str(tmp_path), [])
+    assert {r["game"] for r in rows} == {"catch", "freeway"}
+    assert rows[0]["score_mean"] == 1.0  # typed float, not "1.0"
+
+    # a prior run's error row must survive resume as a FAILED game: its row
+    # stays in the csv and the rewritten aggregate keeps the games_failed
+    # caveat, while the score maps never see it
+    def fake_train_err(env_id, run_id, base_args):
+        return {}  # killed run -> salvage attempt
+
+    def no_checkpoint(*a, **k):
+        raise FileNotFoundError("no checkpoint")
+
+    monkeypatch.setattr(atari57, "train_one_game", fake_train_err)
+    monkeypatch.setattr("rainbow_iqn_apex_tpu.jaxsuite.eval_checkpoint_fused",
+                        no_checkpoint)
+    run_sweep([], games=["invaders"], results_dir=str(tmp_path),
+              resume_rows=True)
+    rows, pg, bl, failed = load_prior_rows(str(tmp_path), [])
+    assert failed == ["invaders"] and "invaders" not in pg
+    monkeypatch.setattr(atari57, "train_one_game", fake_train2)
+    agg = run_sweep([], games=["freeway"], results_dir=str(tmp_path),
+                    resume_rows=True)
+    assert agg["games_failed"] == 1 and agg["failed_games"] == ["invaders"]
+    assert agg["games"] == 2  # catch + freeway still scored
+
+
 def test_run_generalization_emits_note(tmp_path, monkeypatch):
     import rainbow_iqn_apex_tpu.atari57 as atari57
     from rainbow_iqn_apex_tpu.jaxsuite import run_generalization
