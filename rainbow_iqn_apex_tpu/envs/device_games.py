@@ -121,12 +121,19 @@ class CatchGame(DeviceGame):
         move = jnp.array([0, -1, 1], jnp.int32)[action]
         paddle = jnp.clip(s.paddle + move, 0, G - 1)
         ball_r = s.ball_r + 1
+        ball_c = self._ball_col(s, ball_r)
         terminal = ball_r == G - 1
         reward = jnp.where(
-            terminal, jnp.where(paddle == s.ball_c, 1.0, -1.0), 0.0
+            terminal, jnp.where(paddle == ball_c, 1.0, -1.0), 0.0
         ).astype(jnp.float32)
-        ns = CatchState(ball_r, s.ball_c, paddle, s.t + 1)
+        ns = s._replace(ball_r=ball_r, ball_c=ball_c, paddle=paddle,
+                        t=s.t + 1)
         return ns, reward, terminal, jnp.bool_(False)
+
+    def _ball_col(self, s, ball_r):
+        """Ball column on entering row `ball_r` — the dynamics hook the
+        seeded-level variant overrides (base ball falls straight down)."""
+        return s.ball_c
 
     def render(self, s: CatchState) -> jnp.ndarray:
         grid = jnp.zeros((G, G), jnp.uint8)
@@ -755,7 +762,69 @@ class InvadersVarGame(InvadersGame):
         return s.fleet
 
 
+class CatchVarState(NamedTuple):
+    ball_r: jnp.ndarray
+    ball_c: jnp.ndarray
+    paddle: jnp.ndarray
+    drift: jnp.ndarray  # [G] i32 in {-1,0,+1} — this level's per-row wind
+    t: jnp.ndarray
+
+
+class CatchVarGame(CatchGame):
+    """Level-randomized catch: the level id fixes a per-row lateral drift
+    pattern ('wind' in {-1,0,+1} per row) the ball rides on its way down;
+    ball entry column remains per-episode randomness.  Completes 5/5
+    variant coverage of the jaxsuite (the Procgen-class stand-in,
+    BASELINE.md config 5).
+
+    Design note — this is the suite's NULL-CALIBRATION probe: with the
+    terminal row wind-free (see _init_level), a level-blind greedy tracker
+    measures 1.0 on BOTH pools (wall clipping lets the 1-cell/step paddle
+    catch any persistent wind), so a competent agent's train/held-out gap
+    should be ~0 BY CONSTRUCTION.  A measured nonzero gap on catch@var
+    flags harness or pool-variance artifacts, not memorization — the
+    memorization-sensitive probes are the other four variants, whose
+    layouts/dynamics gate score more deeply.  (With terminal wind left in,
+    tracking measured 0.06 train / -0.63 held-out vs random -0.69: the
+    last-row shift is a coin-flip for any pixel policy since it lands
+    after the paddle's final move, which would make the off_random gate
+    unclearable by fair play — hence wind-free.)"""
+
+    def __init__(self, pool_base: int, pool_size: int):
+        self.pool_base = pool_base
+        self.pool_size = pool_size
+
+    def init(self, key) -> CatchVarState:
+        kl, kc = jax.random.split(key)
+        return self._init_level(_draw_level(self.pool_base, self.pool_size,
+                                            kl), kc)
+
+    def init_at_level(self, level, key) -> CatchVarState:
+        """Pinned-level init: the wind from `level` (traced i32 welcome),
+        the ball entry column from `key`."""
+        return self._init_level(level, key)
+
+    def _init_level(self, level, kc) -> CatchVarState:
+        drift = jax.random.randint(_level_fold(level), (G,), -1, 2,
+                                   jnp.int32)
+        # no wind on the terminal row: a last-step shift lands after the
+        # paddle's final move and is unobservable-before-commit, so it
+        # would be a coin-flip for ANY pixel policy, memorizer or not
+        drift = drift.at[G - 1].set(0)
+        return CatchVarState(
+            ball_r=jnp.int32(0),
+            ball_c=jax.random.randint(kc, (), 0, G, jnp.int32),
+            paddle=jnp.int32(G // 2),
+            drift=drift,
+            t=jnp.int32(0),
+        )
+
+    def _ball_col(self, s, ball_r):
+        return jnp.clip(s.ball_c + s.drift[ball_r], 0, G - 1)
+
+
 VARIANT_GAMES = {
+    "catch": CatchVarGame,
     "breakout": BreakoutVarGame,
     "freeway": FreewayVarGame,
     "asterix": AsterixVarGame,

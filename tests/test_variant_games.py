@@ -11,6 +11,7 @@ from rainbow_iqn_apex_tpu.envs.device_games import (
     N_TRAIN_LEVELS,
     AsterixVarGame,
     BreakoutVarGame,
+    CatchVarGame,
     FreewayVarGame,
     InvadersVarGame,
     make_device_game,
@@ -26,8 +27,9 @@ def test_factory_parses_variants():
     assert t.pool_base == N_TRAIN_LEVELS
     assert isinstance(make_device_game("asterix@var"), AsterixVarGame)
     assert isinstance(make_device_game("invaders@var-test"), InvadersVarGame)
+    assert isinstance(make_device_game("catch@var"), CatchVarGame)
     with pytest.raises(ValueError, match="no seeded-variant"):
-        make_device_game("catch@var")
+        make_device_game("pong@var")
     with pytest.raises(ValueError, match="unknown variant"):
         make_device_game("breakout@nope")
 
@@ -71,6 +73,35 @@ def test_breakout_var_respawns_its_own_wall():
     s2, reward, term, _ = game.step(s, jnp.int32(0), jax.random.PRNGKey(0))
     assert float(reward) == 1.0
     assert np.array_equal(np.asarray(s2.bricks), wall)
+
+
+def test_catch_var_ball_rides_level_wind():
+    """The variant ball drifts by this level's per-row wind (clipped at the
+    walls); the base game's straight drop is the all-zero wind."""
+    game = make_device_game("catch@var")
+    s = game.init(jax.random.PRNGKey(4))
+    drift = np.asarray(s.drift)
+    assert drift.shape == (10,) and set(np.unique(drift)) <= {-1, 0, 1}
+    s2, _, _, _ = game.step(s, jnp.int32(0), jax.random.PRNGKey(0))
+    want = np.clip(int(s.ball_c) + drift[int(s2.ball_r)], 0, 9)
+    assert int(s2.ball_c) == want
+    # drift is a LEVEL property: same episode key -> same wind
+    assert np.array_equal(
+        np.asarray(game.init(jax.random.PRNGKey(4)).drift), drift
+    )
+
+
+def test_catch_var_pools_disjoint():
+    train = make_device_game("catch@var")
+    test = make_device_game("catch@var-test")
+
+    def winds(g, n=64):
+        return {np.asarray(g.init(jax.random.PRNGKey(i)).drift).tobytes()
+                for i in range(n)}
+
+    tr, te = winds(train), winds(test)
+    assert len(tr) > 4
+    assert not (tr & te)
 
 
 def test_freeway_var_uses_level_dynamics():
@@ -210,7 +241,7 @@ def test_variant_games_run_in_fused_rollout():
     rets = rollout_returns("freeway@var-test", _p_random, episodes=8, seed=0,
                            max_ticks=64)
     assert np.isfinite(rets).all()
-    for gid in ("asterix@var", "invaders@var-test"):
+    for gid in ("asterix@var", "invaders@var-test", "catch@var"):
         rets = rollout_returns(gid, _p_random, episodes=8, seed=0,
                                max_ticks=64)
         assert rets.shape == (8,)
@@ -224,6 +255,7 @@ def test_init_at_level_pins_layout_and_spans_pool():
     committed rows keep their meaning — and (d) accept traced levels under
     vmap+jit (the per-level eval's access pattern)."""
     layout_fields = {
+        "catch@var": ("drift",),
         "breakout@var": ("wall",),
         "freeway@var": ("speeds", "dirs"),
         "asterix@var": ("speeds", "lane_dir", "gold_p"),
