@@ -65,7 +65,6 @@ class Config:
     num_tau_prime_samples: int = 64  # N' : target-net tau draws in the loss
     num_quantile_samples: int = 32  # K  : tau draws used for acting
     kappa: float = 1.0  # Huber threshold
-    use_pallas_loss: bool = False  # fused Pallas quantile-Huber kernel
 
     # ---- agent / optimisation (SURVEY §2 row 4) -----------------------------------
     gamma: float = 0.99

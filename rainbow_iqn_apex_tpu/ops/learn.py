@@ -142,17 +142,11 @@ def loss_and_priorities(
     )  # [B, N, A]
     z_online = jnp.take_along_axis(on_q, batch.action[:, None, None], axis=-1)[..., 0]
 
-    if cfg.use_pallas_loss:
-        from rainbow_iqn_apex_tpu.ops.pallas.quantile_huber import (
-            pallas_quantile_huber,
-        )
-
-        interpret = jax.default_backend() not in ("tpu", "axon")
-        per_sample, td_abs = pallas_quantile_huber(
-            z_online, taus, td_target, cfg.kappa, interpret
-        )
-    else:
-        per_sample, td_abs = quantile_huber_loss(z_online, taus, td_target, cfg.kappa)
+    # Measured on-chip 2026-07-31 (results/relay_watch/pallas.jsonl): the
+    # hand-written Pallas quantile-Huber kernel failed remote_compile
+    # (SIGABRT) at every block size while this jnp path ran 1657 learn
+    # steps/s device-resident — XLA's own fusion wins, kernel deleted.
+    per_sample, td_abs = quantile_huber_loss(z_online, taus, td_target, cfg.kappa)
     loss = jnp.mean(batch.weight * per_sample)
     aux = {
         "td_abs": td_abs,

@@ -3,7 +3,7 @@
 
 Rounds 2-4 lost every TPU window to manual process: the builder probed the
 relay by hand (hourly), and the staged capture chain (tpu_session -> bench ->
-bench_scaling -> bench_pallas -> on-chip jaxsuite) required a human to notice
+bench_scaling -> bench_learn_micro -> on-chip jaxsuite) required a human to notice
 the relay was up.  This watcher replaces the human:
 
   * probe loop: a child process attempts axon backend init.  Against the
@@ -195,8 +195,8 @@ def capture_chain() -> None:
              [py, "scripts/bench_scaling.py", "45", "2,2x2"],
              "scaling.jsonl",
              {"SCALE_LANES": "4", "SCALE_SEG": "64", "SCALE_SCAN": "4"}),
-            ("bench_pallas", [py, "scripts/bench_pallas.py"], "pallas.jsonl",
-             {"BENCH_ITERS": "2"}),
+            ("bench_learn_micro", [py, "scripts/bench_learn_micro.py"],
+             "learn_micro.jsonl", {"BENCH_ITERS": "2"}),
             ("jaxsuite_tpu",
              [py, "scripts/run_jaxsuite.py", "--games", "catch",
               "--results-dir", jaxsuite_dir, "--baseline-episodes", "8",
@@ -212,8 +212,8 @@ def capture_chain() -> None:
              [py, "scripts/bench_scaling.py", "420",
               "32,64,128,256,32x2,32x4"],
              "scaling.jsonl", None),
-            ("bench_pallas", [py, "scripts/bench_pallas.py"], "pallas.jsonl",
-             {"BENCH_ITERS": "50"}),
+            ("bench_learn_micro", [py, "scripts/bench_learn_micro.py"],
+             "learn_micro.jsonl", {"BENCH_ITERS": "50"}),
             # on-chip score sweep at the budget the CPU box can't afford: at
             # the round-2 device rate (~1890 learn-steps/s) 64k frames/game
             # is minutes

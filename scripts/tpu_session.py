@@ -14,8 +14,9 @@ the round-1 Pallas kernel) can only cost the phases after it:
   1. bench      — end-to-end learn steps/s on the flat-transfer staging path
   2. transfer   — flat vs shaped uint8 put latency (the re-tiling microscopy)
   3. trace      — jax.profiler device trace of ~30 learn steps -> /tmp
-  4. pallas     — jnp vs Pallas loss learn-step sweep over BLOCK_B (riskiest:
-                  first-ever on-chip compile of the reworked kernel, LAST)
+  4. learn_micro — device-resident jnp learn-step microbench (the Pallas
+                   comparison this phase once ran was resolved on-chip
+                   2026-07-31: kernel failed remote_compile, deleted)
 
 Every phase emits one JSON line; zero-iteration loops emit a `skipped`
 marker, never a fake rate.
@@ -30,7 +31,7 @@ import time
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-from bench_pallas import measure_learn  # noqa: E402  (sibling script)
+from bench_learn_micro import measure_learn  # noqa: E402  (sibling script)
 
 BUDGET = float(sys.argv[1]) if len(sys.argv) > 1 else 420.0
 T0 = time.monotonic()
@@ -302,23 +303,13 @@ def main() -> None:
         except Exception as e:
             emit(phase="fused_r2d2_anakin", error=repr(e)[:200])
 
-    # ---- phase 4: pallas sweep (riskiest compile, deliberately last) -----
+    # ---- phase 4: device-resident learn-step microbench ------------------
     if left() > 60:
         try:
-            emit(phase="pallas", **measure_learn(False, 8, 100,
-                                                 stop=lambda: left() < 30))
+            emit(phase="learn_micro", **measure_learn(100,
+                                                      stop=lambda: left() < 30))
         except Exception as e:
-            emit(phase="pallas", impl="jnp", error=repr(e)[:200])
-        for bb in (8, 16, 32):
-            if left() < 60:
-                emit(phase="pallas", block_b=bb, skipped="budget exhausted")
-                continue
-            try:
-                emit(phase="pallas", **measure_learn(True, bb, 100,
-                                                     stop=lambda: left() < 30))
-            except Exception as e:
-                emit(phase="pallas", impl="pallas", block_b=bb,
-                     error=repr(e)[:200])
+            emit(phase="learn_micro", impl="jnp", error=repr(e)[:200])
 
     emit(phase="done", elapsed_s=round(time.monotonic() - T0, 1))
 
