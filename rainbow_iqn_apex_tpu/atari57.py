@@ -179,6 +179,67 @@ def write_results_csv(path: str, rows: List[Dict]) -> None:
         w.writerows(rows)
 
 
+# Env vars stashed/restored by the sweep's parent-CPU discipline (see
+# sanitize_sweep_parent_env).  Everything the axon relay hook or an explicit
+# device pin rides on.
+_DEVICE_ENV_VARS = (
+    "JAX_PLATFORMS",
+    "PALLAS_AXON_POOL_IPS",
+    "PALLAS_AXON_REMOTE_COMPILE",
+    "PALLAS_AXON_TPU_GEN",
+    "AXON_LOOPBACK_RELAY",
+)
+_DEVICE_ENV_STASH = "JAXSUITE_DEVICE_ENV"
+_SANITIZED_FLAG = "JAXSUITE_PARENT_SANITIZED"
+
+
+def sanitize_sweep_parent_env() -> None:
+    """Re-exec the sweep parent pinned to CPU, stashing the device env.
+
+    Against the single-claim TPU relay, a device backend initialized in the
+    long-lived sweep parent holds the claim for the parent's whole life and
+    starves every trainer child (observed 2026-07-31, first on-chip sweep
+    attempt).  Call this BEFORE anything imports jax.  No-op when there is
+    no device signal (plain CPU box) or after the re-exec.
+    """
+    import sys
+
+    if os.environ.get(_SANITIZED_FLAG) == "1":
+        return
+    deviceish = bool(os.environ.get("PALLAS_AXON_POOL_IPS")) or \
+        os.environ.get("JAX_PLATFORMS", "") not in ("", "cpu")
+    if not deviceish:
+        return
+    stash = {k: os.environ[k] for k in _DEVICE_ENV_VARS if k in os.environ}
+    if "JAX_PLATFORMS" not in stash and os.environ.get("PALLAS_AXON_POOL_IPS"):
+        # pin children to the relay's platform: an unpinned child whose
+        # backend init hits a relay blip SILENTLY falls back to CPU and
+        # crawls for hours (observed 2026-07-31); a pinned child fails fast
+        # with UNAVAILABLE and the sweep records an honest error/salvage row
+        stash["JAX_PLATFORMS"] = "axon"
+    env = dict(os.environ)
+    env[_DEVICE_ENV_STASH] = json.dumps(stash)
+    env[_SANITIZED_FLAG] = "1"
+    env["JAX_PLATFORMS"] = "cpu"
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    os.execve(sys.executable, [sys.executable, *sys.argv], env)
+
+
+def child_device_env() -> Dict[str, str]:
+    """Env for a trainer child: the parent's env with the stashed device
+    vars restored (so children claim the device one at a time) — or the
+    plain env when no stash exists."""
+    env = dict(os.environ)
+    stash = env.pop(_DEVICE_ENV_STASH, None)
+    env.pop(_SANITIZED_FLAG, None)
+    if stash:
+        restored = json.loads(stash)
+        for k in _DEVICE_ENV_VARS:
+            env.pop(k, None)
+        env.update(restored)
+    return env
+
+
 def train_one_game(env_id: str, run_id: str, base_args: List[str]) -> Dict:
     """Train+eval one game via the training CLI (cwd-independent); returns
     the CLI's final JSON summary, or {} if none was printed.  Shared by this
@@ -194,7 +255,8 @@ def train_one_game(env_id: str, run_id: str, base_args: List[str]) -> Dict:
         sys.executable, train_cli,
         "--env-id", env_id, "--run-id", run_id, *base_args,
     ]
-    out = subprocess.run(cmd, capture_output=True, text=True)
+    out = subprocess.run(cmd, capture_output=True, text=True,
+                         env=child_device_env())
     if out.returncode != 0:
         tail = "\n".join(out.stderr.strip().splitlines()[-10:])
         print(

@@ -158,11 +158,13 @@ def run_phase(name: str, argv, out_name: str, extra_env=None,
     return p.returncode
 
 
-def capture_chain() -> None:
-    """The staged live-window chain, safest-first (docs/STATUS.md), each
-    phase committed before the next starts.  Under --dry-run every phase
-    gets a tiny budget and the sweep shrinks to one short catch run, so the
-    whole chain rehearses on CPU in minutes."""
+def capture_chain() -> bool:
+    """The staged live-window chain, headline-first, each phase committed
+    before the next starts.  Returns True when EVERY phase has completed
+    (rc=0, this run or a previous one via chain_state.json) — main() breaks
+    the probe loop on True and re-arms to resume the chain otherwise.
+    Under --dry-run every phase gets a tiny budget and the sweep shrinks to
+    one short catch run, so the whole chain rehearses on CPU in minutes."""
     py = sys.executable
     jaxsuite_dir = (os.path.join(OUTDIR, "jaxsuite") if DRY_RUN
                     else os.path.join("results", "jaxsuite_tpu"))
@@ -187,8 +189,6 @@ def capture_chain() -> None:
         # tiny budgets / one short game: exercises every argv, redirect and
         # log path the real window will use, in minutes on CPU
         phases = [
-            ("tpu_session", [py, "scripts/tpu_session.py", "45"],
-             "tpu_session.jsonl", None),
             ("bench", [py, "bench.py"], "bench_live.jsonl",
              {"BENCH_WATCHDOG_SECS": "120"}),
             ("bench_scaling",
@@ -202,11 +202,16 @@ def capture_chain() -> None:
               "--results-dir", jaxsuite_dir, "--baseline-episodes", "8",
               "--per-game-t-max", "catch=768", "--", *shared],
              "jaxsuite_tpu.jsonl", None),
+            ("tpu_session", [py, "scripts/tpu_session.py", "45"],
+             "tpu_session.jsonl", None),
         ]
     else:
+        # HEADLINE-FIRST: the 2026-07-31 window taught the old order's cost —
+        # tpu_session's 420s budget ran 3300s wall (relay compiles are slow)
+        # and ate the whole ~54-min window before a single scoreboard row.
+        # The driver-scored bench row leads, diagnostics (tpu_session) run
+        # LAST, and a mid-window death costs only the least valuable tail.
         phases = [
-            ("tpu_session", [py, "scripts/tpu_session.py", "420"],
-             "tpu_session.jsonl", None),
             ("bench", [py, "bench.py"], "bench_live.jsonl", None),
             ("bench_scaling",
              [py, "scripts/bench_scaling.py", "420",
@@ -226,9 +231,38 @@ def capture_chain() -> None:
               "freeway=65536", "asterix=65536", "invaders=65536",
               "--", *shared],
              "jaxsuite_tpu.jsonl", None),
+            ("tpu_session", [py, "scripts/tpu_session.py", "420"],
+             "tpu_session.jsonl", None),
         ]
+    state_path = os.path.join(OUTDIR, "chain_state.json")
+    done_phases: set = set()
+    if not DRY_RUN and os.path.exists(state_path):
+        try:
+            with open(state_path) as f:
+                done_phases = set(json.load(f).get("completed", []))
+        except (ValueError, OSError):
+            # a truncated state file (crash mid-write) must not kill the
+            # watcher at the exact moment it matters — start the chain over
+            done_phases = set()
+        if done_phases:
+            log_event(event="chain_resume", skipping=sorted(done_phases))
+
+    def save_state() -> None:
+        tmp = state_path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump({"completed": sorted(done_phases)}, f)
+        os.replace(tmp, state_path)  # atomic: never a half-written state
+
     for name, argv, out_name, extra_env in phases:
-        run_phase(name, argv, out_name, extra_env)
+        if name in done_phases:
+            continue
+        rc = run_phase(name, argv, out_name, extra_env)
+        if rc == 0:
+            done_phases.add(name)
+            if not DRY_RUN:
+                save_state()
+                git_commit([state_path], f"relay_watch: chain state — "
+                                         f"{name} complete")
     # the sweep's own artifacts live outside OUTDIR — commit the benchmark
     # files and metrics only, never ckpt/ binaries (results hygiene)
     sweep_abs = os.path.join(REPO, jaxsuite_dir)
@@ -239,6 +273,13 @@ def capture_chain() -> None:
     arts += glob.glob(os.path.join(sweep_abs, "runs", "*", "metrics.jsonl"))
     if arts:
         git_commit(arts, "relay_watch: on-chip jaxsuite sweep artifacts")
+    complete = all(name in done_phases for name, *_ in phases)
+    if complete and not DRY_RUN and os.path.exists(state_path):
+        # a finished chain's state must not make a FUTURE watcher run skip
+        # every phase and report a vacuous "complete" capture
+        os.remove(state_path)
+        git_commit([state_path], "relay_watch: chain complete — state cleared")
+    return complete
 
 
 def main() -> None:
@@ -263,10 +304,14 @@ def main() -> None:
                           f"({res['elapsed_s']:.0f}s, rc={res['rc']})")
         if res["live"]:
             log_event(event="chain_start", probe_n=n)
-            capture_chain()
-            log_event(event="chain_done", probe_n=n)
-            git_commit([LOG], "relay_watch: capture chain complete")
-            break  # one full capture is the round's goal; builder takes over
+            complete = capture_chain()
+            log_event(event="chain_done", probe_n=n, complete=complete)
+            if complete:
+                git_commit([LOG], "relay_watch: capture chain complete")
+                break  # one full capture is the round's goal
+            # a phase failed (relay died mid-window): re-arm and resume the
+            # chain from its first incomplete phase on the next live probe
+            git_commit([LOG], "relay_watch: chain interrupted — re-arming")
         for _ in range(SLEEP_BETWEEN_PROBES // 10):
             if os.path.exists(STOP):
                 break

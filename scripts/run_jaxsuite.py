@@ -22,6 +22,18 @@ import sys
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
+from rainbow_iqn_apex_tpu.atari57 import sanitize_sweep_parent_env  # noqa: E402
+
+# MUST run before anything imports jax: against the single-claim TPU relay
+# the sweep PARENT may never initialize the device backend — a parent-held
+# claim starves every trainer child forever (observed live 2026-07-31: the
+# first on-chip sweep attempt wedged in backend init before its first child
+# spawned).  The parent re-execs itself pinned to CPU and stashes the device
+# env, which train_one_game restores for each child — children train+eval on
+# device one at a time, each releasing the claim at exit; the parent does
+# baselines/salvage math on CPU.
+sanitize_sweep_parent_env()
+
 from rainbow_iqn_apex_tpu.jaxsuite import JAXSUITE, run_sweep  # noqa: E402
 
 

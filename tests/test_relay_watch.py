@@ -1,0 +1,83 @@
+"""Capture-chain ordering + resume state (scripts/relay_watch.py).
+
+The 2026-07-31 live window measured the old order's cost: tpu_session's
+"420s" diagnostics ran 3300s wall and consumed the whole ~54-min window
+before any scoreboard row.  These tests pin the headline-first order and
+the chain_state.json resume contract (a phase that fails is retried on the
+next window; completed phases are never re-run).
+"""
+
+import importlib.util
+import json
+import os
+import sys
+
+import pytest
+
+
+@pytest.fixture()
+def watch(tmp_path, monkeypatch):
+    spec = importlib.util.spec_from_file_location(
+        "relay_watch_under_test",
+        os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+                     "scripts", "relay_watch.py"))
+    mod = importlib.util.module_from_spec(spec)
+    # keep the module import side-effect free for the test process
+    monkeypatch.setattr(sys, "argv", ["relay_watch.py"])
+    spec.loader.exec_module(mod)
+    monkeypatch.setattr(mod, "OUTDIR", str(tmp_path))
+    monkeypatch.setattr(mod, "DRY_RUN", False)
+    monkeypatch.setattr(mod, "git_commit", lambda paths, msg: True)
+    monkeypatch.setattr(mod, "log_event", lambda **row: None)
+    return mod
+
+
+def run_chain(mod, monkeypatch, rc_by_phase):
+    ran = []
+
+    def fake_run_phase(name, argv, out_name, extra_env=None, **kw):
+        ran.append(name)
+        return rc_by_phase.get(name, 0)
+
+    monkeypatch.setattr(mod, "run_phase", fake_run_phase)
+    complete = mod.capture_chain()
+    return ran, complete
+
+
+EXPECTED_ORDER = ["bench", "bench_scaling", "bench_learn_micro",
+                  "jaxsuite_tpu", "tpu_session"]
+
+
+def test_headline_first_order(watch, monkeypatch):
+    ran, complete = run_chain(watch, monkeypatch, {})
+    assert ran == EXPECTED_ORDER
+    assert complete
+
+
+def test_failed_phase_not_marked_complete(watch, monkeypatch, tmp_path):
+    ran, complete = run_chain(watch, monkeypatch, {"bench_scaling": 1})
+    assert not complete
+    state = json.loads((tmp_path / "chain_state.json").read_text())
+    assert "bench" in state["completed"]
+    assert "bench_scaling" not in state["completed"]
+    # later phases still ran — a dead phase must not strand the window
+    assert "jaxsuite_tpu" in ran
+
+
+def test_resume_skips_completed_phases_and_clears_state(watch, monkeypatch,
+                                                        tmp_path):
+    (tmp_path / "chain_state.json").write_text(json.dumps(
+        {"completed": ["bench", "bench_scaling", "bench_learn_micro"]}))
+    ran, complete = run_chain(watch, monkeypatch, {})
+    assert ran == ["jaxsuite_tpu", "tpu_session"]
+    assert complete
+    # a finished chain clears its state so a future watcher run can't skip
+    # every phase and claim a vacuous full capture
+    assert not (tmp_path / "chain_state.json").exists()
+
+
+def test_truncated_state_restarts_chain(watch, monkeypatch, tmp_path):
+    (tmp_path / "chain_state.json").write_text('{"completed": ["ben')  # torn
+    ran, complete = run_chain(watch, monkeypatch, {})
+    assert ran == EXPECTED_ORDER  # fell back to a fresh chain, no crash
+    assert complete

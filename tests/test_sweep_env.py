@@ -1,0 +1,91 @@
+"""Parent-CPU discipline for the sweep orchestrator (single-claim relay).
+
+The 2026-07-31 live window showed the failure concretely: the first on-chip
+jaxsuite attempt wedged in the PARENT's backend init before its first
+trainer child spawned.  The fix is a re-exec: the parent pins itself to CPU
+and stashes the device env; train_one_game restores it for each child so
+the device claim is only ever held by one short-lived trainer at a time.
+"""
+
+import json
+import os
+from unittest import mock
+
+from rainbow_iqn_apex_tpu.atari57 import (
+    _DEVICE_ENV_STASH,
+    _SANITIZED_FLAG,
+    child_device_env,
+    sanitize_sweep_parent_env,
+)
+
+
+def test_child_env_restores_stashed_device_vars(monkeypatch):
+    monkeypatch.setenv(_DEVICE_ENV_STASH, json.dumps(
+        {"PALLAS_AXON_POOL_IPS": "127.0.0.1", "JAX_PLATFORMS": "axon"}))
+    monkeypatch.setenv(_SANITIZED_FLAG, "1")
+    monkeypatch.setenv("JAX_PLATFORMS", "cpu")  # the parent's own pin
+    env = child_device_env()
+    assert env["PALLAS_AXON_POOL_IPS"] == "127.0.0.1"
+    assert env["JAX_PLATFORMS"] == "axon"
+    # the stash bookkeeping must not leak into the child
+    assert _DEVICE_ENV_STASH not in env
+    assert _SANITIZED_FLAG not in env
+
+
+def test_child_env_passthrough_without_stash(monkeypatch):
+    monkeypatch.delenv(_DEVICE_ENV_STASH, raising=False)
+    monkeypatch.setenv("JAX_PLATFORMS", "cpu")
+    env = child_device_env()
+    assert env["JAX_PLATFORMS"] == "cpu"  # untouched on plain CPU boxes
+
+
+def test_sanitize_noop_without_device_signal(monkeypatch):
+    monkeypatch.delenv("PALLAS_AXON_POOL_IPS", raising=False)
+    monkeypatch.setenv("JAX_PLATFORMS", "cpu")
+    monkeypatch.delenv(_SANITIZED_FLAG, raising=False)
+    with mock.patch.object(os, "execve") as ex:
+        sanitize_sweep_parent_env()
+    ex.assert_not_called()
+
+
+def test_sanitize_noop_after_reexec(monkeypatch):
+    monkeypatch.setenv("PALLAS_AXON_POOL_IPS", "127.0.0.1")
+    monkeypatch.setenv(_SANITIZED_FLAG, "1")
+    with mock.patch.object(os, "execve") as ex:
+        sanitize_sweep_parent_env()
+    ex.assert_not_called()
+
+
+def test_sanitize_pins_unpinned_relay_children(monkeypatch):
+    # relay hook present but no explicit JAX_PLATFORMS pin: the stash must
+    # add one so a child can't silently fall back to CPU on a relay blip
+    monkeypatch.setenv("PALLAS_AXON_POOL_IPS", "127.0.0.1")
+    monkeypatch.delenv("JAX_PLATFORMS", raising=False)
+    monkeypatch.delenv(_SANITIZED_FLAG, raising=False)
+    with mock.patch.object(os, "execve") as ex:
+        sanitize_sweep_parent_env()
+    env = ex.call_args[0][2]
+    assert json.loads(env[_DEVICE_ENV_STASH])["JAX_PLATFORMS"] == "axon"
+
+
+def test_sanitize_reexecs_with_cpu_pin_and_stash(monkeypatch):
+    monkeypatch.setenv("PALLAS_AXON_POOL_IPS", "127.0.0.1")
+    monkeypatch.setenv("JAX_PLATFORMS", "axon")
+    monkeypatch.delenv(_SANITIZED_FLAG, raising=False)
+    with mock.patch.object(os, "execve") as ex:
+        sanitize_sweep_parent_env()
+    assert ex.call_count == 1
+    _, argv, env = ex.call_args[0]
+    assert argv[0] == ex.call_args[0][0]  # re-execs the same interpreter
+    assert env["JAX_PLATFORMS"] == "cpu"
+    assert "PALLAS_AXON_POOL_IPS" not in env
+    assert env[_SANITIZED_FLAG] == "1"
+    stash = json.loads(env[_DEVICE_ENV_STASH])
+    assert stash["PALLAS_AXON_POOL_IPS"] == "127.0.0.1"
+    assert stash["JAX_PLATFORMS"] == "axon"
+    # round-trip: a child built from the re-exec'd env gets the device back
+    for k, v in env.items():
+        monkeypatch.setenv(k, v)
+    child = child_device_env()
+    assert child["PALLAS_AXON_POOL_IPS"] == "127.0.0.1"
+    assert child["JAX_PLATFORMS"] == "axon"
