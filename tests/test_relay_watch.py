@@ -45,7 +45,7 @@ def run_chain(mod, monkeypatch, rc_by_phase):
 
 
 EXPECTED_ORDER = ["bench", "bench_scaling", "bench_learn_micro",
-                  "jaxsuite_tpu", "tpu_session"]
+                  "jaxsuite_tpu", "jaxsuite_var_tpu", "tpu_session"]
 
 
 def test_headline_first_order(watch, monkeypatch):
@@ -69,7 +69,7 @@ def test_resume_skips_completed_phases_and_clears_state(watch, monkeypatch,
     (tmp_path / "chain_state.json").write_text(json.dumps(
         {"completed": ["bench", "bench_scaling", "bench_learn_micro"]}))
     ran, complete = run_chain(watch, monkeypatch, {})
-    assert ran == ["jaxsuite_tpu", "tpu_session"]
+    assert ran == ["jaxsuite_tpu", "jaxsuite_var_tpu", "tpu_session"]
     assert complete
     # a finished chain clears its state so a future watcher run can't skip
     # every phase and claim a vacuous full capture
