@@ -221,8 +221,17 @@ def capture_chain() -> bool:
         phases = [
             ("bench", [py, "bench.py"], "bench_live.jsonl", None),
             ("bench_scaling",
-             [py, "scripts/bench_scaling.py", "420",
-              "32,64,128,256,32x2,32x4"],
+             # 512/1024 added after the 2026-07-31 window measured MFU
+             # still RISING at 256 (0.46) — find where it rolls off.  The
+             # proven cheap points run FIRST so a budget exhaust costs only
+             # the new big-batch tail; budget raised 420->700s to fit the
+             # 9-point list (the 6-point sweep measured 306s on-chip).
+             # NOTE: this round's seeded chain_state.json marks this phase
+             # complete, deliberately — the next window's budget goes to
+             # the unscored jaxsuite phases; the new points run when the
+             # chain next starts fresh.
+             [py, "scripts/bench_scaling.py", "700",
+              "32,64,128,256,32x2,32x4,32x8,512,1024"],
              "scaling.jsonl", None),
             ("bench_learn_micro", [py, "scripts/bench_learn_micro.py"],
              "learn_micro.jsonl", {"BENCH_ITERS": "50"}),
