@@ -1,0 +1,21 @@
+# Convenience targets; CI drives the same commands directly.
+
+PY ?= python
+
+.PHONY: test test-fast serve-smoke serve-bench
+
+# tier-1: fast unit + integration tests on the virtual 8-device CPU mesh
+test-fast:
+	JAX_PLATFORMS=cpu $(PY) -m pytest tests/ -q -m "not slow"
+
+test:
+	JAX_PLATFORMS=cpu $(PY) -m pytest tests/ -q
+
+# policy-server smoke: start -> request -> shutdown, in-process transport,
+# no network listener — the `serve`-marked subset of tier-1
+serve-smoke:
+	JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_serving.py -q -m serve
+
+# load-generator bench (acceptance: occupancy > 4, zero sheds, swap mid-run)
+serve-bench:
+	JAX_PLATFORMS=cpu $(PY) scripts/bench_serve.py --clients 64 --requests 2000

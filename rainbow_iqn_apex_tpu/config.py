@@ -123,6 +123,15 @@ class Config:
     process_id: int = 0  # this host's index in [0, process_count)
     coordinator_address: str = ""  # host:port of process 0 (the Redis-host flag's heir)
 
+    # ---- serving (serving/; batched low-latency inference, docs/SERVING.md) ------
+    serve_batch_buckets: str = "8,16,32,64"  # padded batch sizes; one XLA
+    # executable per bucket (rounded up to actor-device multiples at runtime)
+    serve_deadline_ms: float = 5.0  # max coalescing wait past the oldest request
+    serve_queue_bound: int = 256  # bounded request queue; full = shed
+    serve_swap_poll_s: float = 2.0  # checkpoint-watcher poll interval (hot-swap)
+    serve_mode: str = "greedy"  # "greedy" (noise off) | "noisy" (eval_noisy-style)
+    serve_metrics_interval_s: float = 5.0  # seconds between 'serve' JSONL rows
+
     # ---- evaluation (SURVEY §2 row 9) ---------------------------------------------
     eval_episodes: int = 10
     eval_interval: int = 50_000  # learner steps between in-training evals; 0 = off

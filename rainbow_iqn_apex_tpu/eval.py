@@ -14,17 +14,25 @@ from typing import Any, Dict, Optional
 import numpy as np
 
 from rainbow_iqn_apex_tpu.agents.agent import Agent, FrameStacker
+from rainbow_iqn_apex_tpu.atari57 import ATARI57_BASELINES
 from rainbow_iqn_apex_tpu.config import Config
 from rainbow_iqn_apex_tpu.envs import make_env
 
 # Published per-game random/human baselines used for human-normalised scores
-# (Rainbow paper appendix convention).  Only games we can run offline are
-# seeded here; the Atari-57 table ships with the Atari bindings.
+# (Rainbow paper appendix convention), keyed by env_id.  Toy entries are
+# analytic; the Atari-57 rows come from the shared table in atari57.py (same
+# RECON caveat as there — recall-sourced, re-verify before publication).
 HUMAN_BASELINES: Dict[str, Dict[str, float]] = {
     # env_id: {"random": r, "human": h}
     "toy:catch": {"random": -0.8, "human": 1.0},  # analytic: random ~ 2/size - 1
     "toy:chain": {"random": 0.15, "human": 1.0},
 }
+HUMAN_BASELINES.update(
+    {
+        f"atari:{game}": {"random": random, "human": human}
+        for game, (random, human) in ATARI57_BASELINES.items()
+    }
+)
 
 
 def human_normalized(env_id: str, score: float) -> Optional[float]:

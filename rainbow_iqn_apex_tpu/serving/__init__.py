@@ -1,0 +1,40 @@
+"""Batched low-latency policy inference serving (Ape-X's actor fleet turned
+client-facing): dynamic micro-batching over bucketed XLA shapes, lane-sharded
+inference on the actor mesh, checkpoint-driven weight hot-swap, and a JSONL
+metrics surface.  See docs/SERVING.md."""
+
+from rainbow_iqn_apex_tpu.serving.batcher import (
+    MicroBatcher,
+    ServeFuture,
+    ServerClosed,
+    ServerOverloaded,
+    pick_bucket,
+)
+from rainbow_iqn_apex_tpu.serving.engine import (
+    InferenceEngine,
+    fit_buckets,
+    parse_buckets,
+)
+from rainbow_iqn_apex_tpu.serving.metrics import ServeMetrics
+from rainbow_iqn_apex_tpu.serving.server import PolicyServer
+from rainbow_iqn_apex_tpu.serving.swap import (
+    CheckpointWatcher,
+    params_template,
+    restore_params,
+)
+
+__all__ = [
+    "CheckpointWatcher",
+    "InferenceEngine",
+    "MicroBatcher",
+    "PolicyServer",
+    "ServeFuture",
+    "ServeMetrics",
+    "ServerClosed",
+    "ServerOverloaded",
+    "fit_buckets",
+    "params_template",
+    "parse_buckets",
+    "pick_bucket",
+    "restore_params",
+]

@@ -1,0 +1,189 @@
+"""Dynamic micro-batching: coalesce concurrent act() requests into one batch.
+
+Batched inference is where accelerator throughput lives (Stooke & Abbeel,
+arXiv:1803.02811): one [B, H, W, C] dispatch amortises the fixed
+per-dispatch cost over B requests.  The batcher's contract:
+
+- requests enter a BOUNDED queue (backpressure); a full queue sheds the
+  request immediately with ``ServerOverloaded`` instead of growing latency
+  without bound — the caller sees the overload and can back off;
+- the worker drains the queue into one batch per dispatch, waiting at most
+  ``deadline_s`` past the OLDEST queued request's arrival before dispatching
+  whatever it has (latency bound), and never waiting at all once ``max_batch``
+  requests are queued (throughput bound);
+- the batch is padded up to a small set of bucketed sizes chosen at
+  construction, so XLA compiles one executable per bucket and never again
+  (see engine.py — shape churn is the recompile trap).
+
+All of this is plain host threading: requests are tiny numpy arrays and the
+device call itself happens outside the lock.
+"""
+
+from __future__ import annotations
+
+import collections
+import threading
+import time
+from typing import List, Optional, Sequence, Tuple
+
+import numpy as np
+
+
+class ServerOverloaded(RuntimeError):
+    """Raised to the submitting client when the request queue is full."""
+
+
+class ServerClosed(RuntimeError):
+    """Raised to the submitting client when the server is shut down."""
+
+
+class ServeFuture:
+    """One in-flight request: the client blocks on ``result()``; the worker
+    fulfils with ``set_result``/``set_error``."""
+
+    __slots__ = ("obs", "t_enqueue", "_event", "_action", "_q", "_error")
+
+    def __init__(self, obs: np.ndarray):
+        self.obs = obs
+        self.t_enqueue = time.monotonic()
+        self._event = threading.Event()
+        self._action: Optional[int] = None
+        self._q: Optional[np.ndarray] = None
+        self._error: Optional[BaseException] = None
+
+    def set_result(self, action: int, q: np.ndarray) -> None:
+        self._action = action
+        self._q = q
+        self._event.set()
+
+    def set_error(self, err: BaseException) -> None:
+        self._error = err
+        self._event.set()
+
+    def done(self) -> bool:
+        return self._event.is_set()
+
+    def result(self, timeout: Optional[float] = None) -> Tuple[int, np.ndarray]:
+        """Block until fulfilled; returns (action, q_values [A])."""
+        if not self._event.wait(timeout):
+            raise TimeoutError("serve request not fulfilled in time")
+        if self._error is not None:
+            raise self._error
+        return self._action, self._q
+
+    @property
+    def latency_ms(self) -> float:
+        return (time.monotonic() - self.t_enqueue) * 1e3
+
+
+def pick_bucket(buckets: Sequence[int], n: int) -> int:
+    """Smallest bucket >= n (buckets sorted ascending; n <= max bucket)."""
+    for b in buckets:
+        if b >= n:
+            return b
+    raise ValueError(f"batch of {n} exceeds the largest bucket {buckets[-1]}")
+
+
+class MicroBatcher:
+    """Bounded request queue + deadline-driven coalescing.
+
+    The worker thread (server.py) calls ``take()`` in a loop; client threads
+    call ``submit()``.  ``close()`` wakes everyone; queued requests are still
+    drained by the worker (graceful shutdown), new submissions are refused.
+    """
+
+    def __init__(
+        self,
+        buckets: Sequence[int],
+        deadline_s: float,
+        queue_bound: int,
+        metrics=None,
+    ):
+        if not buckets:
+            raise ValueError("need at least one batch bucket")
+        self.buckets = sorted(set(int(b) for b in buckets))
+        if self.buckets[0] < 1:
+            raise ValueError(f"bucket sizes must be >= 1, got {self.buckets}")
+        self.max_batch = self.buckets[-1]
+        self.deadline_s = float(deadline_s)
+        self.queue_bound = int(queue_bound)
+        self.metrics = metrics
+        self._queue: collections.deque = collections.deque()
+        self._lock = threading.Lock()
+        self._nonempty = threading.Condition(self._lock)
+        self._closed = False
+
+    # ---------------------------------------------------------- client side
+    def submit(self, obs: np.ndarray) -> ServeFuture:
+        fut = ServeFuture(obs)
+        with self._lock:
+            if self._closed:
+                raise ServerClosed("server is shut down")
+            if len(self._queue) >= self.queue_bound:
+                if self.metrics is not None:
+                    self.metrics.record_shed()
+                raise ServerOverloaded(
+                    f"request queue full ({self.queue_bound}); shedding"
+                )
+            self._queue.append(fut)
+            self._nonempty.notify()
+        return fut
+
+    def depth(self) -> int:
+        with self._lock:
+            return len(self._queue)
+
+    # ---------------------------------------------------------- worker side
+    def take(
+        self, poll_s: float = 0.05, idle_timeout_s: Optional[float] = None
+    ) -> Optional[List[ServeFuture]]:
+        """Block for the next coalesced batch.
+
+        Returns up to ``max_batch`` requests: immediately when the queue
+        already holds a full batch, otherwise after the oldest queued request
+        has waited ``deadline_s``.  With ``idle_timeout_s`` set, an EMPTY
+        queue for that long returns ``[]`` — the worker's cue to emit a
+        liveness heartbeat and call again.  Returns None only when closed
+        AND drained — the worker's signal to exit.
+        """
+        t_start = time.monotonic()
+        with self._lock:
+            while True:
+                if self._queue:
+                    deadline = self._queue[0].t_enqueue + self.deadline_s
+                    if len(self._queue) >= self.max_batch or self._closed:
+                        break
+                    remaining = deadline - time.monotonic()
+                    if remaining <= 0:
+                        break
+                    self._nonempty.wait(timeout=min(remaining, poll_s))
+                else:
+                    if self._closed:
+                        return None
+                    if (idle_timeout_s is not None
+                            and time.monotonic() - t_start >= idle_timeout_s):
+                        return []
+                    self._nonempty.wait(timeout=poll_s)
+            n = min(len(self._queue), self.max_batch)
+            batch = [self._queue.popleft() for _ in range(n)]
+            depth_after = len(self._queue)
+        if self.metrics is not None:
+            self.metrics.record_batch(
+                n, pick_bucket(self.buckets, n), depth_after
+            )
+        return batch
+
+    def close(self) -> None:
+        """Refuse new submissions; the worker keeps draining what's queued."""
+        with self._lock:
+            self._closed = True
+            self._nonempty.notify_all()
+
+    def abort_pending(self, err: BaseException) -> int:
+        """Fail every queued request (hard shutdown path); returns count."""
+        with self._lock:
+            pending = list(self._queue)
+            self._queue.clear()
+        for fut in pending:
+            fut.set_error(err)
+        return len(pending)

@@ -1,0 +1,151 @@
+"""Sharded bucketed inference engine: the device half of the policy server.
+
+Reuses the actor-side machinery the Ape-X driver already trusts
+(parallel/mesh.py lane sharding + ops/learn.build_act_step): request batches
+are padded to one of a few fixed bucket sizes and dispatched through ONE
+jitted act step whose input sharding spreads rows over the actor mesh.
+
+Why buckets: jit compiles per input shape.  Serving traffic produces every
+batch size from 1..B, and letting each distinct size reach XLA means a
+compile storm exactly when the server is busiest.  Padding to a small fixed
+set keeps the executable count == bucket count forever (asserted in tests
+via the jit cache size), at the cost of a few wasted padded rows.
+
+Why an atomic params reference: hot-swap.  ``load_params`` device_puts the
+new tree OFF the worker thread and then swaps one Python reference — the
+in-flight dispatch keeps the old tree (XLA holds its own buffers), the next
+batch picks up the new one, and no request ever observes a half-written
+tree.  This is the serving-side mirror of the learner->actor publish in
+parallel/apex.py.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from rainbow_iqn_apex_tpu.config import Config
+from rainbow_iqn_apex_tpu.ops.learn import build_act_step
+from rainbow_iqn_apex_tpu.parallel.mesh import actor_mesh, batch_sharding, replicated
+from rainbow_iqn_apex_tpu.serving.batcher import pick_bucket
+
+
+def fit_buckets(buckets: Sequence[int], n_devices: int) -> List[int]:
+    """Round each requested bucket up to a lane-shardable size (a multiple of
+    the actor-mesh device count) and dedupe; order stays ascending."""
+    fitted = sorted({max(-(-int(b) // n_devices) * n_devices, n_devices)
+                     for b in buckets})
+    if not fitted:
+        raise ValueError("need at least one batch bucket")
+    return fitted
+
+
+class InferenceEngine:
+    """Bucketed, lane-sharded policy inference with atomically swappable
+    params.
+
+    mode: "greedy" acts without noisy-net noise (eval-time behaviour);
+    "noisy" keeps noise on (exploration-flavoured eval, cfg.eval_noisy
+    semantics).  Taus are sampled fresh per dispatch in both modes, as the
+    acting path always does.
+    """
+
+    def __init__(
+        self,
+        cfg: Config,
+        num_actions: int,
+        params: Any,
+        devices: Optional[Sequence[jax.Device]] = None,
+        buckets: Optional[Sequence[int]] = None,
+        mode: str = "greedy",
+    ):
+        if mode not in ("greedy", "noisy"):
+            raise ValueError(f"unknown serve mode {mode!r}")
+        self.cfg = cfg
+        self.num_actions = num_actions
+        self.mode = mode
+        devs = list(devices if devices is not None else jax.devices())
+        self.mesh = actor_mesh(devs)
+        self.n_devices = len(devs)
+        self._rep = replicated(self.mesh)
+        self._lane_sh = batch_sharding(self.mesh, "actor")
+        self.buckets = fit_buckets(
+            buckets if buckets is not None else parse_buckets(cfg.serve_batch_buckets),
+            self.n_devices,
+        )
+        self._act = jax.jit(
+            build_act_step(cfg, num_actions, use_noise=(mode == "noisy")),
+            in_shardings=(self._rep, self._lane_sh, self._rep),
+            out_shardings=(self._lane_sh, self._lane_sh),
+        )
+        self._key = jax.random.PRNGKey(cfg.seed + 4099)
+        self._key_lock = threading.Lock()
+        self._swap_lock = threading.Lock()
+        self._params = jax.device_put(params, self._rep)
+        self.params_version = 0
+
+    # ------------------------------------------------------------- hot swap
+    def load_params(self, params: Any) -> int:
+        """Stage ``params`` onto the actor mesh, then atomically swap the
+        reference the next dispatch reads.  Safe to call from any thread
+        while inference runs; returns the new params version.
+
+        Staging happens UNDER the swap lock: two concurrent swaps (watcher
+        poll + direct learner push) must land in call order, or a slow
+        stage of older params could overwrite a fresher swap."""
+        with self._swap_lock:
+            self._params = jax.device_put(params, self._rep)
+            self.params_version += 1
+            return self.params_version
+
+    @property
+    def params(self) -> Any:
+        return self._params
+
+    # ------------------------------------------------------------ inference
+    def _next_key(self):
+        with self._key_lock:
+            self._key, k = jax.random.split(self._key)
+        return k
+
+    def bucket_for(self, n: int) -> int:
+        return pick_bucket(self.buckets, n)
+
+    def infer(self, obs: np.ndarray) -> Tuple[np.ndarray, np.ndarray]:
+        """obs [n, H, W, C] uint8, n <= max bucket -> (actions [n], q [n, A]).
+
+        Pads to the smallest bucket (repeating row 0 — real pixels keep the
+        padded rows' compute on the same numeric path as live traffic) and
+        slices the padding back off on the host.
+        """
+        n = obs.shape[0]
+        bucket = self.bucket_for(n)
+        if bucket != n:
+            pad = np.broadcast_to(obs[:1], (bucket - n, *obs.shape[1:]))
+            obs = np.concatenate([obs, pad], axis=0)
+        a, q = self._act(self._params, jnp.asarray(obs), self._next_key())
+        return np.asarray(a)[:n], np.asarray(q)[:n]
+
+    # -------------------------------------------------------- observability
+    def compiled_executables(self) -> Optional[int]:
+        """How many distinct executables the act step has compiled — the
+        no-recompile-per-request guarantee is ``<= len(self.buckets)``.
+        Returns None when the jit cache API is unavailable (jax internals
+        moved) so the guard test can skip LOUDLY instead of passing
+        vacuously."""
+        try:
+            return int(self._act._cache_size())
+        except AttributeError:
+            return None
+
+
+def parse_buckets(spec: str) -> List[int]:
+    """Parse "8,16,32,64" into [8, 16, 32, 64]."""
+    out = [int(p) for p in str(spec).split(",") if p.strip()]
+    if not out:
+        raise ValueError(f"no batch buckets in {spec!r}")
+    return out

@@ -1,0 +1,270 @@
+"""PolicyServer: batched low-latency `act(observation) -> action` for many
+concurrent clients, with weight hot-swap.
+
+Composition (one worker thread owns the device; clients only touch the
+queue):
+
+    client threads --submit--> MicroBatcher (bounded queue, deadline)
+                                   |
+                              worker thread --pad to bucket--> InferenceEngine
+                                   |                               ^
+                              fulfil futures             CheckpointWatcher /
+                              + ServeMetrics             reload() hot-swap
+
+Transport is in-process by design: the Ape-X mesh already colocates acting
+with the chips, so the serving seam is a Python API that a network front-end
+(or the actor loop itself) calls.  Everything latency-relevant — coalescing,
+padding, shedding, swap — is below this seam and covered by tier-1 CPU
+tests; a socket listener is a thin adapter on top.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Dict, Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+
+from rainbow_iqn_apex_tpu.config import Config
+from rainbow_iqn_apex_tpu.serving.batcher import (
+    MicroBatcher,
+    ServeFuture,
+    ServerClosed,
+)
+from rainbow_iqn_apex_tpu.serving.engine import InferenceEngine, parse_buckets
+from rainbow_iqn_apex_tpu.serving.metrics import ServeMetrics
+from rainbow_iqn_apex_tpu.serving.swap import (
+    CheckpointWatcher,
+    params_template,
+    restore_params,
+)
+from rainbow_iqn_apex_tpu.utils.checkpoint import Checkpointer
+from rainbow_iqn_apex_tpu.utils.logging import MetricsLogger
+
+
+class PolicyServer:
+    """Serve IQN policy inference to concurrent clients.
+
+    Lifecycle: construct -> start() -> submit()/act() from any thread ->
+    stop().  stop() drains queued requests before exiting (graceful), unless
+    ``drain=False`` fails them immediately.
+    """
+
+    def __init__(
+        self,
+        cfg: Config,
+        num_actions: int,
+        params: Any,
+        devices: Optional[Sequence[jax.Device]] = None,
+        checkpointer: Optional[Checkpointer] = None,
+        state_shape: Optional[Tuple[int, ...]] = None,
+        template: Optional[Any] = None,
+        metrics_path: Optional[str] = None,
+        echo_metrics: bool = False,
+    ):
+        self.cfg = cfg
+        self.num_actions = num_actions
+        self.metrics = ServeMetrics(
+            MetricsLogger(metrics_path, run_id=cfg.run_id, echo=echo_metrics)
+            if metrics_path
+            else None
+        )
+        self.engine = InferenceEngine(
+            cfg,
+            num_actions,
+            params,
+            devices=devices,
+            buckets=parse_buckets(cfg.serve_batch_buckets),
+            mode=cfg.serve_mode,
+        )
+        self.batcher = MicroBatcher(
+            self.engine.buckets,
+            deadline_s=cfg.serve_deadline_ms / 1e3,
+            queue_bound=cfg.serve_queue_bound,
+            metrics=self.metrics,
+        )
+        self.watcher: Optional[CheckpointWatcher] = None
+        self._owns_checkpointer = False  # from_checkpoint sets it; stop() closes
+        if checkpointer is not None:
+            self.watcher = CheckpointWatcher(
+                checkpointer,
+                template if template is not None
+                else params_template(cfg, num_actions, state_shape=state_shape),
+                self.engine.load_params,
+                poll_interval_s=cfg.serve_swap_poll_s,
+                metrics=self.metrics,
+            )
+        self._obs_shape = tuple(state_shape or cfg.state_shape)
+        self._metrics_interval_s = max(cfg.serve_metrics_interval_s, 0.0)
+        self._worker: Optional[threading.Thread] = None
+        self._started = False
+
+    @classmethod
+    def from_checkpoint(
+        cls,
+        cfg: Config,
+        num_actions: int,
+        checkpoint_dir: str,
+        state_shape: Optional[Tuple[int, ...]] = None,
+        **kwargs: Any,
+    ) -> "PolicyServer":
+        """Boot a server straight off a learner's checkpoint directory; the
+        watcher then follows that directory for newer steps."""
+        ckpt = Checkpointer(checkpoint_dir)
+        # one template: init_train_state is a full network+optimizer trace,
+        # too expensive to rebuild again inside __init__ for the watcher
+        try:
+            template = params_template(cfg, num_actions, state_shape=state_shape)
+            params = restore_params(ckpt, template)
+        except BaseException:
+            ckpt.close()  # a supervisor retrying boot must not leak managers
+            raise
+        server = cls(
+            cfg,
+            num_actions,
+            params,
+            checkpointer=ckpt,
+            state_shape=state_shape,
+            template=template,
+            **kwargs,
+        )
+        server._owns_checkpointer = True
+        server.watcher.last_step = ckpt.latest_step()
+        return server
+
+    # -------------------------------------------------------------- lifecycle
+    def warmup(self) -> int:
+        """Compile every bucket's executable now, not on first live traffic —
+        an uncompiled bucket charges full XLA compile time (well past act()'s
+        default timeout on a real network) to whichever request hits it first,
+        and corrupts the latency percentiles.  Idempotent; returns the bucket
+        count."""
+        for b in self.engine.buckets:
+            self.engine.infer(np.zeros((b, *self._obs_shape), np.uint8))
+        return len(self.engine.buckets)
+
+    def start(self, warmup: bool = True) -> "PolicyServer":
+        if self._started:
+            return self
+        if warmup:
+            self.warmup()
+        self._started = True
+        self._worker = threading.Thread(
+            target=self._serve_loop, name="serve-worker", daemon=True
+        )
+        self._worker.start()
+        if self.watcher is not None:
+            self.watcher.start()
+        return self
+
+    def stop(self, drain: bool = True) -> Dict[str, Any]:
+        """Shut down: refuse new requests, drain (or fail) queued ones, emit
+        a final metrics row.  Returns lifetime stats."""
+        self.batcher.close()
+        if not drain:
+            self.batcher.abort_pending(ServerClosed("server stopped"))
+        if self._worker is not None:
+            self._worker.join(timeout=60)
+            self._worker = None
+        # whatever is STILL queued (never started, or the join timed out on a
+        # wedged worker) fails promptly instead of hanging its clients until
+        # their own result() timeouts
+        self.batcher.abort_pending(ServerClosed("server stopped"))
+        if self.watcher is not None:
+            self.watcher.stop()
+            if self._owns_checkpointer:
+                self._owns_checkpointer = False  # idempotent double-stop
+                self.watcher.ckpt.close()
+        self.metrics.emit(final=True)
+        if self.metrics.logger is not None:
+            self.metrics.logger.close()
+        return self.metrics.stats()
+
+    def __enter__(self) -> "PolicyServer":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.stop()
+
+    # ------------------------------------------------------------ client API
+    def submit(self, obs: np.ndarray) -> ServeFuture:
+        """Enqueue one observation [H, W, C] uint8; returns a future.
+        Raises ServerOverloaded when the queue is at its bound (shed) and
+        ServerClosed after stop().  Shape/dtype are validated HERE, in the
+        caller's thread — a malformed observation must fail its own client,
+        never reach the worker's batch assembly."""
+        arr = np.asarray(obs)
+        if tuple(arr.shape) != self._obs_shape:
+            raise ValueError(
+                f"observation shape {tuple(arr.shape)} != served {self._obs_shape}"
+            )
+        if arr.dtype != np.uint8:
+            # silent uint8 truncation would turn normalized float frames
+            # into all-zero pixels and confidently wrong actions
+            raise TypeError(f"observations must be uint8 frames, got {arr.dtype}")
+        return self.batcher.submit(arr)
+
+    def act(self, obs: np.ndarray, timeout: Optional[float] = 30.0) -> int:
+        """Blocking convenience: one observation in, one action out."""
+        action, _ = self.submit(obs).result(timeout)
+        return action
+
+    def act_values(
+        self, obs: np.ndarray, timeout: Optional[float] = 30.0
+    ) -> Tuple[int, np.ndarray]:
+        """Blocking act returning (action, expected Q per action [A])."""
+        return self.submit(obs).result(timeout)
+
+    def reload(self, step: Optional[int] = None, force: bool = False) -> Dict[str, Any]:
+        """Explicit hot-swap from the watched checkpoint dir."""
+        if self.watcher is None:
+            raise RuntimeError("server was built without a checkpointer")
+        return self.watcher.reload(step=step, force=force)
+
+    def load_params(self, params: Any) -> int:
+        """Direct hot-swap from an in-memory params tree (the learner-process
+        colocated path: no checkpoint round-trip)."""
+        version = self.engine.load_params(params)
+        self.metrics.record_swap(ok=True, params_version=version, source="direct")
+        return version
+
+    def stats(self) -> Dict[str, Any]:
+        return {
+            "queue_depth": self.batcher.depth(),
+            "params_version": self.engine.params_version,
+            "compiled_executables": self.engine.compiled_executables(),
+            "buckets": self.engine.buckets,
+            **self.metrics.stats(),
+        }
+
+    # ------------------------------------------------------------ worker loop
+    def _serve_loop(self) -> None:
+        last_emit = time.monotonic()
+        # idle timeout = metrics interval: take() returns [] on a quiet
+        # queue so the heartbeat row below still fires with zero traffic
+        # (a consumer must be able to tell "up, idle" from "dead")
+        idle_s = self._metrics_interval_s or None
+        while True:
+            batch = self.batcher.take(idle_timeout_s=idle_s)
+            if batch is None:  # closed and drained
+                break
+            if batch:
+                try:
+                    obs = np.stack([f.obs for f in batch])
+                    actions, qs = self.engine.infer(obs)
+                except Exception as e:  # fail the batch, keep serving
+                    for fut in batch:
+                        fut.set_error(e)
+                else:
+                    for i, fut in enumerate(batch):
+                        fut.set_result(int(actions[i]), qs[i])
+                        self.metrics.record_latency_ms(fut.latency_ms)
+            now = time.monotonic()
+            if self._metrics_interval_s and now - last_emit >= self._metrics_interval_s:
+                last_emit = now
+                try:
+                    self.metrics.emit(queue_depth=self.batcher.depth())
+                except Exception:  # a metrics I/O failure (disk full on the
+                    pass           # JSONL path) must never kill the worker

@@ -1,0 +1,149 @@
+"""Weight hot-swap: feed the serving engine fresh learner checkpoints.
+
+The training side already has a weight path (learner -> actor broadcast,
+parallel/apex.py publish_weights).  Serving mirrors it from the durable end:
+the learner saves Orbax checkpoints on its schedule (utils/checkpoint.py) and
+the server either polls for new steps (``CheckpointWatcher``) or is told
+explicitly (``reload()``).  Either way the actual swap is
+``InferenceEngine.load_params`` — stage on the mesh off-thread, atomic
+reference flip, zero dropped in-flight requests.
+
+A corrupt or torn checkpoint must never take the server down: restore
+failures are caught, emitted as ``swap`` rows with ``ok=false``, and the
+engine keeps serving the previous params.  A failing step is retried up to
+``max_restore_failures`` times (a transient I/O blip on a networked FS must
+not strand the server on stale weights) and then poisoned — no retry storm
+against a genuinely bad file.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Callable, Dict, Optional
+
+import jax
+import numpy as np
+
+from rainbow_iqn_apex_tpu.config import Config
+from rainbow_iqn_apex_tpu.ops.learn import TrainState, init_train_state
+from rainbow_iqn_apex_tpu.utils.checkpoint import Checkpointer
+
+
+def params_template(
+    cfg: Config, num_actions: int, state_shape=None
+) -> TrainState:
+    """An abstract TrainState with the right shapes/dtypes for restore —
+    serving never trains, so the optimizer slots are just restore scaffolding."""
+    return init_train_state(
+        cfg, num_actions, jax.random.PRNGKey(0), state_shape=state_shape
+    )
+
+
+def restore_params(
+    ckpt: Checkpointer,
+    template: TrainState,
+    step: Optional[int] = None,
+) -> Any:
+    """Load ONLINE params (what acting uses) from a checkpoint step."""
+    state, _ = ckpt.restore(template, step=step)
+    return state.params
+
+
+class CheckpointWatcher:
+    """Poll an Orbax checkpoint dir; hot-swap the engine on each new step.
+
+    ``swap_fn`` is ``engine.load_params``; ``metrics`` (ServeMetrics) gets a
+    ``swap`` event per attempt, success or failure.  ``reload()`` runs one
+    swap attempt synchronously (explicit-reload API); the poll thread does
+    the same on its interval.
+    """
+
+    def __init__(
+        self,
+        ckpt: Checkpointer,
+        template: TrainState,
+        swap_fn: Callable[[Any], int],
+        poll_interval_s: float = 2.0,
+        metrics=None,
+        max_restore_failures: int = 3,
+    ):
+        self.ckpt = ckpt
+        self.template = jax.tree.map(np.asarray, template)
+        self.swap_fn = swap_fn
+        self.poll_interval_s = float(poll_interval_s)
+        self.metrics = metrics
+        self.max_restore_failures = int(max_restore_failures)
+        self.last_step: Optional[int] = None
+        self._fail_counts: Dict[int, int] = {}  # step -> restore failures
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._swap_lock = threading.Lock()  # one restore at a time
+
+    # ------------------------------------------------------------- swapping
+    def reload(self, step: Optional[int] = None, force: bool = False) -> Dict[str, Any]:
+        """Attempt one swap from ``step`` (default: latest).  Returns an event
+        dict mirroring the emitted metrics row.  ``force`` re-swaps even when
+        the step was already loaded (params-delta testing, manual recovery)."""
+        with self._swap_lock:
+            # refresh, not latest_step: the learner writing the dir is a
+            # different process, invisible to the manager's cached listing
+            target = self.ckpt.refresh() if step is None else step
+            if target is None:
+                return {"ok": False, "reason": "no_checkpoint"}
+            failures = self._fail_counts.get(target, 0)
+            if failures >= self.max_restore_failures and not force:
+                return {"ok": False, "step": target, "reason": "poisoned"}
+            if target == self.last_step and not force:
+                return {"ok": True, "step": target, "reason": "already_loaded"}
+            try:
+                params = restore_params(self.ckpt, self.template, step=target)
+                version = self.swap_fn(params)
+            except Exception as e:  # torn/corrupt file: keep serving old params
+                self._fail_counts[target] = failures + 1
+                event = {
+                    "ok": False,
+                    "step": target,
+                    "failures": failures + 1,
+                    "reason": f"{type(e).__name__}: {e}"[:200],
+                }
+                if self.metrics is not None:
+                    self.metrics.record_swap(**event)
+                return event
+            self.last_step = target
+            # a recovered step (forced or retried) is whole again — un-poison
+            self._fail_counts.pop(target, None)
+            event = {"ok": True, "step": target, "params_version": version}
+            if self.metrics is not None:
+                self.metrics.record_swap(**event)
+            return event
+
+    # ------------------------------------------------------------ poll loop
+    def _poll_once(self) -> None:
+        # reload() refreshes the step listing and restores under _swap_lock;
+        # touching the (thread-unsafe) manager out here would race an
+        # explicit reload() mid-restore
+        self.reload()
+
+    def _run(self) -> None:
+        while not self._stop.wait(self.poll_interval_s):
+            try:
+                self._poll_once()
+            except Exception as e:  # a flaky listing must not kill the thread
+                if self.metrics is not None:
+                    self.metrics.record_swap(
+                        ok=False, reason=f"poll: {type(e).__name__}: {e}"[:200]
+                    )
+
+    def start(self) -> "CheckpointWatcher":
+        if self._thread is None:
+            self._thread = threading.Thread(
+                target=self._run, name="serve-ckpt-watcher", daemon=True
+            )
+            self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=10)
+            self._thread = None

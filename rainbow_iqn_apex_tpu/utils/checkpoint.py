@@ -45,6 +45,16 @@ class Checkpointer:
     def latest_step(self) -> Optional[int]:
         return self._mngr.latest_step()
 
+    def refresh(self) -> Optional[int]:
+        """Re-read the step list from disk and return the latest step.
+
+        The manager caches its directory listing, so steps written by
+        ANOTHER process (or another Checkpointer on the same dir) are
+        invisible to plain latest_step() — a follower (serving/swap.py's
+        CheckpointWatcher tailing a learner's dir) must refresh first."""
+        self._mngr.reload()
+        return self._mngr.latest_step()
+
     def restore_extra(self, step: Optional[int] = None) -> Dict[str, Any]:
         """The JSON side-car alone (frames counter etc.) without building an
         abstract TrainState — for tooling that inspects a run (frame count,

@@ -1,0 +1,183 @@
+#!/usr/bin/env python
+"""Load-generator bench for the policy server (serving/): N synthetic client
+threads drive `PolicyServer.act` as fast as the server completes them, with a
+weight hot-swap fired mid-run, and the result is printed as JSON rows in the
+bench.py idiom (one object per line, flushed immediately, LAST line is the
+headline requests/sec).
+
+What is measured: end-to-end serving throughput and latency through the real
+stack — bounded queue, deadline coalescing, bucket padding, lane-sharded
+jitted inference, atomic param swap — not a model microbenchmark.  Batch
+occupancy tells whether micro-batching actually coalesced (the acceptance
+gate is mean occupancy > 4 at 64 clients); shed_total must be 0 when clients
+<= queue bound (blocking clients can never overrun it).
+
+CPU smoke shape (default): 44x44x2 frames, hidden 64, IQN taus 8/8/4 — the
+same small-but-real network the parallel tests use, so the numbers track the
+serving machinery, not conv throughput.
+
+Usage:
+    JAX_PLATFORMS=cpu python scripts/bench_serve.py --clients 64 --requests 2000
+"""
+
+import argparse
+import json
+import os
+import sys
+import threading
+import time
+
+# The sandbox's sitecustomize registers the remote-TPU PJRT plugin whenever
+# PALLAS_AXON_POOL_IPS is set, and a registered plugin blocks `import jax`
+# even under JAX_PLATFORMS=cpu (see conftest.py).  This bench is a CPU smoke
+# tool unless the caller explicitly pins a device platform.
+if os.environ.get("JAX_PLATFORMS", "cpu") == "cpu":
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    os.environ.pop("PALLAS_AXON_POOL_IPS", None)
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def row(**fields):
+    print(json.dumps(fields), flush=True)
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--clients", type=int, default=64)
+    ap.add_argument("--requests", type=int, default=2000)
+    ap.add_argument("--deadline-ms", type=float, default=5.0)
+    ap.add_argument("--buckets", default="8,16,32,64")
+    ap.add_argument("--queue-bound", type=int, default=256)
+    ap.add_argument("--mode", default="greedy", choices=("greedy", "noisy"))
+    ap.add_argument("--no-swap", action="store_true",
+                    help="skip the mid-bench weight hot-swap")
+    ap.add_argument("--num-actions", type=int, default=6)
+    ap.add_argument("--out", default="results/serve_bench",
+                    help="directory for the JSONL metrics log")
+    args = ap.parse_args()
+
+    import jax
+    import numpy as np
+
+    from rainbow_iqn_apex_tpu.config import Config
+    from rainbow_iqn_apex_tpu.ops.learn import init_train_state
+    from rainbow_iqn_apex_tpu.serving import PolicyServer
+
+    cfg = Config(
+        compute_dtype="float32",
+        frame_height=44,
+        frame_width=44,
+        history_length=2,
+        hidden_size=64,
+        num_cosines=16,
+        num_tau_samples=8,
+        num_tau_prime_samples=8,
+        num_quantile_samples=4,
+        serve_batch_buckets=args.buckets,
+        serve_deadline_ms=args.deadline_ms,
+        serve_queue_bound=args.queue_bound,
+        serve_mode=args.mode,
+        serve_metrics_interval_s=1.0,
+        run_id="serve_bench",
+    )
+    state = init_train_state(cfg, args.num_actions, jax.random.PRNGKey(0))
+    os.makedirs(args.out, exist_ok=True)
+    metrics_path = os.path.join(args.out, "metrics.jsonl")
+    server = PolicyServer(
+        cfg, args.num_actions, state.params, metrics_path=metrics_path
+    )
+    row(event="bench_serve_start", clients=args.clients, requests=args.requests,
+        buckets=server.engine.buckets, deadline_ms=args.deadline_ms,
+        queue_bound=args.queue_bound, devices=server.engine.n_devices,
+        metrics=metrics_path)
+
+    # Pre-compile every bucket OUTSIDE the timed window so latency numbers
+    # measure serving, not XLA compilation.
+    t0 = time.monotonic()
+    compiled = server.warmup()
+    row(event="warmup_done", buckets_compiled=compiled,
+        compile_s=round(time.monotonic() - t0, 2))
+    server.start()
+
+    rng = np.random.default_rng(0)
+    obs_pool = rng.integers(0, 255, (64, 44, 44, 2), dtype=np.uint8)
+    issued = threading.Semaphore(args.requests)  # total-request budget
+    completed = [0]
+    completed_lock = threading.Lock()
+    swap_at = args.requests // 2
+    swap_fired = threading.Event()
+    errors = []
+
+    def swap_params():
+        """The hot-swap under load: perturbed params in, zero dropped
+        requests expected (verified post-hoc from server stats)."""
+        perturbed = jax.tree.map(lambda x: x + 0.01, state.params)
+        version = server.load_params(perturbed)
+        row(event="swap_fired", at_request=swap_at, params_version=version)
+
+    def client(idx: int):
+        while issued.acquire(blocking=False):
+            try:
+                server.act(obs_pool[idx % len(obs_pool)], timeout=120)
+            except Exception as e:  # noqa: BLE001 — report, don't hang the bench
+                errors.append(f"{type(e).__name__}: {e}")
+                return
+            should_swap = False
+            with completed_lock:
+                completed[0] += 1
+                if not args.no_swap and completed[0] >= swap_at \
+                        and not swap_fired.is_set():
+                    swap_fired.set()
+                    should_swap = True
+            if should_swap:
+                # the device_put runs OUTSIDE the lock — holding it would
+                # stall every other client's completion path and charge the
+                # swap's cost to the measured latency as harness contention
+                swap_params()
+
+    threads = [
+        threading.Thread(target=client, args=(i,), daemon=True)
+        for i in range(args.clients)
+    ]
+    t_start = time.monotonic()
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    wall_s = time.monotonic() - t_start
+    stats = server.stop()
+
+    occupancy = stats["batch_occupancy_lifetime"]
+    rps = completed[0] / max(wall_s, 1e-9)
+    row(metric="serve_batch_occupancy_mean", value=occupancy, unit="req/batch")
+    for k in ("latency_p50_ms", "latency_p95_ms", "latency_p99_ms"):
+        if k in stats:
+            row(metric=f"serve_{k}", value=stats[k], unit="ms")
+    row(metric="serve_shed_total", value=stats["total_shed"], unit="requests")
+    row(metric="serve_swaps", value=stats["total_swaps"], unit="events")
+    if errors:
+        row(event="client_errors", n=len(errors), first=errors[0])
+        return 1
+    if completed[0] != args.requests:
+        row(event="incomplete", completed=completed[0], expected=args.requests)
+        return 1
+    # Blocking clients can hold at most `clients` requests in flight, so any
+    # shed below the queue bound is a server bug, not an overload.
+    if args.clients <= args.queue_bound and stats["total_shed"] > 0:
+        row(event="unexpected_shed", shed=stats["total_shed"])
+        return 1
+    # The coalescing gate from the docstring and docs/SERVING.md, enforced:
+    # at 64+ clients a healthy batcher runs far above 4 requests/batch, and
+    # occupancy ~1 means micro-batching silently stopped working.
+    if args.clients >= 64 and occupancy <= 4:
+        row(event="occupancy_below_gate", occupancy=occupancy, gate=4)
+        return 1
+    row(metric="serve_requests_per_sec", value=round(rps, 1), unit="req/s",
+        requests=completed[0], wall_s=round(wall_s, 2),
+        occupancy=occupancy, path="in_process")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -99,6 +99,46 @@ def git_commit(paths, msg) -> bool:
                                                 msg=msg, err=m))
 
 
+def classify_probe(rc, out: str) -> str:
+    """Explicit cause for a probe outcome — the round-5 probes died at 1530s
+    with rc=2 and were logged as bare (rc, elapsed) rows, leaving the
+    postmortem to re-derive the cause from probe_last.out.  Every row now
+    carries one of these labels:
+
+      live                  TPU backend initialised
+      cpu_fallback          backend init OK but no TPU behind it (relay env
+                            not wired through; probing again won't help)
+      relay_unavailable     the known dead-relay signature: backend init ran
+                            its full course and ended UNAVAILABLE/DEADLINE
+      import_error          jax import machinery broke (env bug, not relay)
+      probe_timeout         the child's own SIGALRM fired
+      no_output             child died silently (rc!=0, nothing written) —
+                            the one genuinely unexplained class, worth a
+                            bounded fast retry
+      init_failed           backend init raised something else (tail says what)
+    """
+    if rc == 0:
+        return "live" if "PROBE_OK tpu" in out else "cpu_fallback"
+    if rc == 9 or "PROBE_TIMEOUT" in out:
+        return "probe_timeout"
+    if not out.strip():
+        return "no_output"
+    if "UNAVAILABLE" in out or "DEADLINE_EXCEEDED" in out:
+        return "relay_unavailable"
+    if "ImportError" in out or "ModuleNotFoundError" in out:
+        return "import_error"
+    return "init_failed"
+
+
+# Causes where an immediate re-probe is plausible progress: a silent child
+# death or an unclassified init failure may be a transient (OOM blip, relay
+# flapping mid-handshake).  The known-dead signature is NOT here — it already
+# took its full ~25 min to resolve, and hammering a dead relay adds nothing
+# over the normal long sleep.
+RETRYABLE_CAUSES = ("no_output", "init_failed")
+PROBE_RETRIES = 2  # bounded: at most this many EXTRA attempts per cycle
+
+
 def run_probe() -> dict:
     """One backend-init probe.  Waits for the child to exit on its own —
     NEVER kills it (single-claim relay discipline).  Output goes to a file,
@@ -122,9 +162,24 @@ def run_probe() -> dict:
     with open(probe_out) as f:
         out = f.read().strip()
     dt = time.monotonic() - t0
-    live = p.returncode == 0 and "PROBE_OK tpu" in out
-    return {"rc": p.returncode, "elapsed_s": round(dt, 1), "live": live,
-            "tail": out[-400:]}
+    cause = classify_probe(p.returncode, out)
+    return {"rc": p.returncode, "elapsed_s": round(dt, 1),
+            "live": cause == "live", "cause": cause, "tail": out[-400:]}
+
+
+def probe_with_retry() -> dict:
+    """run_probe plus a bounded fast-retry loop for the transient causes.
+    Returns the LAST attempt's result with ``attempts`` attached; every
+    retried attempt is logged so no outcome is ever a bare rc again."""
+    attempt = 1
+    res = run_probe()
+    while (not res["live"] and res["cause"] in RETRYABLE_CAUSES
+           and attempt <= PROBE_RETRIES):
+        log_event(event="probe_retry", attempt=attempt, **res)
+        attempt += 1
+        res = run_probe()
+    res["attempts"] = attempt
+    return res
 
 
 def run_phase(name: str, argv, out_name: str, extra_env=None,
@@ -335,11 +390,12 @@ def main() -> None:
     n = 0
     while not os.path.exists(STOP):
         n += 1
-        res = run_probe()
+        res = probe_with_retry()
         log_event(event="probe", n=n, **res)
         git_commit([LOG], f"relay_watch: probe {n} "
                           f"{'LIVE' if res['live'] else 'dead'} "
-                          f"({res['elapsed_s']:.0f}s, rc={res['rc']})")
+                          f"({res['elapsed_s']:.0f}s, rc={res['rc']}, "
+                          f"cause={res['cause']})")
         if res["live"]:
             log_event(event="chain_start", probe_n=n)
             complete = capture_chain()

@@ -29,6 +29,22 @@ def test_human_normalized_math():
     assert human_normalized_score("NopeGame", 1.0) is None
 
 
+def test_eval_baselines_wired_to_atari57_table():
+    """eval.py's env_id-keyed table must carry every Atari-57 game, sourced
+    from THIS table (a missing entry silently drops human_normalized from
+    eval results)."""
+    from rainbow_iqn_apex_tpu.eval import HUMAN_BASELINES, human_normalized
+
+    for game, (random, human) in ATARI57_BASELINES.items():
+        assert HUMAN_BASELINES[f"atari:{game}"] == {
+            "random": random, "human": human,
+        }
+    assert human_normalized("atari:Pong", 14.6) == pytest.approx(1.0)
+    assert human_normalized("atari:Pong", -20.7) == pytest.approx(0.0)
+    assert human_normalized("toy:catch", 1.0) == pytest.approx(1.0)
+    assert human_normalized("atari:NopeGame", 1.0) is None
+
+
 def test_aggregate_median():
     scores = {"Pong": 14.6, "Breakout": 1.7, "Boxing": 12.1}  # 1.0, 0.0, 1.0
     agg = aggregate(scores)

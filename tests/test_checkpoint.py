@@ -1,0 +1,94 @@
+"""Checkpointer round-trip coverage for the serving hot-swap path
+(utils/checkpoint.py + serving/swap.py): what the server restores must be
+EXACTLY what the learner saved, and a torn/corrupt checkpoint must raise
+cleanly — the watcher catches it and keeps serving (tests/test_serving.py
+covers that half)."""
+
+import os
+
+import jax
+import numpy as np
+import pytest
+
+from rainbow_iqn_apex_tpu.config import Config
+from rainbow_iqn_apex_tpu.ops.learn import init_train_state
+from rainbow_iqn_apex_tpu.serving.swap import params_template, restore_params
+from rainbow_iqn_apex_tpu.utils.checkpoint import Checkpointer
+
+CFG = Config(
+    compute_dtype="float32",
+    frame_height=44,
+    frame_width=44,
+    history_length=2,
+    hidden_size=64,
+    num_cosines=16,
+    num_tau_samples=8,
+    num_tau_prime_samples=8,
+    num_quantile_samples=4,
+)
+A = 4
+
+
+def _assert_trees_equal(a, b):
+    leaves_a, leaves_b = jax.tree.leaves(a), jax.tree.leaves(b)
+    assert len(leaves_a) == len(leaves_b)
+    for la, lb in zip(leaves_a, leaves_b):
+        np.testing.assert_array_equal(np.asarray(la), np.asarray(lb))
+
+
+def test_save_mutate_reload_exact_roundtrip(tmp_path):
+    """save -> mutate in memory -> reload: the restore returns the SAVED tree
+    bit-for-bit, not the mutated live one (the hot-swap correctness core)."""
+    ckpt = Checkpointer(str(tmp_path))
+    state = init_train_state(CFG, A, jax.random.PRNGKey(0))
+    ckpt.save(0, state, extra={"frames": 123})
+    ckpt.wait()
+
+    mutated = state.replace(
+        params=jax.tree.map(lambda x: x * 2.0 + 1.0, state.params)
+    )
+    ckpt.save(7, mutated)
+    ckpt.wait()
+    assert ckpt.latest_step() == 7
+
+    template = params_template(CFG, A)
+    restored0, extra0 = ckpt.restore(template, step=0)
+    _assert_trees_equal(restored0.params, state.params)
+    _assert_trees_equal(restored0.target_params, state.target_params)
+    assert int(restored0.step) == int(state.step)
+    assert extra0 == {"frames": 123}
+
+    # latest-step restore sees the mutated tree, exactly
+    params7 = restore_params(ckpt, template)
+    _assert_trees_equal(params7, mutated.params)
+    leaf = np.asarray(jax.tree.leaves(params7)[0])
+    with pytest.raises(AssertionError):  # and it genuinely differs from step 0
+        np.testing.assert_array_equal(
+            leaf, np.asarray(jax.tree.leaves(state.params)[0])
+        )
+
+
+def test_corrupted_checkpoint_raises_cleanly(tmp_path):
+    """A truncated step directory must raise a normal exception the watcher
+    can catch — never return a silently-wrong tree."""
+    ckpt = Checkpointer(str(tmp_path))
+    state = init_train_state(CFG, A, jax.random.PRNGKey(0))
+    ckpt.save(0, state)
+    ckpt.wait()
+    step_dir = os.path.join(str(tmp_path), "0")
+    truncated = 0
+    for root, _, files in os.walk(step_dir):
+        for f in files:
+            open(os.path.join(root, f), "w").close()
+            truncated += 1
+    assert truncated > 0  # the corruption actually touched the layout
+    with pytest.raises(Exception):
+        ckpt.restore(params_template(CFG, A), step=0)
+
+
+def test_restore_missing_checkpoint_raises_filenotfound(tmp_path):
+    ckpt = Checkpointer(str(tmp_path / "empty"))
+    with pytest.raises(FileNotFoundError):
+        ckpt.restore(params_template(CFG, A))
+    with pytest.raises(FileNotFoundError):
+        ckpt.restore_extra()

@@ -81,3 +81,67 @@ def test_truncated_state_restarts_chain(watch, monkeypatch, tmp_path):
     ran, complete = run_chain(watch, monkeypatch, {})
     assert ran == EXPECTED_ORDER  # fell back to a fresh chain, no crash
     assert complete
+
+
+# --------------------------------------------------- probe cause + retry
+# Round 5 postmortem: probes 5 and 6 died at 1530s with rc=2 logged as bare
+# (rc, elapsed) rows — the cause had to be re-derived by hand.  Every probe
+# row now carries an explicit cause, and only genuinely transient causes get
+# a BOUNDED fast retry (the known ~25-min dead-relay signature does not).
+
+@pytest.mark.parametrize("rc,out,cause", [
+    (0, "PROBE_OK tpu n=8 t=12.0s", "live"),
+    (0, "PROBE_OK cpu n=1 t=0.1s", "cpu_fallback"),
+    (2, "PROBE_FAIL RuntimeError: UNAVAILABLE: relay down", "relay_unavailable"),
+    (2, "PROBE_FAIL RuntimeError: DEADLINE_EXCEEDED waiting", "relay_unavailable"),
+    (2, "PROBE_FAIL ImportError: libtpu", "import_error"),
+    (2, "PROBE_FAIL RuntimeError: something odd", "init_failed"),
+    (9, "PROBE_TIMEOUT after 2700s", "probe_timeout"),
+    (2, "", "no_output"),
+    (-11, "", "no_output"),  # segfaulted child, nothing written
+])
+def test_classify_probe(watch, rc, out, cause):
+    assert watch.classify_probe(rc, out) == cause
+
+
+def _probe_seq(watch, monkeypatch, results):
+    seq = iter(results)
+    attempts = []
+
+    def fake_run_probe():
+        res = next(seq)
+        attempts.append(res)
+        return dict(res)
+
+    monkeypatch.setattr(watch, "run_probe", fake_run_probe)
+    return attempts
+
+
+DEAD = {"rc": 2, "elapsed_s": 1.0, "live": False, "cause": "no_output",
+        "tail": ""}
+UNAVAIL = {"rc": 2, "elapsed_s": 1530.0, "live": False,
+           "cause": "relay_unavailable", "tail": "UNAVAILABLE"}
+LIVE = {"rc": 0, "elapsed_s": 12.0, "live": True, "cause": "live",
+        "tail": "PROBE_OK tpu"}
+
+
+def test_probe_retry_is_bounded(watch, monkeypatch):
+    attempts = _probe_seq(watch, monkeypatch, [DEAD] * 10)
+    res = watch.probe_with_retry()
+    assert len(attempts) == 1 + watch.PROBE_RETRIES  # bounded, not forever
+    assert res["attempts"] == 1 + watch.PROBE_RETRIES
+    assert res["cause"] == "no_output" and not res["live"]
+
+
+def test_probe_retry_stops_on_live(watch, monkeypatch):
+    attempts = _probe_seq(watch, monkeypatch, [DEAD, LIVE, DEAD])
+    res = watch.probe_with_retry()
+    assert len(attempts) == 2 and res["live"] and res["attempts"] == 2
+
+
+def test_known_dead_relay_signature_not_retried(watch, monkeypatch):
+    """relay_unavailable already took its full course — an immediate re-probe
+    buys nothing over the long inter-probe sleep."""
+    attempts = _probe_seq(watch, monkeypatch, [UNAVAIL, UNAVAIL])
+    res = watch.probe_with_retry()
+    assert len(attempts) == 1 and res["cause"] == "relay_unavailable"
