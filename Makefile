@@ -2,7 +2,7 @@
 
 PY ?= python
 
-.PHONY: test test-fast serve-smoke serve-bench
+.PHONY: test test-fast serve-smoke serve-bench chaos-smoke
 
 # tier-1: fast unit + integration tests on the virtual 8-device CPU mesh
 test-fast:
@@ -19,3 +19,10 @@ serve-smoke:
 # load-generator bench (acceptance: occupancy > 4, zero sheds, swap mid-run)
 serve-bench:
 	JAX_PLATFORMS=cpu $(PY) scripts/bench_serve.py --clients 64 --requests 2000
+
+# chaos smoke: every named fault-injection point exercised end to end
+# (NaN rollback, corrupt-checkpoint fallback, torn-snapshot CRC, retried
+# checkpoint IO, stall watchdog, heartbeat loss) — the `chaos`-marked
+# subset of tier-1 (docs/RESILIENCE.md)
+chaos-smoke:
+	JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_resilience.py -q -m chaos

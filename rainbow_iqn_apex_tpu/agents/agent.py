@@ -130,6 +130,14 @@ class Agent:
     def step(self) -> int:
         return int(self.state.step)
 
+    # ---------------------------------------------------------------- rollback
+    def load_snapshot(self, state, key) -> None:
+        """NaN-guard rollback target (parallel/supervisor.py): replace the
+        live TrainState + PRNG key with the supervisor's last-good host
+        copy.  The poisoned donated buffers are simply dropped."""
+        self.state = jax.tree.map(jnp.asarray, state)
+        self.key = jnp.asarray(key)
+
     # ------------------------------------------------------------- weight sync
     def params_for_publish(self):
         """Online params as the learner publishes them to actors (the Redis

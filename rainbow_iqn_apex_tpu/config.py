@@ -28,10 +28,30 @@ class Config:
     checkpoint_dir: str = "checkpoints"
     checkpoint_interval: int = 100_000  # learner steps between Orbax saves
     metrics_interval: int = 1_000  # learner steps between JSONL metric rows
-    resume: bool = False
+    resume: str = ""  # "" = fresh start; "true" = restore latest step (raise
+    # on corruption); "auto" = preemption-safe: restore the newest VALID
+    # checkpoint, falling back past corrupt steps, fresh start when none —
+    # the mode an auto-restarting scheduler should use (docs/RESILIENCE.md).
+    # Legacy bool configs (resume=True/False) keep working.
     snapshot_replay: bool = False  # persist replay contents next to checkpoints
     # (parity: the reference's replay survives restarts via Redis persistence;
     # off by default — Atari-scale buffers are ~7GB/host on disk)
+
+    # ---- resilience (utils/faults.py + parallel/supervisor.py; RESILIENCE.md) ----
+    fault_spec: str = ""  # chaos injection, e.g. "nan_loss@5,checkpoint_write@1"
+    # (point@n = fire on n-th call, point:p = seeded probability, bare point =
+    # always; RIA_FAULTS env var overrides)
+    fault_stall_s: float = 0.0  # injected stall duration for 'stalled_step'
+    max_nan_strikes: int = 3  # consecutive non-finite learn steps before abort
+    guard_snapshot_interval: int = 500  # learner steps between last-good
+    # in-memory state snapshots (the NaN-guard rollback target)
+    stall_timeout_s: float = 300.0  # watchdog: no completed learn step for
+    # this long -> 'stalled_step' fault row; 0 disables
+    io_retry_attempts: int = 3  # checkpoint/replay-snapshot IO tries (total)
+    io_retry_base_s: float = 0.05  # backoff base; doubles per retry + jitter
+    io_retry_max_s: float = 2.0
+    heartbeat_interval_s: float = 0.0  # per-host liveness file cadence; 0 off
+    heartbeat_timeout_s: float = 30.0  # peer file older than this = dead host
 
     # ---- environment (SURVEY §2 row 2) -------------------------------------------
     env_id: str = "toy:catch"  # "toy:catch", "toy:chain", or "atari:<Game>"

@@ -58,10 +58,14 @@ from rainbow_iqn_apex_tpu.parallel.mesh import (
     split_devices,
 )
 from rainbow_iqn_apex_tpu.parallel.sharded_replay import ShardedReplay
+from rainbow_iqn_apex_tpu.parallel.supervisor import TrainSupervisor
+from rainbow_iqn_apex_tpu.utils import faults
 from rainbow_iqn_apex_tpu.utils.checkpoint import (
     Checkpointer,
     maybe_restore_replay,
-    save_replay_snapshot,
+    maybe_resume,
+    rng_extra,
+    rng_from_extra,
 )
 from rainbow_iqn_apex_tpu.utils.logging import MetricsLogger
 
@@ -204,13 +208,29 @@ class ApexDriver:
         self.actor_params = p
 
     # ---------------------------------------------------------------- resume
+    def load_state(self, state, extra: Optional[Dict[str, Any]] = None) -> None:
+        """Place a restored TrainState onto the learner mesh, pick up the
+        saved RNG stream when the checkpoint carries one, and re-publish
+        actor weights."""
+        self.state = jax.device_put(state, replicated(self.lmesh))
+        self.key = jnp.asarray(rng_from_extra(extra or {}, self.key))
+        self.publish_weights()
+
     def restore(self, ckpt) -> Dict[str, Any]:
         """Load the latest checkpoint into the learner mesh and re-publish
         actor weights; returns the checkpoint's extra metadata."""
         state, extra = ckpt.restore(self.state)
-        self.state = jax.device_put(state, replicated(self.lmesh))
-        self.publish_weights()
+        self.load_state(state, extra)
         return extra
+
+    # ---------------------------------------------------------------- rollback
+    def load_snapshot(self, state, key) -> None:
+        """NaN-guard rollback (parallel/supervisor.py): last-good host state
+        back onto the learner mesh.  Actor params are NOT re-published — the
+        poisoned state was never published (the guard runs before the
+        publish), so actors still hold good, merely stale, weights."""
+        self.state = jax.device_put(state, replicated(self.lmesh))
+        self.key = jnp.asarray(key)
 
     # ----------------------------------------------------------------- compute
     def _next_key(self):
@@ -379,11 +399,33 @@ def train_apex(cfg: Config, max_frames: Optional[int] = None) -> Dict[str, Any]:
         echo=is_main,
     )
     ckpt = Checkpointer(os.path.join(cfg.checkpoint_dir, cfg.run_id))
+    faults.install_from(cfg)
+    # NOTE (multi-host): the injector/retry decisions are pure functions of
+    # (spec, seed, call order), identical on every host — supervised control
+    # flow can never diverge the SPMD program around a collective.
+    sup = TrainSupervisor(cfg, metrics=metrics)
+    from rainbow_iqn_apex_tpu.parallel.multihost import (
+        HeartbeatMonitor,
+        HeartbeatWriter,
+        heartbeat_dir,
+    )
+
+    heartbeat = monitor = None
+    if cfg.heartbeat_interval_s > 0:
+        heartbeat = HeartbeatWriter(
+            heartbeat_dir(cfg), cfg.process_id, cfg.heartbeat_interval_s
+        ).start()
+        if is_main:
+            monitor = HeartbeatMonitor(
+                heartbeat_dir(cfg), cfg.heartbeat_timeout_s, self_id=cfg.process_id
+            )
 
     frames = 0
     last_pub = 0
-    if cfg.resume and ckpt.latest_step() is not None:
-        extra = driver.restore(ckpt)
+    restored = maybe_resume(cfg, ckpt, driver.state)
+    if restored is not None:
+        state, extra, _ = restored
+        driver.load_state(state, extra)
         frames = int(extra.get("frames", 0))
         last_pub = driver.step
         maybe_restore_replay(cfg, memory)
@@ -494,6 +536,10 @@ def train_apex(cfg: Config, max_frames: Optional[int] = None) -> Dict[str, Any]:
                         )
                 steps_due = frames // cfg.replay_ratio - driver.step
                 for _ in range(max(steps_due, 0)):
+                    sup.snapshot_if_due(
+                        driver.step,
+                        lambda: (host_state(driver.state), driver.key),
+                    )
                     if multihost:
                         # local sub-batch in, local priority rows out; the
                         # global batch assembles across hosts inside, and IS
@@ -504,17 +550,29 @@ def train_apex(cfg: Config, max_frames: Optional[int] = None) -> Dict[str, Any]:
                             sample = memory.sample(local_batch, priority_beta(cfg, frames))
                             idx = sample.idx
                         info = driver.learn_local(
-                            sample,
+                            sup.poison_maybe(sample),
                             global_size=len(memory) * nproc,
                             beta=priority_beta(cfg, frames),
                         )
                     elif prefetcher is not None:
                         idx, batch = prefetcher.get()
-                        info = driver.learn_batch(batch)
+                        info = driver.learn_batch(sup.poison_maybe(batch))
                     else:
                         sample = memory.sample(local_batch, priority_beta(cfg, frames))
                         idx = sample.idx
-                        info = driver.learn(sample)
+                        info = driver.learn(sup.poison_maybe(sample))
+                    sup.maybe_stall()
+                    if not sup.step_ok(info):
+                        # non-finite step (loss is all-reduced: every host
+                        # sees the same value and rolls back together).
+                        # Quarantine the sampled rows — |TD|=0 drops a
+                        # genuinely poisoned max-priority transition to
+                        # eps^omega so it can't re-sample into a rollback
+                        # livelock — and the guard runs BEFORE publish so
+                        # actors never see poisoned params.
+                        memory.update_priorities(idx, np.zeros(len(idx)))
+                        driver.load_snapshot(*sup.rollback())
+                        continue
                     memory.update_priorities(idx, np.asarray(info["priorities"]))
                     step = driver.step
                     if step - last_pub >= cfg.weight_publish_interval:
@@ -531,6 +589,16 @@ def train_apex(cfg: Config, max_frames: Optional[int] = None) -> Dict[str, Any]:
                             mean_return=float(np.mean(returns)) if returns else float("nan"),
                             staleness=step - last_pub,
                         )
+                        if monitor is not None:
+                            # a preempted host stops heartbeating; the
+                            # host_dead row is the external supervisor's
+                            # restart/reshard signal — a hung collective
+                            # would otherwise wedge this loop silently
+                            for hid in monitor.newly_dead():
+                                metrics.log(
+                                    "fault", event="host_dead", host=hid,
+                                    step=step, frames=frames,
+                                )
                     if is_main and cfg.eval_interval and step % cfg.eval_interval == 0:
                         metrics.log(
                             "eval", step=step, **_eval_learner(cfg, env, driver)
@@ -539,19 +607,29 @@ def train_apex(cfg: Config, max_frames: Optional[int] = None) -> Dict[str, Any]:
                         # every host calls save — Orbax treats it as a
                         # collective under jax.distributed (primary host
                         # writes, the rest join its barrier); a p0-only call
-                        # would hang the pod at the next sync point
-                        ckpt.save(step, host_state(driver.state),
-                                  {"frames": frames})
-                        save_replay_snapshot(cfg, memory)  # per-host shard
+                        # would hang the pod at the next sync point.  The
+                        # retry wrapper's decisions are deterministic, so
+                        # hosts retry in lockstep too.
+                        sup.save_checkpoint(
+                            ckpt, step, host_state(driver.state),
+                            {"frames": frames, **rng_extra(driver.key)},
+                        )
+                        sup.save_replay(cfg, memory)  # per-host shard
 
     finally:
         if prefetcher is not None:
             prefetcher.close()
+        sup.close()
+        if heartbeat is not None:
+            heartbeat.stop()
     final_eval = _eval_learner(cfg, env, driver) if is_main else {}
     if is_main:
         metrics.log("eval", step=driver.step, **final_eval)
-    ckpt.save(driver.step, host_state(driver.state), {"frames": frames})
-    save_replay_snapshot(cfg, memory)
+    sup.save_checkpoint(
+        ckpt, driver.step, host_state(driver.state),
+        {"frames": frames, **rng_extra(driver.key)}, critical=True,
+    )
+    sup.save_replay(cfg, memory, critical=True)
     ckpt.wait()
     metrics.close()
     return {
@@ -559,6 +637,9 @@ def train_apex(cfg: Config, max_frames: Optional[int] = None) -> Dict[str, Any]:
         "learn_steps": driver.step,
         "lanes": lanes_total,
         "train_return_mean": float(np.mean(returns)) if returns else float("nan"),
+        "rollbacks": sup.rollbacks,
+        "stalls": sup.stalls,
+        "io_faults": sup.io_faults,
         **{f"eval_{k}": v for k, v in final_eval.items()},
     }
 

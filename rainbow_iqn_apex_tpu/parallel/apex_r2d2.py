@@ -52,12 +52,16 @@ from rainbow_iqn_apex_tpu.parallel.multihost import (
     plan_hosts,
     shift_stack,
 )
+from rainbow_iqn_apex_tpu.parallel.supervisor import TrainSupervisor
 from rainbow_iqn_apex_tpu.replay.sequence import SequenceReplay, SequenceSample
 from rainbow_iqn_apex_tpu.train import priority_beta
+from rainbow_iqn_apex_tpu.utils import faults
 from rainbow_iqn_apex_tpu.utils.checkpoint import (
     Checkpointer,
     maybe_restore_replay,
-    save_replay_snapshot,
+    maybe_resume,
+    rng_extra,
+    rng_from_extra,
 )
 from rainbow_iqn_apex_tpu.utils.logging import MetricsLogger
 from rainbow_iqn_apex_tpu.utils.prefetch import BatchPrefetcher
@@ -171,13 +175,25 @@ class R2D2ApexDriver:
             p = jax.device_put(p, self._rep_a)
         self.actor_params = p
 
+    def load_state(self, state, extra: Optional[Dict[str, Any]] = None) -> None:
+        """Place a restored R2D2TrainState onto the learner mesh, pick up
+        the saved RNG stream when present, re-publish actor weights."""
+        self.state = jax.device_put(state, replicated(self.lmesh))
+        self.key = jnp.asarray(rng_from_extra(extra or {}, self.key))
+        self.publish_weights()
+
     def restore(self, ckpt) -> Dict[str, Any]:
         """Load the latest checkpoint into the learner mesh and re-publish
         actor weights; returns the checkpoint's extra metadata."""
         state, extra = ckpt.restore(self.state)
-        self.state = jax.device_put(state, replicated(self.lmesh))
-        self.publish_weights()
+        self.load_state(state, extra)
         return extra
+
+    def load_snapshot(self, state, key) -> None:
+        """NaN-guard rollback (parallel/supervisor.py); actor params stay as
+        last published — the poisoned state never reached them."""
+        self.state = jax.device_put(state, replicated(self.lmesh))
+        self.key = jnp.asarray(key)
 
     def act(self, obs: np.ndarray) -> Tuple[np.ndarray, Tuple[np.ndarray, np.ndarray]]:
         """obs [L_local, H, W] u8 (history 1) or [L_local, H, W, hist]
@@ -323,11 +339,15 @@ def train_apex_r2d2(cfg: Config, max_frames: Optional[int] = None) -> Dict[str, 
         echo=is_main,
     )
     ckpt = Checkpointer(os.path.join(cfg.checkpoint_dir, cfg.run_id))
+    faults.install_from(cfg)
+    sup = TrainSupervisor(cfg, metrics=metrics)
 
     frames = 0
     last_pub = 0
-    if cfg.resume and ckpt.latest_step() is not None:
-        extra = driver.restore(ckpt)
+    restored = maybe_resume(cfg, ckpt, driver.state)
+    if restored is not None:
+        state, extra, _ = restored
+        driver.load_state(state, extra)
         frames = int(extra.get("frames", 0))
         last_pub = driver.step
         maybe_restore_replay(cfg, memory)
@@ -406,6 +426,10 @@ def train_apex_r2d2(cfg: Config, max_frames: Optional[int] = None) -> Dict[str, 
                         )
                 steps_due = frames // frames_per_step - driver.step
                 for _ in range(max(steps_due, 0)):
+                    sup.snapshot_if_due(
+                        driver.step,
+                        lambda: (host_state(driver.state), driver.key),
+                    )
                     if multihost:
                         if prefetcher is not None:
                             idx, s = prefetcher.get()
@@ -413,17 +437,26 @@ def train_apex_r2d2(cfg: Config, max_frames: Optional[int] = None) -> Dict[str, 
                             s = memory.sample(local_batch, priority_beta(cfg, frames))
                             idx = s.idx
                         info = driver.learn_local(
-                            s,
+                            sup.poison_maybe(s),
                             global_size=len(memory) * nproc,
                             beta=priority_beta(cfg, frames),
                         )
                     elif prefetcher is not None:
                         idx, batch = prefetcher.get()
-                        info = driver.learn_batch(batch)
+                        info = driver.learn_batch(sup.poison_maybe(batch))
                     else:
                         s = memory.sample(local_batch, priority_beta(cfg, frames))
                         idx, batch = s.idx, to_device_seq_batch(s)
-                        info = driver.learn_batch(batch)
+                        info = driver.learn_batch(sup.poison_maybe(batch))
+                    sup.maybe_stall()
+                    if not sup.step_ok(info):
+                        # same all-reduced-loss argument as apex.py: every
+                        # host rolls back together; the sampled sequences
+                        # are quarantined (|TD|=0) so a poisoned one can't
+                        # re-sample into a rollback livelock
+                        memory.update_priorities(idx, np.zeros(len(idx)))
+                        driver.load_snapshot(*sup.rollback())
+                        continue
                     memory.update_priorities(idx, np.asarray(info["priorities"]))
                     step = driver.step
                     if step - last_pub >= cfg.weight_publish_interval:
@@ -447,19 +480,26 @@ def train_apex_r2d2(cfg: Config, max_frames: Optional[int] = None) -> Dict[str, 
                         )
                     if cfg.checkpoint_interval and step % cfg.checkpoint_interval == 0:
                         # collective under jax.distributed: every host joins,
-                        # the primary writes (a p0-only call would hang)
-                        ckpt.save(step, host_state(driver.state),
-                                  {"frames": frames})
-                        save_replay_snapshot(cfg, memory)
+                        # the primary writes (a p0-only call would hang);
+                        # retry decisions are deterministic -> lockstep
+                        sup.save_checkpoint(
+                            ckpt, step, host_state(driver.state),
+                            {"frames": frames, **rng_extra(driver.key)},
+                        )
+                        sup.save_replay(cfg, memory)
     finally:
         if prefetcher is not None:
             prefetcher.close()
+        sup.close()
 
     final_eval = _eval_r2d2_learner(cfg, env, driver) if is_main else {}
     if is_main:
         metrics.log("eval", step=driver.step, **final_eval)
-    ckpt.save(driver.step, host_state(driver.state), {"frames": frames})
-    save_replay_snapshot(cfg, memory)
+    sup.save_checkpoint(
+        ckpt, driver.step, host_state(driver.state),
+        {"frames": frames, **rng_extra(driver.key)}, critical=True,
+    )
+    sup.save_replay(cfg, memory, critical=True)
     ckpt.wait()
     metrics.close()
     return {
@@ -468,5 +508,8 @@ def train_apex_r2d2(cfg: Config, max_frames: Optional[int] = None) -> Dict[str, 
         "lanes": lanes_total,
         "sequences": len(memory),
         "train_return_mean": float(np.mean(returns)) if returns else float("nan"),
+        "rollbacks": sup.rollbacks,
+        "stalls": sup.stalls,
+        "io_faults": sup.io_faults,
         **{f"eval_{k}": v for k, v in final_eval.items()},
     }

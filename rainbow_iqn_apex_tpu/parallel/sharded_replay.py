@@ -38,6 +38,11 @@ class ShardedReplay:
         self.shard_capacity = shards[0].capacity
         self.lanes_per_shard = shards[0].lanes
         self.rng = np.random.default_rng(shards[0].rng.integers(2**31))
+        # graceful degradation: shards marked dead (their host stopped
+        # heartbeating / their backing store is gone) are excluded from
+        # append/sample/write-back so the learner keeps training on the
+        # survivors instead of wedging (docs/RESILIENCE.md)
+        self._dead: set = set()
 
     @classmethod
     def build(
@@ -67,9 +72,13 @@ class ShardedReplay:
         priorities: Optional[np.ndarray] = None,
         truncations: Optional[np.ndarray] = None,
     ) -> None:
-        """Lockstep append of all lanes, block-partitioned across shards."""
+        """Lockstep append of all lanes, block-partitioned across shards.
+        Lanes pinned to a dead shard are dropped (their actor host is gone;
+        the surviving shards keep absorbing their own lanes)."""
         lps = self.lanes_per_shard
         for k, shard in enumerate(self.shards):
+            if k in self._dead:
+                continue
             sl = slice(k * lps, (k + 1) * lps)
             shard.append_batch(
                 frames[sl],
@@ -81,19 +90,42 @@ class ShardedReplay:
             )
 
     def __len__(self) -> int:
-        return sum(len(s) for s in self.shards)
+        return sum(len(s) for k, s in enumerate(self.shards) if k not in self._dead)
 
     @property
     def sampleable(self) -> bool:
-        return all(s.sampleable for s in self.shards)
+        alive = [s for k, s in enumerate(self.shards) if k not in self._dead]
+        return bool(alive) and all(s.sampleable for s in alive)
+
+    # -------------------------------------------------------------- degradation
+    def drop_shard(self, k: int) -> None:
+        """Mark shard ``k`` dead: its lanes stop appending, its contents stop
+        being sampled, priority write-backs to it are dropped.  Idempotent.
+        The learner's sample distribution renormalises over the survivors —
+        exactly what losing one redis-server of a sharded fleet means."""
+        if not 0 <= k < len(self.shards):
+            raise ValueError(f"no shard {k} (have {len(self.shards)})")
+        if len(self._dead) >= len(self.shards) - 1 and k not in self._dead:
+            raise RuntimeError("cannot drop the last surviving replay shard")
+        self._dead.add(k)
+
+    @property
+    def dead_shards(self) -> Tuple[int, ...]:
+        return tuple(sorted(self._dead))
 
     # ------------------------------------------------------------------ sample
     def sample(self, batch_size: int, beta: float) -> SampledBatch:
         """Proportional global sample: shard k contributes ~ its share of the
         total priority mass (multinomial split), then samples locally."""
-        totals = np.asarray([s.tree.total for s in self.shards], np.float64)
+        totals = np.asarray(
+            [
+                0.0 if k in self._dead else s.tree.total
+                for k, s in enumerate(self.shards)
+            ],
+            np.float64,
+        )
         if totals.sum() <= 0:
-            raise ValueError("cannot sample: all shards empty")
+            raise ValueError("cannot sample: all surviving shards empty")
         counts = self.rng.multinomial(batch_size, totals / totals.sum())
         # a zero-count shard simply doesn't contribute this batch (matches
         # multi-redis sampling); the multinomial split makes the overall draw
@@ -139,29 +171,54 @@ class ShardedReplay:
     # -------------------------------------------------------------- snapshot
     def snapshot(self, path_prefix: str) -> None:
         """One npz per shard (the per-host persistence unit in the pod
-        picture, mirroring per-redis-instance RDB files)."""
+        picture, mirroring per-redis-instance RDB files) plus a tiny meta
+        file carrying the shard-split RNG, so a resumed learner draws the
+        same shard mix the uninterrupted run would have."""
+        import json
+
+        from rainbow_iqn_apex_tpu.replay import snapshot_io
+
         for k, shard in enumerate(self.shards):
             shard.snapshot(f"{path_prefix}_shard{k}")
+        snapshot_io.atomic_savez(
+            f"{path_prefix}_meta",
+            rng_state=np.frombuffer(
+                json.dumps(self.rng.bit_generator.state).encode(), np.uint8
+            ),
+        )
 
     def restore(self, path_prefix: str) -> None:
+        import json
         import os
 
         from rainbow_iqn_apex_tpu.replay import snapshot_io
 
-        # check the whole shard set up front so a kill that landed between
-        # shard writes reads as "no snapshot" instead of a half-restored mix
+        # check the whole shard set up front — existence AND CRC — so a kill
+        # that landed between shard writes, or one torn shard file, reads as
+        # "no snapshot" instead of a half-restored mix.  The verified
+        # payloads are applied directly (one disk read per shard, not two).
         paths = [f"{path_prefix}_shard{k}" for k in range(len(self.shards))]
         for p in paths:
             if not os.path.exists(snapshot_io.npz_path(p)):
                 raise FileNotFoundError(snapshot_io.npz_path(p))
-        for shard, p in zip(self.shards, paths):
-            shard.restore(p)
+        payloads = [snapshot_io.load(p) for p in paths]  # SnapshotCorrupt here
+        for shard, z in zip(self.shards, payloads):
+            shard.apply_snapshot(z)
+        try:  # pre-resilience snapshots carry no meta file
+            meta = snapshot_io.load(f"{path_prefix}_meta")
+            self.rng.bit_generator.state = json.loads(
+                np.asarray(meta["rng_state"], np.uint8).tobytes().decode()
+            )
+        except snapshot_io.MISSING:
+            pass
 
     # -------------------------------------------------------------- priorities
     def update_priorities(self, idx: np.ndarray, td_abs: np.ndarray) -> None:
         shard_of = idx // self.shard_capacity
         local = idx % self.shard_capacity
         for k, shard in enumerate(self.shards):
+            if k in self._dead:
+                continue  # write-backs racing a shard death are dropped
             m = shard_of == k
             if m.any():
                 shard.update_priorities(local[m], td_abs[m])

@@ -326,6 +326,8 @@ class PrioritizedReplay:
             self._snapshot_locked(path)
 
     def _snapshot_locked(self, path: str) -> None:
+        import json
+
         from rainbow_iqn_apex_tpu.replay import snapshot_io
 
         snapshot_io.atomic_savez(
@@ -339,12 +341,22 @@ class PrioritizedReplay:
             pos=self.pos,
             filled=self.filled,
             max_priority=self.max_priority,
+            # sampler RNG state: exact resume must replay the SAME batch the
+            # uninterrupted run would have drawn (preemption-safe resume)
+            rng_state=np.frombuffer(
+                json.dumps(self.rng.bit_generator.state).encode(), np.uint8
+            ),
         )
 
     def restore(self, path: str) -> None:
         from rainbow_iqn_apex_tpu.replay import snapshot_io
 
-        z = snapshot_io.load(path)
+        self.apply_snapshot(snapshot_io.load(path))
+
+    def apply_snapshot(self, z) -> None:
+        """Apply an already-loaded (and CRC-verified) snapshot payload —
+        lets ShardedReplay verify every shard first and apply without
+        re-reading the files."""
         if z["frames"].shape != self.frames.shape:
             raise ValueError(
                 f"snapshot shape {z['frames'].shape} != buffer {self.frames.shape}"
@@ -359,6 +371,12 @@ class PrioritizedReplay:
         self.pos = int(z["pos"])
         self.filled = int(z["filled"])
         self.max_priority = float(z["max_priority"])
+        if "rng_state" in z.files:  # pre-resilience snapshots carry no RNG
+            import json
+
+            self.rng.bit_generator.state = json.loads(
+                np.asarray(z["rng_state"], np.uint8).tobytes().decode()
+            )
 
     # -------------------------------------------------------------- priorities
     def update_priorities(self, idx: np.ndarray, td_abs: np.ndarray) -> None:

@@ -27,6 +27,7 @@ import numpy as np
 from rainbow_iqn_apex_tpu.config import Config
 from rainbow_iqn_apex_tpu.ops.learn import TrainState, init_train_state
 from rainbow_iqn_apex_tpu.utils.checkpoint import Checkpointer
+from rainbow_iqn_apex_tpu.utils.faults import FailureBudget
 
 
 def params_template(
@@ -74,7 +75,9 @@ class CheckpointWatcher:
         self.metrics = metrics
         self.max_restore_failures = int(max_restore_failures)
         self.last_step: Optional[int] = None
-        self._fail_counts: Dict[int, int] = {}  # step -> restore failures
+        # the shared bounded-failure policy (utils/faults.py): training's
+        # supervisor and the serving hot-swap count strikes the same way
+        self._budget = FailureBudget(max_restore_failures)
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
         self._swap_lock = threading.Lock()  # one restore at a time
@@ -90,8 +93,7 @@ class CheckpointWatcher:
             target = self.ckpt.refresh() if step is None else step
             if target is None:
                 return {"ok": False, "reason": "no_checkpoint"}
-            failures = self._fail_counts.get(target, 0)
-            if failures >= self.max_restore_failures and not force:
+            if self._budget.poisoned(target) and not force:
                 return {"ok": False, "step": target, "reason": "poisoned"}
             if target == self.last_step and not force:
                 return {"ok": True, "step": target, "reason": "already_loaded"}
@@ -99,11 +101,10 @@ class CheckpointWatcher:
                 params = restore_params(self.ckpt, self.template, step=target)
                 version = self.swap_fn(params)
             except Exception as e:  # torn/corrupt file: keep serving old params
-                self._fail_counts[target] = failures + 1
                 event = {
                     "ok": False,
                     "step": target,
-                    "failures": failures + 1,
+                    "failures": self._budget.record(target),
                     "reason": f"{type(e).__name__}: {e}"[:200],
                 }
                 if self.metrics is not None:
@@ -111,7 +112,7 @@ class CheckpointWatcher:
                 return event
             self.last_step = target
             # a recovered step (forced or retried) is whole again — un-poison
-            self._fail_counts.pop(target, None)
+            self._budget.clear(target)
             event = {"ok": True, "step": target, "params_version": version}
             if self.metrics is not None:
                 self.metrics.record_swap(**event)

@@ -22,10 +22,13 @@ from rainbow_iqn_apex_tpu.config import Config
 from rainbow_iqn_apex_tpu.envs import make_vector_env
 from rainbow_iqn_apex_tpu.eval import evaluate
 from rainbow_iqn_apex_tpu.replay.buffer import PrioritizedReplay
+from rainbow_iqn_apex_tpu.utils import faults
 from rainbow_iqn_apex_tpu.utils.checkpoint import (
     Checkpointer,
     maybe_restore_replay,
-    save_replay_snapshot,
+    maybe_resume,
+    rng_extra,
+    rng_from_extra,
 )
 from rainbow_iqn_apex_tpu.utils.logging import MetricsLogger
 
@@ -64,11 +67,20 @@ def train(cfg: Config, max_frames: Optional[int] = None) -> Dict[str, Any]:
     run_dir = os.path.join(cfg.results_dir, cfg.run_id)
     metrics = MetricsLogger(os.path.join(run_dir, "metrics.jsonl"), cfg.run_id)
     ckpt = Checkpointer(os.path.join(cfg.checkpoint_dir, cfg.run_id))
+    faults.install_from(cfg)
+    # deferred: the parallel package's __init__ imports the apex drivers,
+    # which import THIS module (priority_beta) — a module-level import here
+    # would be circular for `--role single` entry
+    from rainbow_iqn_apex_tpu.parallel.supervisor import TrainSupervisor
+
+    sup = TrainSupervisor(cfg, metrics=metrics)
 
     frames = 0
-    if cfg.resume and ckpt.latest_step() is not None:
-        agent.state, extra = ckpt.restore(agent.state)
+    restored = maybe_resume(cfg, ckpt, agent.state)
+    if restored is not None:
+        agent.state, extra, _ = restored
         frames = int(extra.get("frames", 0))
+        agent.key = rng_from_extra(extra, agent.key)
         maybe_restore_replay(cfg, memory)
         metrics.log("resume", step=agent.step, frames=frames)
 
@@ -104,13 +116,26 @@ def train(cfg: Config, max_frames: Optional[int] = None) -> Dict[str, Any]:
                     )
                 steps_due = frames // cfg.replay_ratio - agent.step
                 for _ in range(max(steps_due, 0)):
+                    sup.snapshot_if_due(
+                        agent.step, lambda: (agent.state, agent.key)
+                    )
                     if prefetcher is not None:
                         idx, batch = prefetcher.get()
-                        info = agent.learn_batch(batch)
+                        info = agent.learn_batch(sup.poison_maybe(batch))
                     else:
                         sample = memory.sample(cfg.batch_size, priority_beta(cfg, frames))
                         idx = sample.idx
-                        info = agent.learn(sample)
+                        info = agent.learn(sup.poison_maybe(sample))
+                    sup.maybe_stall()
+                    if not sup.step_ok(info):
+                        # non-finite step: quarantine the sampled rows
+                        # (|TD|=0 -> eps^omega priority, so a genuinely
+                        # poisoned transition at max_priority can't be
+                        # re-sampled into a rollback livelock), then roll
+                        # params/opt/RNG back to last-good
+                        memory.update_priorities(idx, np.zeros(len(idx)))
+                        agent.load_snapshot(*sup.rollback())
+                        continue
                     memory.update_priorities(idx, np.asarray(info["priorities"]))
 
                     step = agent.step
@@ -129,20 +154,30 @@ def train(cfg: Config, max_frames: Optional[int] = None) -> Dict[str, Any]:
                         last_eval = evaluate(cfg, agent, seed=cfg.seed + 977)
                         metrics.log("eval", step=step, **last_eval)
                     if cfg.checkpoint_interval and step % cfg.checkpoint_interval == 0:
-                        ckpt.save(step, agent.state, {"frames": frames})
-                        save_replay_snapshot(cfg, memory)
+                        sup.save_checkpoint(
+                            ckpt, step, agent.state,
+                            {"frames": frames, **rng_extra(agent.key)},
+                        )
+                        sup.save_replay(cfg, memory)
     finally:
         if prefetcher is not None:
             prefetcher.close()
+        sup.close()
     final_eval = evaluate(cfg, agent, seed=cfg.seed + 977)
     metrics.log("eval", step=agent.step, **final_eval)
-    ckpt.save(agent.step, agent.state, {"frames": frames})
-    save_replay_snapshot(cfg, memory)
+    sup.save_checkpoint(
+        ckpt, agent.step, agent.state,
+        {"frames": frames, **rng_extra(agent.key)}, critical=True,
+    )
+    sup.save_replay(cfg, memory, critical=True)
     ckpt.wait()
     metrics.close()
     return {
         "frames": frames,
         "learn_steps": agent.step,
         "train_return_mean": float(np.mean(returns)) if returns else float("nan"),
+        "rollbacks": sup.rollbacks,
+        "stalls": sup.stalls,
+        "io_faults": sup.io_faults,
         **{f"eval_{k}": v for k, v in final_eval.items()},
     }

@@ -37,7 +37,7 @@ from rainbow_iqn_apex_tpu.ops.learn import build_act_step, init_train_state
 from rainbow_iqn_apex_tpu.parallel.multihost import shift_stack
 from rainbow_iqn_apex_tpu.replay.device import DeviceReplay, build_device_learn
 from rainbow_iqn_apex_tpu.train import priority_beta
-from rainbow_iqn_apex_tpu.utils.checkpoint import Checkpointer
+from rainbow_iqn_apex_tpu.utils.checkpoint import Checkpointer, maybe_resume
 from rainbow_iqn_apex_tpu.utils.logging import MetricsLogger
 
 
@@ -129,8 +129,9 @@ def train_anakin(cfg: Config, max_frames: Optional[int] = None) -> Dict[str, Any
 
     frames = 0
     ticks = 0
-    if cfg.resume and ckpt.latest_step() is not None:
-        ts, extra = ckpt.restore(ts)
+    restored = maybe_resume(cfg, ckpt, ts)
+    if restored is not None:
+        ts, extra, _ = restored
         frames = int(extra.get("frames", 0))
         ds, ticks = _maybe_restore_replay(cfg, ds)
         metrics.log("resume", step=int(ts.step), frames=frames)
@@ -431,8 +432,9 @@ def train_anakin_fused(cfg: Config, max_frames: Optional[int] = None) -> Dict[st
 
     frames = 0
     ds = replay.init_state()
-    if cfg.resume and ckpt.latest_step() is not None:
-        ts, extra = ckpt.restore(ts)
+    restored = maybe_resume(cfg, ckpt, ts)
+    if restored is not None:
+        ts, extra, _ = restored
         frames = int(extra.get("frames", 0))
         # replay snapshot only on an actual resume (host-path parity): a
         # fresh run with the same run_id must cold-start its ring

@@ -49,7 +49,7 @@ from rainbow_iqn_apex_tpu.replay.device_sequence import (
     build_device_r2d2_learn,
 )
 from rainbow_iqn_apex_tpu.train import priority_beta
-from rainbow_iqn_apex_tpu.utils.checkpoint import Checkpointer
+from rainbow_iqn_apex_tpu.utils.checkpoint import Checkpointer, maybe_resume
 from rainbow_iqn_apex_tpu.utils.logging import MetricsLogger
 
 
@@ -331,8 +331,9 @@ def train_anakin_r2d2(cfg: Config,
 
     frames = 0
     ss = ss0
-    if cfg.resume and ckpt.latest_step() is not None:
-        ts, extra = ckpt.restore(ts)
+    restored = maybe_resume(cfg, ckpt, ts)
+    if restored is not None:
+        ts, extra, _ = restored
         frames = int(extra.get("frames", 0))
         ss = _maybe_restore_replay(cfg, ss)
         metrics.log("resume", step=int(ts.step), frames=frames)
@@ -453,8 +454,9 @@ def _train_anakin_r2d2_hostfed(cfg: Config,
 
     frames = 0
     ss = replay.init_state()
-    if cfg.resume and ckpt.latest_step() is not None:
-        ts, extra = ckpt.restore(ts)
+    restored = maybe_resume(cfg, ckpt, ts)
+    if restored is not None:
+        ts, extra, _ = restored
         frames = int(extra.get("frames", 0))
         ss = _maybe_restore_replay(cfg, ss)
         metrics.log("resume", step=int(ts.step), frames=frames)

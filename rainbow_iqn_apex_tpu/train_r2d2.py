@@ -31,6 +31,8 @@ from rainbow_iqn_apex_tpu.train import priority_beta
 from rainbow_iqn_apex_tpu.utils.checkpoint import (
     Checkpointer,
     maybe_restore_replay,
+    maybe_resume,
+    rng_from_extra,
     save_replay_snapshot,
 )
 from rainbow_iqn_apex_tpu.utils.logging import MetricsLogger
@@ -145,9 +147,11 @@ def train_r2d2(cfg: Config, max_frames: Optional[int] = None) -> Dict[str, Any]:
     ckpt = Checkpointer(os.path.join(cfg.checkpoint_dir, cfg.run_id))
 
     frames = 0
-    if cfg.resume and ckpt.latest_step() is not None:
-        agent.state, extra = ckpt.restore(agent.state)
+    restored = maybe_resume(cfg, ckpt, agent.state)
+    if restored is not None:
+        agent.state, extra, _ = restored
         frames = int(extra.get("frames", 0))
+        agent.key = rng_from_extra(extra, agent.key)
         maybe_restore_replay(cfg, memory)
         metrics.log("resume", step=agent.step, frames=frames)
 

@@ -17,6 +17,11 @@ import numpy as np
 import orbax.checkpoint as ocp
 
 from rainbow_iqn_apex_tpu.ops.learn import TrainState
+from rainbow_iqn_apex_tpu.utils import faults
+
+
+class CheckpointWriteError(IOError):
+    """Injected/observed checkpoint write failure (utils/faults.py)."""
 
 
 class Checkpointer:
@@ -31,6 +36,23 @@ class Checkpointer:
         )
 
     def save(self, step: int, state: TrainState, extra: Optional[Dict[str, Any]] = None) -> None:
+        # Crash-safety: drain the previous async save BEFORE starting this
+        # one.  Orbax prunes past max_to_keep as part of save; if a prior
+        # save were still in flight, a crash here could leave the newest
+        # step torn while the pruned step is already gone — waiting first
+        # guarantees at least one fully-committed checkpoint survives any
+        # single crash point.
+        self._mngr.wait_until_finished()
+        if step in self._mngr.all_steps():
+            # A NaN-guard rollback can replay the loop back over a step that
+            # already checkpointed; the existing save is a valid consistent
+            # cut (state + RNG + frames from one instant), and re-saving the
+            # same step would raise StepAlreadyExistsError inside Orbax.
+            return
+        if faults.get().fire("checkpoint_write"):
+            raise CheckpointWriteError(
+                f"injected checkpoint write failure at step {step}"
+            )
         self._mngr.save(
             step,
             args=ocp.args.Composite(
@@ -44,6 +66,45 @@ class Checkpointer:
 
     def latest_step(self) -> Optional[int]:
         return self._mngr.latest_step()
+
+    def all_steps(self) -> Tuple[int, ...]:
+        return tuple(self._mngr.all_steps())
+
+    # ------------------------------------------------------------- integrity
+    def latest_valid_step(
+        self, abstract_state: Optional[TrainState] = None
+    ) -> Optional[int]:
+        """Newest step whose checkpoint actually restores, scanning PAST
+        corrupt ones (torn writes, bit rot) instead of crashing on them.
+
+        With ``abstract_state`` the validation is a full params restore (the
+        only honest check — Orbax's commit markers can't see post-commit
+        corruption); without it only the JSON side-car is validated (cheap,
+        catches truncated step dirs but not every torn params file).
+        """
+        out = self._restore_newest_valid(abstract_state)
+        return None if out is None else out[2]
+
+    def restore_latest_valid(
+        self, abstract_state: TrainState
+    ) -> Optional[Tuple[TrainState, Dict[str, Any], int]]:
+        """(state, extra, step) from the newest restorable checkpoint, or
+        None when no step restores.  One descending pass: validation IS the
+        restore, so the winner is never read twice."""
+        out = self._restore_newest_valid(abstract_state)
+        return None if out is None or out[0] is None else out
+
+    def _restore_newest_valid(self, abstract_state: Optional[TrainState]):
+        for step in sorted(self._mngr.all_steps(), reverse=True):
+            try:
+                if abstract_state is None:
+                    extra = self.restore_extra(step)
+                    return None, extra, step
+                state, extra = self.restore(abstract_state, step=step)
+                return state, extra, step
+            except Exception:  # corrupt/torn step: fall back to the previous
+                continue
+        return None
 
     def refresh(self) -> Optional[int]:
         """Re-read the step list from disk and return the latest step.
@@ -115,8 +176,9 @@ def save_replay_snapshot(cfg, memory) -> None:
 
 def maybe_restore_replay(cfg, memory) -> bool:
     """Restore a replay snapshot if a usable one exists; returns whether it
-    did.  Missing or torn files (kill mid-write, pre-atomic era) degrade to
-    a cold replay; genuine mismatches (wrong shapes) still raise."""
+    did.  Missing, torn, or CRC-failing files (kill mid-write, disk
+    corruption) degrade to a cold replay; genuine mismatches (wrong shapes)
+    still raise."""
     from rainbow_iqn_apex_tpu.replay import snapshot_io
 
     if not cfg.snapshot_replay:
@@ -126,3 +188,77 @@ def maybe_restore_replay(cfg, memory) -> bool:
         return True
     except snapshot_io.MISSING:
         return False
+
+
+# ------------------------------------------------------------------- resume
+def resume_mode(resume) -> str:
+    """Normalise Config.resume (legacy bool or string flag) to one of
+    ``"off"`` | ``"latest"`` | ``"auto"``.
+
+    ``latest`` is the pre-resilience behaviour: restore the newest step and
+    raise if it is corrupt.  ``auto`` is preemption-safe: restore the newest
+    step that VALIDATES, falling back past corrupt ones, and start fresh
+    when nothing restores — the mode an auto-restarting scheduler should use.
+    """
+    if isinstance(resume, bool):
+        return "latest" if resume else "off"
+    text = str(resume).strip().lower()
+    if text in ("", "0", "false", "no", "off", "none"):
+        return "off"
+    if text == "auto":
+        return "auto"
+    if text in ("true", "1", "yes", "on", "latest"):
+        return "latest"
+    # a typo'd mode silently meaning "strict" would crash-loop the exact
+    # preemption case "auto" exists for — refuse loudly instead
+    raise ValueError(
+        f"unrecognised resume mode {resume!r} (want ''/false, true, or auto)"
+    )
+
+
+def maybe_resume(
+    cfg, ckpt: Checkpointer, abstract_state
+) -> Optional[Tuple[Any, Dict[str, Any], int]]:
+    """The one resume gate every train loop shares: returns
+    (state, extra, step) when cfg.resume asks for a restart and a usable
+    checkpoint exists, else None."""
+    mode = resume_mode(cfg.resume)
+    if mode == "off":
+        return None
+    if mode == "auto":
+        out = ckpt.restore_latest_valid(abstract_state)
+        if out is None and ckpt.all_steps():
+            # Checkpoints EXIST but none restores.  That is either a fully
+            # corrupt set or (more likely) a model-config change that no
+            # longer matches the saved shapes — silently reinitialising
+            # would discard the whole run, so refuse and make the operator
+            # decide (delete the run dir, or fix the config).
+            raise RuntimeError(
+                f"--resume auto: {len(ckpt.all_steps())} checkpoint step(s) "
+                f"under {ckpt.directory} but none restores into this run's "
+                "state (all corrupt, or the model config changed); refusing "
+                "to silently start fresh — remove the checkpoint dir to "
+                "really restart from scratch"
+            )
+        return out
+    if ckpt.latest_step() is None:
+        return None
+    state, extra = ckpt.restore(abstract_state)
+    return state, extra, int(ckpt.latest_step())
+
+
+# ------------------------------------------------------------ RNG side-car
+def rng_extra(key) -> Dict[str, Any]:
+    """Serialise a jax PRNG key into checkpoint 'extra' JSON, so resume can
+    continue the exact tau/noise/action sample stream (preemption-safe
+    resume must be numerically identical, not just statistically)."""
+    return {"rng_key": [int(x) for x in np.asarray(key).ravel().tolist()]}
+
+
+def rng_from_extra(extra: Dict[str, Any], fallback):
+    """The saved key, or ``fallback`` for pre-resilience checkpoints."""
+    if not extra or "rng_key" not in extra:
+        return fallback
+    import jax.numpy as jnp
+
+    return jnp.asarray(extra["rng_key"], dtype=jnp.uint32)

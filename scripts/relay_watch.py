@@ -182,6 +182,53 @@ def probe_with_retry() -> dict:
     return res
 
 
+# Chaos/soak attribution (docs/RESILIENCE.md): a phase that died because a
+# checkpoint or replay snapshot came up corrupt is a RESILIENCE finding (the
+# recovery path failed), while a phase that simply outran its budget is a
+# scheduling finding.  Soak rows must not conflate them — a chaos-run
+# postmortem that reads "timeout" for a CRC failure hunts the wrong bug.
+CKPT_CORRUPT_SIGNATURES = (
+    "SnapshotCorrupt",       # replay/snapshot_io.py CRC failure
+    "CheckpointWriteError",  # utils/checkpoint.py write-path failure
+    "BadZipFile",            # torn npz below the CRC layer
+    "crc32",                 # raw CRC mismatch text
+    "checkpoint is corrupt",
+)
+TIMEOUT_SIGNATURES = ("PROBE_TIMEOUT", "TimeoutError", "DEADLINE_EXCEEDED")
+
+
+def classify_phase(rc: int, tail: str) -> str:
+    """Explicit cause for a phase outcome:
+
+      ok             phase exited clean
+      ckpt_corrupt   a checkpoint/replay-snapshot integrity failure killed it
+                     (chaos-run attribution: the recovery path is the story)
+      timeout        the phase outran a budget (SIGALRM text, timeout rc 124,
+                     or a kill-by-signal rc)
+      error          anything else (the tail says what)
+    """
+    if rc == 0:
+        return "ok"
+    if any(sig in tail for sig in CKPT_CORRUPT_SIGNATURES):
+        return "ckpt_corrupt"
+    if rc == 124 or rc < 0 or rc == 137 or any(
+        sig in tail for sig in TIMEOUT_SIGNATURES
+    ):
+        return "timeout"
+    return "error"
+
+
+def _tail_of(path: str, n: int = 4000) -> str:
+    try:
+        with open(path, "rb") as f:
+            f.seek(0, os.SEEK_END)
+            size = f.tell()
+            f.seek(max(size - n, 0))
+            return f.read().decode(errors="replace")
+    except OSError:
+        return ""
+
+
 def run_phase(name: str, argv, out_name: str, extra_env=None,
               strip_platform_pin: bool = True) -> int:
     """Run one capture phase, stdout -> results/relay_watch/<out_name>,
@@ -205,11 +252,13 @@ def run_phase(name: str, argv, out_name: str, extra_env=None,
         while p.poll() is None:
             time.sleep(30)
     dt = time.monotonic() - t0
+    cause = classify_phase(p.returncode,
+                           _tail_of(err_path) + _tail_of(out_path))
     log_event(event="phase_done", phase=name, rc=p.returncode,
-              elapsed_s=round(dt, 1))
+              elapsed_s=round(dt, 1), cause=cause)
     git_commit([out_path, err_path, LOG],
                f"relay_watch: {name} captured on live TPU window "
-               f"(rc={p.returncode}, {dt:.0f}s)")
+               f"(rc={p.returncode}, {dt:.0f}s, cause={cause})")
     return p.returncode
 
 

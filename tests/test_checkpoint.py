@@ -92,3 +92,72 @@ def test_restore_missing_checkpoint_raises_filenotfound(tmp_path):
         ckpt.restore(params_template(CFG, A))
     with pytest.raises(FileNotFoundError):
         ckpt.restore_extra()
+
+
+def test_torn_write_latest_valid_step_falls_back(tmp_path):
+    """A torn write on the newest step (host died mid-flush, or post-commit
+    corruption) must not strand resume: latest_valid_step skips past it to
+    the previous whole checkpoint, and restore_latest_valid hands back that
+    step's exact tree."""
+    ckpt = Checkpointer(str(tmp_path))
+    state = init_train_state(CFG, A, jax.random.PRNGKey(0))
+    newer = state.replace(
+        params=jax.tree.map(lambda x: x + 3.0, state.params)
+    )
+    ckpt.save(0, state, extra={"frames": 1})
+    ckpt.save(5, newer, extra={"frames": 5})
+    ckpt.wait()
+
+    # tear the newest step: truncate every file under it
+    torn = 0
+    for root, _, files in os.walk(os.path.join(str(tmp_path), "5")):
+        for f in files:
+            open(os.path.join(root, f), "w").close()
+            torn += 1
+    assert torn > 0
+
+    template = params_template(CFG, A)
+    assert ckpt.latest_step() == 5  # the directory listing still says 5
+    assert ckpt.latest_valid_step(template) == 0  # integrity disagrees
+    out = ckpt.restore_latest_valid(template)
+    assert out is not None
+    restored, extra, step = out
+    assert step == 0 and extra == {"frames": 1}
+    _assert_trees_equal(restored.params, state.params)
+
+
+def test_resaving_an_existing_step_is_a_noop(tmp_path):
+    """A NaN-guard rollback can replay the loop over a step that already
+    checkpointed; the second save must not raise (Orbax would throw
+    StepAlreadyExistsError) and the original cut stays intact."""
+    ckpt = Checkpointer(str(tmp_path))
+    state = init_train_state(CFG, A, jax.random.PRNGKey(0))
+    ckpt.save(3, state, extra={"frames": 33})
+    ckpt.wait()
+    mutated = state.replace(
+        params=jax.tree.map(lambda x: x + 1.0, state.params)
+    )
+    ckpt.save(3, mutated, extra={"frames": 99})  # revisited after rollback
+    ckpt.wait()
+    restored, extra = ckpt.restore(params_template(CFG, A), step=3)
+    assert extra == {"frames": 33}  # the first consistent cut won
+    _assert_trees_equal(restored.params, state.params)
+
+
+def test_save_drains_previous_before_pruning(tmp_path):
+    """Crash-safety of the save schedule: each save waits for the previous
+    async save to commit before Orbax prunes past max_to_keep, so at every
+    instant at least one fully-committed checkpoint exists on disk."""
+    ckpt = Checkpointer(str(tmp_path), max_to_keep=2)
+    state = init_train_state(CFG, A, jax.random.PRNGKey(0))
+    for step in range(5):  # more saves than max_to_keep, no explicit wait
+        ckpt.save(step, state, extra={"frames": step})
+        # the PREVIOUS step is always fully committed at this point
+        if step > 0:
+            assert not os.path.exists(
+                os.path.join(str(tmp_path), str(step - 1))
+            ) or ckpt.restore_extra(step - 1) == {"frames": step - 1}
+    ckpt.wait()
+    kept = sorted(ckpt.all_steps())
+    assert kept == [3, 4]
+    assert ckpt.restore_extra(4) == {"frames": 4}

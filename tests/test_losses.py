@@ -73,3 +73,63 @@ def test_batch_independence():
     loss, _ = quantile_huber_loss(online, taus, target, kappa=1.0)
     assert loss.shape == (2,)
     np.testing.assert_allclose(loss, [0.25, 0.25], atol=1e-7)
+
+
+# ---------------------------------------------------------------------------
+# NaN/Inf propagation under extreme inputs (ISSUE 2 satellite): the
+# supervisor's NaN guard (parallel/supervisor.py) keys off the loss scalar,
+# so these pin down exactly WHICH extremes produce a non-finite loss — the
+# guard's known triggers — and which stay finite (no false alarms).
+def test_inf_reward_propagates_to_nonfinite_loss_and_priority():
+    """An inf reward makes the td_target inf -> u inf -> loss and |TD| both
+    non-finite.  This is the canonical guard trigger: the rollback fires AND
+    the poisoned priority never reaches the sum-tree (the write-back is
+    skipped on a failed step)."""
+    online = jnp.array([[0.0, 1.0]])
+    taus = jnp.array([[0.25, 0.75]])
+    target = jnp.array([[jnp.inf, 2.0]])  # r = +inf
+    loss, td_abs = quantile_huber_loss(online, taus, target, kappa=1.0)
+    assert not bool(jnp.isfinite(loss).all())
+    assert not bool(jnp.isfinite(td_abs).all())
+
+    # -inf bootstraps trigger identically
+    loss_n, td_n = quantile_huber_loss(
+        online, taus, jnp.array([[-jnp.inf, 0.0]]), kappa=1.0
+    )
+    assert not bool(jnp.isfinite(loss_n).all())
+    assert not bool(jnp.isfinite(td_n).all())
+
+
+def test_nan_target_poisons_every_pair():
+    loss, td_abs = quantile_huber_loss(
+        jnp.array([[0.0, 1.0]]),
+        jnp.array([[0.25, 0.75]]),
+        jnp.array([[jnp.nan, 2.0]]),
+        kappa=1.0,
+    )
+    assert bool(jnp.isnan(loss).all())
+    assert bool(jnp.isnan(td_abs).all())
+
+
+def test_zero_and_one_taus_stay_finite():
+    """Degenerate tau draws (0 and 1 exactly — the fp edge of uniform
+    sampling) must NOT trip the guard: the |tau - indicator| weight hits 0/1
+    but nothing divides by tau, so the loss stays finite."""
+    online = jnp.array([[0.0, 1.0]])
+    taus = jnp.array([[0.0, 1.0]])
+    target = jnp.array([[0.5, 2.0]])
+    loss, td_abs = quantile_huber_loss(online, taus, target, kappa=1.0)
+    assert bool(jnp.isfinite(loss).all())
+    assert bool(jnp.isfinite(td_abs).all())
+    assert float(loss[0]) >= 0.0
+
+
+def test_extreme_magnitude_rewards_stay_finite():
+    """SABER-uncapped reward scales (1e30) overflow nothing in fp32's huber
+    LINEAR branch; the guard only fires on genuine inf/nan."""
+    online = jnp.array([[0.0]])
+    taus = jnp.array([[0.5]])
+    target = jnp.array([[1e30]])
+    loss, td_abs = quantile_huber_loss(online, taus, target, kappa=1.0)
+    assert bool(jnp.isfinite(loss).all())
+    assert bool(jnp.isfinite(td_abs).all())

@@ -145,3 +145,57 @@ def test_known_dead_relay_signature_not_retried(watch, monkeypatch):
     attempts = _probe_seq(watch, monkeypatch, [UNAVAIL, UNAVAIL])
     res = watch.probe_with_retry()
     assert len(attempts) == 1 and res["cause"] == "relay_unavailable"
+
+
+# ---------------------------------------------------------------------------
+# Phase-failure classification (ISSUE 2 satellite): chaos-run soak failures
+# must be attributed correctly — a checkpoint-corruption death is a
+# resilience finding, a budget overrun is a scheduling finding, and the two
+# must never be conflated in watch.jsonl.
+@pytest.mark.parametrize(
+    "rc,tail,expected",
+    [
+        (0, "", "ok"),
+        (0, "SnapshotCorrupt: crc32 mismatch", "ok"),  # rc wins: it finished
+        (1, "rainbow_iqn_apex_tpu.replay.snapshot_io.SnapshotCorrupt: "
+            "replay.npz: crc32 0x1 != recorded 0x2", "ckpt_corrupt"),
+        (1, "CheckpointWriteError: injected checkpoint write failure",
+         "ckpt_corrupt"),
+        (1, "zipfile.BadZipFile: File is not a zip file", "ckpt_corrupt"),
+        (124, "", "timeout"),  # GNU timeout's exit code
+        (137, "", "timeout"),  # SIGKILL'd by a budget enforcer
+        (-9, "", "timeout"),
+        (9, "PROBE_TIMEOUT after 2700s", "timeout"),
+        (1, "TimeoutError: prefetch worker produced nothing for 60.0s",
+         "timeout"),
+        (1, "ValueError: snapshot shape (8,) != buffer (16,)", "error"),
+        (2, "", "error"),
+    ],
+)
+def test_classify_phase(watch, rc, tail, expected):
+    assert watch.classify_phase(rc, tail) == expected
+
+
+def test_phase_done_rows_carry_cause(watch, monkeypatch, tmp_path):
+    """run_phase logs a classified cause (from the phase's stderr tail) so
+    the soak harness can attribute failures without re-reading artifacts."""
+    rows = []
+    monkeypatch.setattr(watch, "log_event", lambda **row: rows.append(row))
+
+    class FakeProc:
+        returncode = 1
+
+        def poll(self):
+            return 1
+
+    def fake_popen(argv, cwd=None, env=None, stdout=None, stderr=None,
+                   text=None):
+        stderr.write("raise SnapshotCorrupt: crc32 0xdead != recorded 0xbeef\n")
+        stderr.flush()
+        return FakeProc()
+
+    monkeypatch.setattr(watch.subprocess, "Popen", fake_popen)
+    rc = watch.run_phase("bench", ["true"], "bench.out")
+    assert rc == 1
+    done = [r for r in rows if r.get("event") == "phase_done"]
+    assert done and done[0]["cause"] == "ckpt_corrupt"

@@ -1,0 +1,596 @@
+"""Chaos suite: every named fault-injection point, end to end.
+
+Acceptance (ISSUE 2): a NaN step triggers rollback-and-continue with finite
+loss afterward; a corrupt latest checkpoint resumes from the previous valid
+one; a torn replay snapshot is detected by CRC and skipped; an injected
+checkpoint write failure is retried under the shared backoff policy; an
+injected stall trips the watchdog; a lost heartbeat is reported as a dead
+host; and a kill-then-``--resume auto`` run produces a learn step
+numerically identical to the uninterrupted baseline.
+
+Everything here is tier-1 (fast, not `slow`); the `chaos` marker also lets
+`make chaos-smoke` run just this surface.
+"""
+
+import json
+import os
+
+import jax
+import numpy as np
+import pytest
+
+from rainbow_iqn_apex_tpu.config import Config
+from rainbow_iqn_apex_tpu.parallel.multihost import (
+    HeartbeatMonitor,
+    HeartbeatWriter,
+)
+from rainbow_iqn_apex_tpu.parallel.sharded_replay import ShardedReplay
+from rainbow_iqn_apex_tpu.parallel.supervisor import (
+    StallWatchdog,
+    TrainAborted,
+    TrainSupervisor,
+)
+from rainbow_iqn_apex_tpu.replay import snapshot_io
+from rainbow_iqn_apex_tpu.replay.buffer import PrioritizedReplay
+from rainbow_iqn_apex_tpu.utils import faults
+from rainbow_iqn_apex_tpu.utils.checkpoint import (
+    Checkpointer,
+    maybe_restore_replay,
+    maybe_resume,
+    replay_snapshot_path,
+    resume_mode,
+    rng_extra,
+    rng_from_extra,
+    save_replay_snapshot,
+)
+
+pytestmark = pytest.mark.chaos
+
+
+@pytest.fixture(autouse=True)
+def _disarm_faults():
+    """No chaos leaks into the rest of the suite."""
+    yield
+    faults.install(None)
+
+
+# ---------------------------------------------------------------- injector
+def test_fault_injector_spec_and_determinism():
+    inj = faults.FaultInjector("nan_loss@2,nan_loss@4,checkpoint_write@1")
+    assert [inj.fire("nan_loss") for _ in range(5)] == [
+        False, True, False, True, False,
+    ]
+    assert inj.fire("checkpoint_write") is True
+    assert inj.fire("checkpoint_write") is False
+    assert inj.fired("nan_loss") == 2 and inj.calls("nan_loss") == 5
+
+    # probability mode replays exactly under the same seed
+    a = faults.FaultInjector("heartbeat_loss:0.5", seed=7)
+    b = faults.FaultInjector("heartbeat_loss:0.5", seed=7)
+    s1 = [a.fire("heartbeat_loss") for _ in range(20)]
+    assert s1 == [b.fire("heartbeat_loss") for _ in range(20)]
+    assert any(s1) and not all(s1)
+
+    with pytest.raises(faults.FaultSpecError):
+        faults.FaultInjector("no_such_point@1")
+    with pytest.raises(faults.FaultSpecError):
+        faults.FaultInjector("nan_loss@0")
+    assert not faults.FaultInjector("").enabled
+
+
+def test_retry_backoff_bounded_and_deterministic():
+    pol = faults.RetryPolicy(attempts=3, base_delay_s=0.01, max_delay_s=0.04, seed=3)
+    assert pol.delays() == faults.RetryPolicy(
+        attempts=3, base_delay_s=0.01, max_delay_s=0.04, seed=3
+    ).delays()
+
+    calls = {"n": 0}
+    slept = []
+
+    def flaky():
+        calls["n"] += 1
+        if calls["n"] < 3:
+            raise IOError("blip")
+        return "ok"
+
+    out = faults.retry_call(flaky, pol, sleep=slept.append)
+    assert out == "ok" and calls["n"] == 3 and len(slept) == 2
+
+    def always_broken():
+        raise IOError("down")
+
+    seen = []
+    with pytest.raises(IOError):
+        faults.retry_call(
+            always_broken, pol, on_retry=lambda a, e: seen.append(a),
+            sleep=lambda _t: None,
+        )
+    assert seen == [1, 2, 3]  # every attempt observed, bounded
+
+
+def test_failure_budget_poisons_and_recovers():
+    b = faults.FailureBudget(max_failures=2)
+    assert not b.poisoned("s7")
+    assert b.record("s7") == 1 and not b.poisoned("s7")
+    assert b.record("s7") == 2 and b.poisoned("s7")
+    b.clear("s7")
+    assert not b.poisoned("s7") and b.failures("s7") == 0
+
+
+def test_resume_mode_normalisation():
+    assert resume_mode(False) == "off" and resume_mode(True) == "latest"
+    assert resume_mode("") == "off" and resume_mode("false") == "off"
+    assert resume_mode("true") == "latest" and resume_mode("1") == "latest"
+    assert resume_mode("auto") == "auto" and resume_mode("AUTO") == "auto"
+    with pytest.raises(ValueError):  # a typo must not silently mean strict
+        resume_mode("atuo")
+
+
+# ----------------------------------------------------------- snapshot CRC
+def _filled_replay(seed=3, lanes=2, cap=128) -> PrioritizedReplay:
+    mem = PrioritizedReplay(
+        cap, (12, 12), history=2, n_step=3, gamma=0.9, lanes=lanes, seed=seed
+    )
+    rng = np.random.default_rng(seed)
+    for _ in range(40):
+        mem.append_batch(
+            rng.integers(0, 255, (lanes, 12, 12), dtype=np.uint8),
+            rng.integers(0, 4, lanes).astype(np.int32),
+            rng.normal(size=lanes).astype(np.float32),
+            rng.random(lanes) < 0.05,
+        )
+    return mem
+
+
+def test_snapshot_crc_detects_tampering(tmp_path):
+    path = str(tmp_path / "snap")
+    mem = _filled_replay()
+    mem.snapshot(path)
+    # clean load passes
+    z = snapshot_io.load(path)
+    assert "frames" in z.files
+    # flip one payload byte below the zip layer's happy path
+    real = snapshot_io.npz_path(path)
+    data = bytearray(open(real, "rb").read())
+    data[len(data) // 2] ^= 0xFF
+    open(real, "wb").write(bytes(data))
+    with pytest.raises(snapshot_io.MISSING):
+        snapshot_io.load(path)
+    # truncation (torn write) is MISSING too
+    with open(real, "r+b") as f:
+        f.truncate(100)
+    with pytest.raises(snapshot_io.MISSING):
+        snapshot_io.load(path)
+
+
+def test_injected_torn_snapshot_is_skipped(tmp_path):
+    """`replay_snapshot_corrupt` point: the write lands torn, the CRC flags
+    it at restore, and the resume path degrades to a cold replay instead of
+    crashing (maybe_restore_replay -> False)."""
+    cfg = Config(
+        snapshot_replay=True,
+        checkpoint_dir=str(tmp_path / "ckpt"),
+        run_id="chaos0",
+        fault_spec="replay_snapshot_corrupt@1",
+    )
+    faults.install_from(cfg)
+    mem = _filled_replay()
+    save_replay_snapshot(cfg, mem)  # injector tears this write
+    assert faults.get().fired("replay_snapshot_corrupt") == 1
+
+    fresh = _filled_replay(seed=99)
+    before = fresh.frames.copy()
+    assert maybe_restore_replay(cfg, fresh) is False  # detected + skipped
+    np.testing.assert_array_equal(fresh.frames, before)  # untouched
+
+    # the next (clean) snapshot restores fine
+    save_replay_snapshot(cfg, mem)
+    assert maybe_restore_replay(cfg, fresh) is True
+    np.testing.assert_array_equal(fresh.frames, mem.frames)
+
+
+# ------------------------------------------------- checkpoint fall-back
+CKPT_CFG = Config(
+    compute_dtype="float32",
+    frame_height=44,
+    frame_width=44,
+    history_length=2,
+    hidden_size=64,
+    num_cosines=16,
+    num_tau_samples=8,
+    num_tau_prime_samples=8,
+    num_quantile_samples=4,
+)
+A = 4
+
+
+def _truncate_step_dir(root: str, step: int) -> int:
+    touched = 0
+    for r, _, files in os.walk(os.path.join(root, str(step))):
+        for f in files:
+            open(os.path.join(r, f), "w").close()
+            touched += 1
+    return touched
+
+
+def test_corrupt_latest_checkpoint_resumes_previous_valid(tmp_path):
+    from rainbow_iqn_apex_tpu.ops.learn import init_train_state
+
+    ckpt = Checkpointer(str(tmp_path))
+    s0 = init_train_state(CKPT_CFG, A, jax.random.PRNGKey(0))
+    s7 = s0.replace(params=jax.tree.map(lambda x: x * 2.0 + 1.0, s0.params))
+    ckpt.save(0, s0, {"frames": 10})
+    ckpt.save(7, s7, {"frames": 70})
+    ckpt.wait()
+    assert _truncate_step_dir(str(tmp_path), 7) > 0
+
+    template = init_train_state(CKPT_CFG, A, jax.random.PRNGKey(1))
+    assert ckpt.latest_step() == 7  # orbax still lists the torn step
+    assert ckpt.latest_valid_step(template) == 0  # integrity says otherwise
+
+    # --resume auto: falls back past the corrupt step
+    cfg = CKPT_CFG.replace(resume="auto")
+    state, extra, step = maybe_resume(cfg, ckpt, template)
+    assert step == 0 and extra["frames"] == 10
+    for la, lb in zip(jax.tree.leaves(state.params), jax.tree.leaves(s0.params)):
+        np.testing.assert_array_equal(np.asarray(la), np.asarray(lb))
+
+    # legacy --resume true: latest step, corruption surfaces loudly
+    with pytest.raises(Exception):
+        maybe_resume(CKPT_CFG.replace(resume="true"), ckpt, template)
+
+    # every step corrupt: auto REFUSES to silently start fresh — that
+    # pattern usually means a changed model config, not universal bit rot
+    assert _truncate_step_dir(str(tmp_path), 0) > 0
+    assert ckpt.latest_valid_step(template) is None
+    with pytest.raises(RuntimeError, match="none restores"):
+        maybe_resume(cfg, ckpt, template)
+
+    # an EMPTY dir (no checkpoints at all) is a genuine fresh start
+    empty = Checkpointer(str(tmp_path / "fresh"))
+    assert maybe_resume(cfg, empty, template) is None
+
+
+def test_checkpoint_write_failure_is_retried(tmp_path):
+    """`checkpoint_write` point: the first save attempt raises, the shared
+    retry policy re-runs it, and the checkpoint lands."""
+    from rainbow_iqn_apex_tpu.ops.learn import init_train_state
+
+    cfg = CKPT_CFG.replace(
+        fault_spec="checkpoint_write@1",
+        io_retry_base_s=0.001,
+        io_retry_max_s=0.002,
+    )
+    inj = faults.install_from(cfg)
+    sup = TrainSupervisor(cfg.replace(stall_timeout_s=0.0))
+    ckpt = Checkpointer(str(tmp_path))
+    state = init_train_state(CKPT_CFG, A, jax.random.PRNGKey(0))
+    assert sup.save_checkpoint(ckpt, 5, state, {"frames": 1}) is True
+    ckpt.wait()
+    assert ckpt.latest_step() == 5
+    assert inj.fired("checkpoint_write") == 1
+    assert inj.calls("checkpoint_write") == 2  # fail, then the retry
+    assert sup.io_faults == 1
+
+    # exhausted budget on a non-critical save degrades, critical raises
+    cfg2 = cfg.replace(fault_spec="checkpoint_write")  # always fails
+    faults.install_from(cfg2)
+    sup2 = TrainSupervisor(cfg2.replace(stall_timeout_s=0.0))
+    assert sup2.save_checkpoint(ckpt, 9, state) is False
+    with pytest.raises(IOError):
+        sup2.save_checkpoint(ckpt, 9, state, critical=True)
+
+
+# ----------------------------------------------------------- NaN rollback
+def _train_cfg(tmp_path, **kw):
+    base = dict(
+        env_id="toy:catch",
+        compute_dtype="float32",
+        frame_height=80,
+        frame_width=80,
+        history_length=2,
+        hidden_size=64,
+        num_cosines=16,
+        num_tau_samples=8,
+        num_tau_prime_samples=8,
+        num_quantile_samples=4,
+        batch_size=16,
+        learning_rate=1e-3,
+        adam_eps=1e-8,
+        multi_step=3,
+        gamma=0.9,
+        memory_capacity=2048,
+        learn_start=128,
+        replay_ratio=2,
+        target_update_period=100,
+        num_envs_per_actor=4,
+        metrics_interval=10,
+        eval_interval=0,
+        checkpoint_interval=0,
+        eval_episodes=2,
+        stall_timeout_s=0.0,
+        results_dir=str(tmp_path / "results"),
+        checkpoint_dir=str(tmp_path / "ckpt"),
+        seed=11,
+    )
+    base.update(kw)
+    return Config(**base)
+
+
+def test_nan_step_rolls_back_and_training_continues(tmp_path):
+    """`nan_loss` point through the REAL single-process loop: the poisoned
+    batch produces a non-finite step, the supervisor rolls params/opt/RNG
+    back to the last-good snapshot, skips the batch, and the run finishes
+    with finite losses."""
+    from rainbow_iqn_apex_tpu.train import train
+
+    cfg = _train_cfg(
+        tmp_path,
+        fault_spec="nan_loss@5",
+        guard_snapshot_interval=3,
+        max_nan_strikes=2,
+    )
+    summary = train(cfg, max_frames=500)
+    assert summary["rollbacks"] == 1
+    assert summary["learn_steps"] > 0
+    assert np.isfinite(summary["eval_score_mean"])
+
+    rows = [
+        json.loads(line)
+        for line in open(tmp_path / "results" / cfg.run_id / "metrics.jsonl")
+    ]
+    events = [r["event"] for r in rows if r["kind"] == "fault"]
+    assert "injected_nan_batch" in events
+    assert "nonfinite_step" in events
+    assert "rollback" in events
+    # loss is finite after the rollback (the guarded loop never logs NaN)
+    train_rows = [r for r in rows if r["kind"] == "train"]
+    assert train_rows and all(np.isfinite(r["loss"]) for r in train_rows)
+
+
+def test_nan_strikes_abort_when_replay_is_poisoned():
+    """Rollback masks a transient; systemic NaN aborts within the strike
+    budget instead of looping forever."""
+    cfg = Config(max_nan_strikes=2, guard_snapshot_interval=1, stall_timeout_s=0.0)
+    sup = TrainSupervisor(cfg)
+    sup.snapshot_if_due(0, lambda: ({"w": np.ones(2)}, np.zeros(2, np.uint32)))
+    bad = {"loss": float("nan"), "grad_norm": 1.0}
+    assert not sup.step_ok(bad)
+    sup.rollback()  # strike 1: tolerated
+    assert not sup.step_ok(bad)
+    with pytest.raises(TrainAborted):
+        sup.rollback()  # strike 2: budget hit
+    # a rollback before ANY snapshot can't help either
+    sup2 = TrainSupervisor(cfg)
+    assert not sup2.step_ok(bad)
+    with pytest.raises(TrainAborted):
+        sup2.rollback()
+
+
+def test_inf_grad_norm_is_a_strike():
+    cfg = Config(max_nan_strikes=3, stall_timeout_s=0.0)
+    sup = TrainSupervisor(cfg)
+    assert sup.step_ok({"loss": 0.5, "grad_norm": 1.0})
+    assert not sup.step_ok({"loss": 0.5, "grad_norm": float("inf")})
+    assert sup.strikes == 1
+    assert sup.step_ok({"loss": 0.5, "grad_norm": 1.0})
+    assert sup.strikes == 0  # healthy step resets the consecutive count
+
+
+# ---------------------------------------------------------- stall watchdog
+def test_stall_watchdog_fires_on_injected_stall():
+    fired = []
+    dog = StallWatchdog(timeout_s=0.15, on_stall=fired.append, poll_s=0.02)
+    dog.tick()
+    import time as _time
+
+    _time.sleep(0.4)  # the "stall": no tick for >> timeout
+    dog.stop()
+    assert dog.stalls >= 1 and fired and fired[0] >= 0.15
+
+    # and through the supervisor's injection point end to end
+    cfg = Config(
+        fault_spec="stalled_step@2",
+        fault_stall_s=0.4,
+        stall_timeout_s=0.15,
+        seed=0,
+    )
+    inj = faults.install_from(cfg)
+    sup = TrainSupervisor(cfg, injector=inj)
+    sup.watchdog.poll_s = 0.02
+    assert sup.step_ok({"loss": 0.1, "grad_norm": 0.1})  # arms the watchdog
+    sup.maybe_stall()  # call 1: no fault
+    sup.maybe_stall()  # call 2: sleeps 0.4s; watchdog fires meanwhile
+    sup.close()
+    assert inj.fired("stalled_step") == 1
+    assert sup.stalls >= 1
+
+
+# -------------------------------------------------------------- heartbeats
+def test_heartbeat_loss_detected_as_dead_host(tmp_path):
+    hb_dir = str(tmp_path / "hb")
+    alive = HeartbeatWriter(hb_dir, 0, interval_s=0.05,
+                            injector=faults.FaultInjector("")).start()
+    dying = HeartbeatWriter(hb_dir, 1, interval_s=0.05,
+                            injector=faults.FaultInjector(""))
+    dying.beat()  # was alive once...
+    dying.injector = faults.FaultInjector("heartbeat_loss")  # ...then preempted
+    assert dying.beats == 1
+    dying.beat()
+    assert dying.suppressed == 1 and dying.beats == 1  # writes suppressed
+
+    import time as _time
+
+    monitor = HeartbeatMonitor(hb_dir, timeout_s=0.2, self_id=0)
+    _time.sleep(0.35)
+    ages = monitor.ages()
+    assert set(ages) == {0, 1}
+    assert ages[0] < 0.2 < ages[1]  # h0 fresh, h1 stale
+    assert monitor.check() == [1]
+    assert monitor.newly_dead() == [1]
+    assert monitor.newly_dead() == []  # edge-triggered: reported once
+    alive.stop()
+
+
+def test_sharded_replay_keeps_training_from_surviving_shards():
+    """A dead actor host's shard drops out; sampling, appends and priority
+    write-backs continue on the survivors (the learner never wedges)."""
+    rng = np.random.default_rng(0)
+    mem = ShardedReplay.build(
+        2, 256, 4, frame_shape=(12, 12), history=2, n_step=3, gamma=0.9, seed=1
+    )
+    for _ in range(40):
+        mem.append_batch(
+            rng.integers(0, 255, (4, 12, 12), dtype=np.uint8),
+            rng.integers(0, 4, 4).astype(np.int32),
+            rng.normal(size=4).astype(np.float32),
+            rng.random(4) < 0.05,
+        )
+    full = len(mem)
+    assert mem.sampleable
+    mem.drop_shard(0)
+    assert mem.dead_shards == (0,)
+    assert len(mem) == full // 2
+    assert mem.sampleable
+    s = mem.sample(16, beta=0.6)
+    assert (s.idx >= mem.shard_capacity).all()  # all rows from shard 1
+    mem.update_priorities(s.idx, np.abs(rng.normal(size=16)))  # no wedge
+    # appends keep flowing into the survivor
+    n_before = len(mem)
+    mem.append_batch(
+        rng.integers(0, 255, (4, 12, 12), dtype=np.uint8),
+        rng.integers(0, 4, 4).astype(np.int32),
+        rng.normal(size=4).astype(np.float32),
+        np.zeros(4, bool),
+    )
+    assert len(mem) >= n_before
+    with pytest.raises(RuntimeError):
+        mem.drop_shard(1)  # never drop the last survivor
+
+
+def test_nan_step_rolls_back_in_apex_driver(tmp_path):
+    """The same guard through the Ape-X loop (mesh driver, device-prefetched
+    batches): an injected NaN batch rolls the dp-sharded TrainState back and
+    the run completes with finite losses."""
+    from rainbow_iqn_apex_tpu.parallel.apex import train_apex
+
+    cfg = _train_cfg(
+        tmp_path,
+        num_envs_per_actor=8,
+        learn_start=256,
+        replay_ratio=8,
+        memory_capacity=4096,
+        metrics_interval=20,
+        fault_spec="nan_loss@3",
+        guard_snapshot_interval=2,
+        max_nan_strikes=2,
+        heartbeat_interval_s=0.1,  # exercise the writer in-loop too
+    )
+    summary = train_apex(cfg, max_frames=1_000)
+    assert summary["rollbacks"] == 1
+    assert summary["learn_steps"] > 0
+    assert np.isfinite(summary["eval_score_mean"])
+    rows = [
+        json.loads(line)
+        for line in open(tmp_path / "results" / cfg.run_id / "metrics.jsonl")
+    ]
+    assert any(
+        r["kind"] == "fault" and r["event"] == "rollback" for r in rows
+    )
+    assert all(
+        np.isfinite(r["loss"]) for r in rows if r["kind"] == "train"
+    )
+    # the heartbeat file for this (single) host exists and was refreshed
+    hb = tmp_path / "results" / cfg.run_id / "heartbeats" / "h0.json"
+    assert hb.exists()
+    assert json.loads(hb.read_text())["process_id"] == 0
+
+
+# ------------------------------------------------ kill -> resume identity
+def test_kill_then_resume_auto_learn_step_numerically_identical(tmp_path):
+    """The acceptance core: checkpoint + replay snapshot + RNG side-cars are
+    a COMPLETE cut of learner state.  A fresh process restoring them via the
+    real --resume auto path (maybe_resume + maybe_restore_replay) samples
+    the same batch and produces a bitwise-identical learn step."""
+    from rainbow_iqn_apex_tpu.agents.agent import Agent
+
+    cfg = CKPT_CFG.replace(
+        resume="auto",
+        snapshot_replay=True,
+        run_id="ident0",
+        checkpoint_dir=str(tmp_path / "ckpt"),
+        results_dir=str(tmp_path / "results"),
+        batch_size=16,
+        multi_step=3,
+        gamma=0.9,
+    )
+    frame_shape = (44, 44)
+    rng = np.random.default_rng(42)
+
+    def fill(mem, ticks):
+        for _ in range(ticks):
+            mem.append_batch(
+                rng.integers(0, 255, (2, *frame_shape), dtype=np.uint8),
+                rng.integers(0, A, 2).astype(np.int32),
+                rng.normal(size=2).astype(np.float32),
+                rng.random(2) < 0.05,
+            )
+
+    # ---- run A: train a bit, checkpoint mid-run, then one more step ----
+    agent = Agent(cfg, A, jax.random.PRNGKey(cfg.seed),
+                  state_shape=(*frame_shape, cfg.history_length))
+    memory = PrioritizedReplay(
+        256, frame_shape, history=cfg.history_length, n_step=cfg.multi_step,
+        gamma=cfg.gamma, lanes=2, seed=cfg.seed,
+    )
+    fill(memory, 60)
+    for _ in range(3):
+        s = memory.sample(cfg.batch_size, 0.6)
+        info = agent.learn(s)
+        memory.update_priorities(s.idx, np.asarray(info["priorities"]))
+
+    ckpt = Checkpointer(os.path.join(cfg.checkpoint_dir, cfg.run_id))
+    ckpt.save(agent.step, agent.state,
+              {"frames": 120, **rng_extra(agent.key)})
+    save_replay_snapshot(cfg, memory)
+    ckpt.wait()
+
+    # the uninterrupted continuation: one more learn step
+    s_a = memory.sample(cfg.batch_size, 0.7)
+    info_a = agent.learn(s_a)
+    params_a = jax.tree.map(np.asarray, agent.state.params)
+    loss_a = float(info_a["loss"])
+
+    # ---- run B: "kill" (fresh objects, different init seeds), resume ----
+    agent_b = Agent(cfg, A, jax.random.PRNGKey(999),
+                    state_shape=(*frame_shape, cfg.history_length))
+    memory_b = PrioritizedReplay(
+        256, frame_shape, history=cfg.history_length, n_step=cfg.multi_step,
+        gamma=cfg.gamma, lanes=2, seed=777,
+    )
+    ckpt_b = Checkpointer(os.path.join(cfg.checkpoint_dir, cfg.run_id))
+    restored = maybe_resume(cfg, ckpt_b, agent_b.state)
+    assert restored is not None
+    state, extra, _ = restored
+    agent_b.load_snapshot(state, np.zeros(2, np.uint32))
+    agent_b.key = rng_from_extra(extra, agent_b.key)
+    assert extra["frames"] == 120
+    assert maybe_restore_replay(cfg, memory_b) is True
+
+    s_b = memory_b.sample(cfg.batch_size, 0.7)
+    np.testing.assert_array_equal(s_a.idx, s_b.idx)  # same sampled batch
+    np.testing.assert_array_equal(s_a.obs, s_b.obs)
+    np.testing.assert_array_equal(s_a.weight, s_b.weight)
+    info_b = agent_b.learn(s_b)
+
+    assert float(info_b["loss"]) == loss_a  # bitwise, not approx
+    np.testing.assert_array_equal(
+        np.asarray(info_a["priorities"]), np.asarray(info_b["priorities"])
+    )
+    for la, lb in zip(
+        jax.tree.leaves(params_a),
+        jax.tree.leaves(jax.tree.map(np.asarray, agent_b.state.params)),
+    ):
+        np.testing.assert_array_equal(la, lb)
+    # and the RNG streams stay in lockstep for the NEXT step too
+    np.testing.assert_array_equal(np.asarray(agent.key), np.asarray(agent_b.key))
