@@ -2,7 +2,7 @@
 
 PY ?= python
 
-.PHONY: test test-fast serve-smoke serve-bench chaos-smoke
+.PHONY: test test-fast serve-smoke serve-bench chaos-smoke obs-smoke
 
 # tier-1: fast unit + integration tests on the virtual 8-device CPU mesh
 test-fast:
@@ -26,3 +26,22 @@ serve-bench:
 # subset of tier-1 (docs/RESILIENCE.md)
 chaos-smoke:
 	JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_resilience.py -q -m chaos
+
+# obs smoke: a short anakin run must yield a lintable, reportable run dir —
+# obs_report prints per-role throughput / learn-step percentiles / health,
+# lint_jsonl proves every row is strict, schema-versioned JSON
+# (docs/OBSERVABILITY.md)
+obs-smoke:
+	rm -rf /tmp/ria_obs_smoke
+	JAX_PLATFORMS=cpu $(PY) train_agent_apex.py --role anakin \
+	  --env-id toy:catch --compute-dtype float32 --history-length 2 \
+	  --hidden-size 64 --num-cosines 16 --num-tau-samples 4 \
+	  --num-tau-prime-samples 4 --num-quantile-samples 4 --batch-size 16 \
+	  --learning-rate 1e-3 --multi-step 3 --gamma 0.9 --memory-capacity 4096 \
+	  --learn-start 512 --replay-ratio 2 --target-update-period 200 \
+	  --num-envs-per-actor 8 --metrics-interval 200 --eval-interval 0 \
+	  --checkpoint-interval 0 --eval-episodes 4 --t-max 2048 \
+	  --run-id obs_smoke --results-dir /tmp/ria_obs_smoke/results \
+	  --checkpoint-dir /tmp/ria_obs_smoke/ckpt
+	$(PY) scripts/obs_report.py /tmp/ria_obs_smoke/results/obs_smoke
+	$(PY) scripts/lint_jsonl.py /tmp/ria_obs_smoke/results/obs_smoke

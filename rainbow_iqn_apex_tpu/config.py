@@ -37,6 +37,14 @@ class Config:
     # (parity: the reference's replay survives restarts via Redis persistence;
     # off by default — Atari-scale buffers are ~7GB/host on disk)
 
+    # ---- observability (obs/; docs/OBSERVABILITY.md) ------------------------------
+    trace_dir: str = ""  # arm a one-shot jax profiler capture (xplane/
+    # TensorBoard format, utils/profiling.device_trace) around the learn-step
+    # window [trace_start_step, trace_start_step + trace_num_steps); "" = off
+    trace_start_step: int = 50  # past warmup/compile so the capture is steady-state
+    trace_num_steps: int = 10
+    obs_http_port: int = 0  # serve /metrics + /healthz on this port; 0 = off
+
     # ---- resilience (utils/faults.py + parallel/supervisor.py; RESILIENCE.md) ----
     fault_spec: str = ""  # chaos injection, e.g. "nan_loss@5,checkpoint_write@1"
     # (point@n = fire on n-th call, point:p = seeded probability, bare point =

@@ -1,0 +1,137 @@
+"""Prometheus-style text exposition + the tiny stdlib /metrics + /healthz
+HTTP endpoint both the serving server and the apex drivers mount.
+
+No third-party client library: the exposition format is plain text and the
+server is ``http.server.ThreadingHTTPServer`` on a daemon thread — good
+enough for a scrape every few seconds, zero new dependencies (the container
+bakes only the jax_graft toolchain).
+
+Endpoints:
+  /metrics   registry counters/gauges as ``ria_<name>{role="..."} value``,
+             histograms as summary-style quantile rows + _count/_sum;
+  /healthz   JSON from the attached health callback; HTTP 200 for
+             ok/degraded (the run is alive), 503 for failing (a scheduler
+             or LB should act).
+"""
+
+from __future__ import annotations
+
+import json
+import re
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any, Callable, Dict, Optional
+
+from rainbow_iqn_apex_tpu.obs.registry import Histogram, MetricRegistry
+from rainbow_iqn_apex_tpu.obs.schema import sanitize
+
+_NAME_RE = re.compile(r"[^a-zA-Z0-9_:]")
+
+
+def _prom_name(name: str) -> str:
+    return "ria_" + _NAME_RE.sub("_", name)
+
+
+def prometheus_text(registry: MetricRegistry) -> str:
+    """The registry in Prometheus text exposition format (v0.0.4)."""
+    lines = []
+    for name, role, metric in registry.collect():
+        pname = _prom_name(name)
+        label = f'{{role="{role}"}}' if role else ""
+        if isinstance(metric, Histogram):
+            snap = metric.snapshot()
+            lines.append(f"# TYPE {pname} summary")
+            for q, key in (("0.5", "p50"), ("0.9", "p90"), ("0.99", "p99")):
+                if key in snap:
+                    qlabel = (
+                        f'{{role="{role}",quantile="{q}"}}'
+                        if role
+                        else f'{{quantile="{q}"}}'
+                    )
+                    lines.append(f"{pname}{qlabel} {snap[key]:.6g}")
+            lines.append(f"{pname}_count{label} {metric.total_count}")
+            lines.append(f"{pname}_sum{label} {metric.total_sum:.6g}")
+        else:
+            lines.append(f"# TYPE {pname} {metric.kind}")
+            lines.append(f"{pname}{label} {metric.get():.6g}")
+    return "\n".join(lines) + "\n"
+
+
+class ObsHTTPServer:
+    """Serve /metrics and /healthz for one registry + health callback.
+
+    ``port=0`` binds an ephemeral port (read ``.port`` after construction);
+    Config.obs_http_port <= 0 means callers never construct one at all."""
+
+    def __init__(
+        self,
+        registry: MetricRegistry,
+        health_fn: Optional[Callable[[], Dict[str, Any]]] = None,
+        port: int = 0,
+        host: str = "127.0.0.1",
+    ):
+        self.registry = registry
+        self.health_fn = health_fn
+        outer = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *args):  # no stderr chatter per scrape
+                pass
+
+            def _send(self, code: int, body: str, ctype: str) -> None:
+                data = body.encode()
+                self.send_response(code)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(data)))
+                self.end_headers()
+                self.wfile.write(data)
+
+            def do_GET(self):
+                path = self.path.split("?", 1)[0]
+                try:
+                    if path == "/metrics":
+                        self._send(
+                            200,
+                            prometheus_text(outer.registry),
+                            "text/plain; version=0.0.4",
+                        )
+                    elif path == "/healthz":
+                        health = (
+                            outer.health_fn() if outer.health_fn is not None
+                            else {"status": "ok"}
+                        )
+                        code = 503 if health.get("status") == "failing" else 200
+                        self._send(
+                            code, json.dumps(sanitize(health)), "application/json"
+                        )
+                    else:
+                        self._send(404, "not found\n", "text/plain")
+                except (BrokenPipeError, ConnectionResetError):
+                    pass  # client went away mid-scrape; nothing to serve
+
+        self._httpd = ThreadingHTTPServer((host, port), Handler)
+        self._httpd.daemon_threads = True
+        self.host = host
+        self.port = self._httpd.server_address[1]
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self) -> "ObsHTTPServer":
+        if self._thread is None:
+            self._thread = threading.Thread(
+                target=self._httpd.serve_forever,
+                name="obs-http",
+                daemon=True,
+            )
+            self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        if self._thread is not None:
+            self._httpd.shutdown()
+            self._thread.join(timeout=5)
+            self._thread = None
+        self._httpd.server_close()
+
+    @property
+    def url(self) -> str:
+        return f"http://{self.host}:{self.port}"

@@ -1,0 +1,187 @@
+"""RunHealth: fold every liveness signal into one periodic 'health' row.
+
+Ape-X health is not one number — it is the *joint* state of heartbeats
+(PR 2's host_dead rows), supervisor fault rows (nonfinite_step / rollback /
+stalled_step / io_retry), serve-side shedding, and the replay/queue gauges.
+Before this module a human answered "is this run healthy" by hand-grepping
+four row kinds out of metrics.jsonl; RunHealth folds them into a single row
+
+    {"kind": "health", "status": "ok"|"degraded"|"failing", ...}
+
+emitted at the metrics cadence, plus a ``healthz()`` dict the /healthz HTTP
+endpoint (obs/export.py) serves live.
+
+Signal plumbing is observational: RunHealth attaches to the MetricsLogger as
+a row observer, so every fault/serve/swap row any component logs is counted
+here with NO new coupling to the supervisor/serving internals — components
+keep reporting exactly as they did in PR 2.
+
+Status rules (deterministic, windowed between ticks):
+  failing   - supervisor abort seen (train_aborted), OR consecutive
+              non-finite strikes reached the rollback budget, OR a stall
+              fired in a window where zero learn steps completed (wedged
+              collective/device: the run is not making progress);
+  degraded  - any fault row, shed, or dead host in the window, or any host
+              currently dead (survivors-only sampling keeps training, but a
+              human should know);
+  ok        - none of the above.
+"""
+
+from __future__ import annotations
+
+import collections
+import threading
+import time
+from typing import Any, Dict, Optional
+
+from rainbow_iqn_apex_tpu.obs.registry import MetricRegistry
+
+
+class RunHealth:
+    def __init__(
+        self,
+        registry: MetricRegistry,
+        logger=None,
+        role: str = "",
+        max_nan_strikes: int = 3,
+    ):
+        self.registry = registry
+        self.logger = logger
+        self.role = role
+        self.max_nan_strikes = max(int(max_nan_strikes), 1)
+        self._lock = threading.Lock()
+        self.fault_counts: collections.Counter = collections.Counter()
+        self.dead_hosts: set = set()
+        self.total_shed = 0
+        self._last_strikes = 0
+        self._aborted = False
+        self._stall_active = False  # set by stalled_step, cleared by a
+        # completed finite step — lets healthz() report a LIVE wedge as
+        # failing even though the hung loop will never tick() again
+        # window state (reset every tick)
+        self._win_faults: collections.Counter = collections.Counter()
+        self._win_shed = 0
+        self._last_step: Optional[int] = None
+        self._last_status = "ok"
+        self._last_row: Dict[str, Any] = {"status": "ok", "step": 0}
+
+    # ----------------------------------------------------------- observation
+    def observe_row(self, row: Dict[str, Any]) -> None:
+        """MetricsLogger observer: fold fault/serve rows as they are logged."""
+        kind = row.get("kind")
+        if kind == "fault":
+            self.note_fault(str(row.get("event", "unknown")), row)
+        elif kind == "serve":
+            shed = row.get("shed") or 0
+            if shed:
+                with self._lock:
+                    self.total_shed += shed
+                    self._win_shed += shed
+                self.registry.counter("shed_total", "serve").inc(shed)
+
+    def note_fault(self, event: str, row: Optional[Dict[str, Any]] = None) -> None:
+        with self._lock:
+            self.fault_counts[event] += 1
+            self._win_faults[event] += 1
+            if event == "nonfinite_step":
+                strikes = (row or {}).get("strikes")
+                self._last_strikes = (
+                    int(strikes) if strikes is not None else self._last_strikes + 1
+                )
+            elif event == "rollback":
+                pass  # strikes latch until a finite step clears them
+            elif event == "stalled_step":
+                self._stall_active = True
+            elif event == "train_aborted":
+                self._aborted = True
+            elif event == "host_dead":
+                host = (row or {}).get("dead_host")
+                if host is not None:
+                    self.dead_hosts.add(host)
+        self.registry.counter(f"fault_{event}_total", "supervisor").inc()
+
+    def note_finite_step(self) -> None:
+        """A completed finite learn step clears the strike latch (mirrors
+        TrainSupervisor.step_ok) and ends any live stall episode."""
+        with self._lock:
+            self._last_strikes = 0
+            self._stall_active = False
+
+    def note_abort(self) -> None:
+        self.note_fault("train_aborted")
+
+    # ------------------------------------------------------------- reporting
+    def _status_locked(self, steps_in_window: Optional[int]) -> str:
+        if self._aborted or self._last_strikes >= self.max_nan_strikes:
+            return "failing"
+        # a stall with no progress is failing.  On the tick path progress is
+        # the step delta; on the LIVE path (healthz of a wedged loop that
+        # will never tick again) it is "has any step completed since the
+        # stall fired" — the _stall_active latch.
+        if self._stall_active and (steps_in_window is None
+                                   or steps_in_window <= 0):
+            return "failing"
+        if (
+            sum(self._win_faults.values()) > 0
+            or self._win_shed > 0
+            or self.dead_hosts
+        ):
+            return "degraded"
+        return "ok"
+
+    def status(self) -> str:
+        with self._lock:
+            return self._status_locked(None)
+
+    def tick(self, step: int, frames: int = 0, **gauges: Any) -> Dict[str, Any]:
+        """Close the current window: compute status, emit one 'health' row
+        (when a logger is attached), reset window counters.  Extra ``gauges``
+        (replay_occupancy, queue_depth, ...) ride along in the row and are
+        mirrored into registry gauges for /metrics."""
+        with self._lock:
+            steps_in_window = (
+                None if self._last_step is None else step - self._last_step
+            )
+            status = self._status_locked(steps_in_window)
+            row = {
+                "status": status,
+                "step": int(step),
+                "frames": int(frames),
+                "faults_window": int(sum(self._win_faults.values())),
+                "faults_total": int(sum(self.fault_counts.values())),
+                "rollbacks": int(self.fault_counts.get("rollback", 0)),
+                "stalls": int(self.fault_counts.get("stalled_step", 0)),
+                "io_retries": int(self.fault_counts.get("io_retry", 0)),
+                "nan_strikes": int(self._last_strikes),
+                "shed_total": int(self.total_shed),
+                "hosts_dead": sorted(self.dead_hosts),
+            }
+            self._win_faults.clear()
+            self._win_shed = 0
+            if steps_in_window is not None and steps_in_window > 0:
+                self._stall_active = False  # progress ended the episode
+            self._last_step = step
+            self._last_status = status
+        for k, v in gauges.items():
+            row[k] = v
+            try:
+                self.registry.gauge(k, self.role).set(float(v))
+            except (TypeError, ValueError):
+                pass  # non-numeric gauge: row-only
+        self.registry.gauge(
+            "health_status", self.role
+        ).set({"ok": 0, "degraded": 1, "failing": 2}[status])
+        self._last_row = row
+        if self.logger is not None:
+            self.logger.log("health", **row)
+        return row
+
+    def healthz(self) -> Dict[str, Any]:
+        """Live dict for the /healthz endpoint: the LAST emitted row plus the
+        instantaneous status (a stall can flip it between ticks)."""
+        with self._lock:
+            live = self._status_locked(None)
+            out = dict(self._last_row)
+        out["status"] = live
+        out["ts"] = round(time.time(), 3)
+        return out

@@ -1,0 +1,95 @@
+"""The one JSONL row schema every role emits (docs/OBSERVABILITY.md).
+
+Every row written through ``utils.logging.MetricsLogger`` — train loops, apex
+drivers, serving, supervisor fault rows, obs timing/health/span rows — carries
+the same envelope:
+
+    t       seconds since the logger opened (monotone within a run)
+    ts      absolute wall-clock epoch seconds (satellite: cross-run alignment)
+    host    process index (multi-host attribution; 0 single-host)
+    run     run id
+    kind    row kind (the tables below)
+    schema  this module's SCHEMA_VERSION
+
+and is strict JSON: non-finite floats are sanitized BEFORE serialisation
+(``json.dumps(float("nan"))`` emits bare ``NaN``, which is not JSON and broke
+every downstream parser on PR 2's fault rows — NaN -> null, +/-inf -> the
+string sentinels "inf"/"-inf").
+
+Consumers (scripts/obs_report.py, scripts/lint_jsonl.py, the golden-schema
+test) validate against REQUIRED_KEYS; adding a key is backward-compatible,
+removing or renaming one means bumping SCHEMA_VERSION.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Any, Dict, List
+
+SCHEMA_VERSION = 1
+
+# Envelope keys stamped by MetricsLogger on every row.
+ENVELOPE_KEYS = frozenset({"t", "ts", "host", "run", "kind", "schema"})
+
+# Per-kind required payload keys (beyond the envelope).  Kinds not listed
+# here are free-form but still get the envelope + sanitisation.
+REQUIRED_KEYS: Dict[str, frozenset] = {
+    "learn": frozenset({"step", "frames", "loss"}),  # per-interval train row
+    "eval": frozenset({"step", "score_mean"}),
+    "fault": frozenset({"event"}),  # supervisor/chaos events (PR 2)
+    "serve": frozenset({"requests", "batches", "shed"}),
+    "swap": frozenset(),  # rare load-bearing events; payload varies by source
+    "resume": frozenset({"step", "frames"}),
+    "health": frozenset({"status", "step"}),  # obs/health.py aggregator
+    "timing": frozenset({"step"}),  # StepTimer + span aggregates
+    "span": frozenset({"name", "span_id", "parent_id", "dur_ms"}),
+    "trace": frozenset({"event", "step"}),  # --trace-dir window open/close
+
+}
+
+HEALTH_STATUSES = ("ok", "degraded", "failing")
+
+
+def sanitize(value: Any) -> Any:
+    """Recursively make ``value`` strict-JSON serialisable: non-finite floats
+    become null (NaN) or the "inf"/"-inf" string sentinels, numpy scalars
+    collapse to Python scalars, arrays to lists.  Idempotent."""
+    if isinstance(value, float):
+        if math.isnan(value):
+            return None
+        if math.isinf(value):
+            return "inf" if value > 0 else "-inf"
+        return value
+    if isinstance(value, (str, int, bool)) or value is None:
+        return value
+    if isinstance(value, dict):
+        return {str(k): sanitize(v) for k, v in value.items()}
+    if isinstance(value, (list, tuple)):
+        return [sanitize(v) for v in value]
+    # numpy scalars / 0-d arrays expose item(); ndarrays expose tolist()
+    item = getattr(value, "item", None)
+    if item is not None and getattr(value, "ndim", 0) == 0:
+        return sanitize(item())
+    tolist = getattr(value, "tolist", None)
+    if tolist is not None:
+        return sanitize(tolist())
+    return str(value)  # last resort: never let dumps() raise mid-run
+
+
+def validate_row(row: Dict[str, Any]) -> List[str]:
+    """Schema errors for one parsed row ([] = valid).  Checks the envelope,
+    the schema version, and the kind's required payload keys."""
+    errors = []
+    for key in ("kind", "schema", "ts", "host", "run"):
+        if key not in row:
+            errors.append(f"missing envelope key '{key}'")
+    if row.get("schema") not in (None, SCHEMA_VERSION):
+        errors.append(f"unknown schema version {row.get('schema')!r}")
+    kind = row.get("kind")
+    for key in REQUIRED_KEYS.get(kind, frozenset()):
+        if key not in row:
+            errors.append(f"'{kind}' row missing required key '{key}'")
+    if kind == "health" and row.get("status") not in HEALTH_STATUSES:
+        errors.append(f"health status {row.get('status')!r} not in "
+                      f"{HEALTH_STATUSES}")
+    return errors

@@ -43,6 +43,7 @@ from rainbow_iqn_apex_tpu.agents.agent import (
 from rainbow_iqn_apex_tpu.utils.prefetch import BatchPrefetcher, make_replay_prefetcher
 from rainbow_iqn_apex_tpu.config import Config
 from rainbow_iqn_apex_tpu.envs import make_vector_env
+from rainbow_iqn_apex_tpu.obs import RunObs
 from rainbow_iqn_apex_tpu.ops.learn import (
     Batch,
     TrainState,
@@ -397,13 +398,16 @@ def train_apex(cfg: Config, max_frames: Optional[int] = None) -> Dict[str, Any]:
         os.path.join(run_dir, "metrics.jsonl") if is_main else None,
         cfg.run_id,
         echo=is_main,
+        host=cfg.process_id,
     )
     ckpt = Checkpointer(os.path.join(cfg.checkpoint_dir, cfg.run_id))
     faults.install_from(cfg)
+    obs_run = RunObs(cfg, metrics, role="learner")
+    memory.attach_registry(obs_run.registry)
     # NOTE (multi-host): the injector/retry decisions are pure functions of
     # (spec, seed, call order), identical on every host — supervised control
     # flow can never diverge the SPMD program around a collective.
-    sup = TrainSupervisor(cfg, metrics=metrics)
+    sup = TrainSupervisor(cfg, metrics=metrics, registry=obs_run.registry)
     from rainbow_iqn_apex_tpu.parallel.multihost import (
         HeartbeatMonitor,
         HeartbeatWriter,
@@ -462,7 +466,8 @@ def train_apex(cfg: Config, max_frames: Optional[int] = None) -> Dict[str, Any]:
     try:
         while frames < total_frames:
             if use_dstack:
-                actions, q = driver.act_frames(obs, prev_cuts)
+                with obs_run.span("act"):
+                    actions, q = driver.act_frames(obs, prev_cuts)
             else:
                 stacked = stacker.push(obs)
                 if multihost:
@@ -549,18 +554,24 @@ def train_apex(cfg: Config, max_frames: Optional[int] = None) -> Dict[str, Any]:
                         else:
                             sample = memory.sample(local_batch, priority_beta(cfg, frames))
                             idx = sample.idx
-                        info = driver.learn_local(
-                            sup.poison_maybe(sample),
-                            global_size=len(memory) * nproc,
-                            beta=priority_beta(cfg, frames),
-                        )
+                        with obs_run.span("learn_step"):
+                            info = driver.learn_local(
+                                sup.poison_maybe(sample),
+                                global_size=len(memory) * nproc,
+                                beta=priority_beta(cfg, frames),
+                            )
                     elif prefetcher is not None:
                         idx, batch = prefetcher.get()
-                        info = driver.learn_batch(sup.poison_maybe(batch))
+                        with obs_run.span("learn_step"):
+                            info = driver.learn_batch(sup.poison_maybe(batch))
                     else:
-                        sample = memory.sample(local_batch, priority_beta(cfg, frames))
+                        with obs_run.span("replay_sample"):
+                            sample = memory.sample(
+                                local_batch, priority_beta(cfg, frames)
+                            )
                         idx = sample.idx
-                        info = driver.learn(sup.poison_maybe(sample))
+                        with obs_run.span("learn_step"):
+                            info = driver.learn(sup.poison_maybe(sample))
                     sup.maybe_stall()
                     if not sup.step_ok(info):
                         # non-finite step (loss is all-reduced: every host
@@ -575,12 +586,14 @@ def train_apex(cfg: Config, max_frames: Optional[int] = None) -> Dict[str, Any]:
                         continue
                     memory.update_priorities(idx, np.asarray(info["priorities"]))
                     step = driver.step
+                    obs_run.after_learn_step(step)
                     if step - last_pub >= cfg.weight_publish_interval:
-                        driver.publish_weights()
+                        with obs_run.span("publish_weights"):
+                            driver.publish_weights()
                         last_pub = step
                     if step % cfg.metrics_interval == 0:
                         metrics.log(
-                            "train",
+                            "learn",
                             step=step,
                             frames=frames,
                             fps=metrics.fps(frames),
@@ -589,14 +602,31 @@ def train_apex(cfg: Config, max_frames: Optional[int] = None) -> Dict[str, Any]:
                             mean_return=float(np.mean(returns)) if returns else float("nan"),
                             staleness=step - last_pub,
                         )
+                        obs_run.periodic(
+                            step,
+                            frames,
+                            replay_size=len(memory),
+                            # survivors-aware occupancy maintained by
+                            # ShardedReplay._observe on this same registry —
+                            # recomputing it here would double-count dead
+                            # shards in the denominator
+                            replay_occupancy=round(
+                                obs_run.registry.gauge(
+                                    "replay_occupancy", "replay"
+                                ).get(), 4,
+                            ),
+                            weight_staleness=step - last_pub,
+                        )
                         if monitor is not None:
                             # a preempted host stops heartbeating; the
                             # host_dead row is the external supervisor's
                             # restart/reshard signal — a hung collective
                             # would otherwise wedge this loop silently
                             for hid in monitor.newly_dead():
+                                # dead_host, not host: the envelope's `host`
+                                # key is the EMITTING process index
                                 metrics.log(
-                                    "fault", event="host_dead", host=hid,
+                                    "fault", event="host_dead", dead_host=hid,
                                     step=step, frames=frames,
                                 )
                     if is_main and cfg.eval_interval and step % cfg.eval_interval == 0:
@@ -620,6 +650,7 @@ def train_apex(cfg: Config, max_frames: Optional[int] = None) -> Dict[str, Any]:
         if prefetcher is not None:
             prefetcher.close()
         sup.close()
+        obs_run.close(driver.step, frames)
         if heartbeat is not None:
             heartbeat.stop()
     final_eval = _eval_learner(cfg, env, driver) if is_main else {}

@@ -27,6 +27,7 @@ import numpy as np
 from rainbow_iqn_apex_tpu.agents.agent import FrameStacker
 from rainbow_iqn_apex_tpu.config import Config
 from rainbow_iqn_apex_tpu.envs import make_vector_env
+from rainbow_iqn_apex_tpu.obs import RunObs
 from rainbow_iqn_apex_tpu.ops.r2d2 import (
     R2D2TrainState,
     SequenceBatch,
@@ -337,10 +338,12 @@ def train_apex_r2d2(cfg: Config, max_frames: Optional[int] = None) -> Dict[str, 
         os.path.join(run_dir, "metrics.jsonl") if is_main else None,
         cfg.run_id,
         echo=is_main,
+        host=cfg.process_id,
     )
     ckpt = Checkpointer(os.path.join(cfg.checkpoint_dir, cfg.run_id))
     faults.install_from(cfg)
-    sup = TrainSupervisor(cfg, metrics=metrics)
+    obs_run = RunObs(cfg, metrics, role="learner")
+    sup = TrainSupervisor(cfg, metrics=metrics, registry=obs_run.registry)
 
     frames = 0
     last_pub = 0
@@ -376,9 +379,11 @@ def train_apex_r2d2(cfg: Config, max_frames: Optional[int] = None) -> Dict[str, 
     try:
         while frames < total_frames:
             if use_dstack:
-                actions, (pre_c, pre_h) = driver.act_frames(obs, prev_cuts)
+                with obs_run.span("act"):
+                    actions, (pre_c, pre_h) = driver.act_frames(obs, prev_cuts)
             else:
-                actions, (pre_c, pre_h) = driver.act(stacker.push(obs))
+                with obs_run.span("act"):
+                    actions, (pre_c, pre_h) = driver.act(stacker.push(obs))
             new_obs, rewards, terminals, truncs, ep_returns = env.step(actions)
             cuts = terminals | truncs
             memory.append_batch(
@@ -436,18 +441,24 @@ def train_apex_r2d2(cfg: Config, max_frames: Optional[int] = None) -> Dict[str, 
                         else:
                             s = memory.sample(local_batch, priority_beta(cfg, frames))
                             idx = s.idx
-                        info = driver.learn_local(
-                            sup.poison_maybe(s),
-                            global_size=len(memory) * nproc,
-                            beta=priority_beta(cfg, frames),
-                        )
+                        with obs_run.span("learn_step"):
+                            info = driver.learn_local(
+                                sup.poison_maybe(s),
+                                global_size=len(memory) * nproc,
+                                beta=priority_beta(cfg, frames),
+                            )
                     elif prefetcher is not None:
                         idx, batch = prefetcher.get()
-                        info = driver.learn_batch(sup.poison_maybe(batch))
+                        with obs_run.span("learn_step"):
+                            info = driver.learn_batch(sup.poison_maybe(batch))
                     else:
-                        s = memory.sample(local_batch, priority_beta(cfg, frames))
+                        with obs_run.span("replay_sample"):
+                            s = memory.sample(
+                                local_batch, priority_beta(cfg, frames)
+                            )
                         idx, batch = s.idx, to_device_seq_batch(s)
-                        info = driver.learn_batch(sup.poison_maybe(batch))
+                        with obs_run.span("learn_step"):
+                            info = driver.learn_batch(sup.poison_maybe(batch))
                     sup.maybe_stall()
                     if not sup.step_ok(info):
                         # same all-reduced-loss argument as apex.py: every
@@ -459,12 +470,14 @@ def train_apex_r2d2(cfg: Config, max_frames: Optional[int] = None) -> Dict[str, 
                         continue
                     memory.update_priorities(idx, np.asarray(info["priorities"]))
                     step = driver.step
+                    obs_run.after_learn_step(step)
                     if step - last_pub >= cfg.weight_publish_interval:
-                        driver.publish_weights()
+                        with obs_run.span("publish_weights"):
+                            driver.publish_weights()
                         last_pub = step
                     if step % cfg.metrics_interval == 0:
                         metrics.log(
-                            "train",
+                            "learn",
                             step=step,
                             frames=frames,
                             fps=metrics.fps(frames),
@@ -473,6 +486,15 @@ def train_apex_r2d2(cfg: Config, max_frames: Optional[int] = None) -> Dict[str, 
                             mean_return=float(np.mean(returns)) if returns else float("nan"),
                             sequences=len(memory),
                             staleness=step - last_pub,
+                        )
+                        obs_run.periodic(
+                            step,
+                            frames,
+                            replay_size=len(memory),
+                            replay_occupancy=round(
+                                len(memory) / max(memory.capacity, 1), 4
+                            ),
+                            weight_staleness=step - last_pub,
                         )
                     if is_main and cfg.eval_interval and step % cfg.eval_interval == 0:
                         metrics.log(
@@ -491,6 +513,7 @@ def train_apex_r2d2(cfg: Config, max_frames: Optional[int] = None) -> Dict[str, 
         if prefetcher is not None:
             prefetcher.close()
         sup.close()
+        obs_run.close(driver.step, frames)
 
     final_eval = _eval_r2d2_learner(cfg, env, driver) if is_main else {}
     if is_main:

@@ -43,6 +43,24 @@ class ShardedReplay:
         # append/sample/write-back so the learner keeps training on the
         # survivors instead of wedging (docs/RESILIENCE.md)
         self._dead: set = set()
+        self._reg = None  # obs registry (attach_registry); None = untracked
+
+    def attach_registry(self, registry, role: str = "replay") -> None:
+        """obs/ wiring: appended/sampled row counters + occupancy and
+        dead-shard gauges under the given role label."""
+        self._reg = registry
+        self._role = role
+        registry.gauge("replay_shards", role).set(len(self.shards))
+
+    def _observe(self) -> None:
+        if self._reg is None:
+            return
+        cap = self.shard_capacity * (len(self.shards) - len(self._dead))
+        self._reg.gauge("replay_size", self._role).set(len(self))
+        self._reg.gauge("replay_occupancy", self._role).set(
+            len(self) / max(cap, 1)
+        )
+        self._reg.gauge("replay_dead_shards", self._role).set(len(self._dead))
 
     @classmethod
     def build(
@@ -88,6 +106,9 @@ class ShardedReplay:
                 None if priorities is None else priorities[sl],
                 None if truncations is None else truncations[sl],
             )
+            if self._reg is not None:
+                self._reg.counter("replay_appended_rows", self._role).inc(lps)
+        self._observe()
 
     def __len__(self) -> int:
         return sum(len(s) for k, s in enumerate(self.shards) if k not in self._dead)
@@ -108,6 +129,7 @@ class ShardedReplay:
         if len(self._dead) >= len(self.shards) - 1 and k not in self._dead:
             raise RuntimeError("cannot drop the last surviving replay shard")
         self._dead.add(k)
+        self._observe()
 
     @property
     def dead_shards(self) -> Tuple[int, ...]:
@@ -153,6 +175,8 @@ class ShardedReplay:
             # share of total priority mass
             probs.append(b.prob * (totals[k] / totals.sum()))
 
+        if self._reg is not None:
+            self._reg.counter("replay_sampled_rows", self._role).inc(batch_size)
         cat = lambda f: np.concatenate([getattr(p, f) for p in parts])  # noqa: E731
         prob = np.concatenate(probs)
         weight = (n_global * np.maximum(prob, 1e-12)) ** (-beta)

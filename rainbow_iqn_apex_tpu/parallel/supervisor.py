@@ -119,9 +119,20 @@ class TrainSupervisor:
     plus retried IO: ``sup.save_checkpoint(...)`` / ``sup.save_replay(...)``.
     """
 
-    def __init__(self, cfg, metrics=None, injector: Optional[faults.FaultInjector] = None):
+    def __init__(
+        self,
+        cfg,
+        metrics=None,
+        injector: Optional[faults.FaultInjector] = None,
+        registry=None,
+    ):
         self.cfg = cfg
         self.metrics = metrics
+        # obs/ wiring: live supervisor gauges (strikes/rollbacks/stalls/IO
+        # faults) for /metrics scrapes.  Fault *counters* are folded from the
+        # fault rows by obs.health (the MetricsLogger observer), so the row
+        # funnel stays the single source and nothing double-counts.
+        self.registry = registry
         self.injector = injector if injector is not None else faults.get()
         self.policy = faults.RetryPolicy.from_config(cfg)
         self.max_nan_strikes = int(cfg.max_nan_strikes)
@@ -138,6 +149,11 @@ class TrainSupervisor:
     def _report(self, event: str, **fields) -> None:
         if self.metrics is not None:
             self.metrics.log("fault", event=event, **fields)
+        if self.registry is not None:
+            self.registry.gauge("nan_strikes", "supervisor").set(self.strikes)
+            self.registry.gauge("rollbacks", "supervisor").set(self.rollbacks)
+            self.registry.gauge("stalls", "supervisor").set(self.stalls)
+            self.registry.gauge("io_faults", "supervisor").set(self.io_faults)
 
     def _on_stall(self, elapsed: float) -> None:
         self._report("stalled_step", elapsed_s=round(elapsed, 3))
@@ -162,10 +178,13 @@ class TrainSupervisor:
         ``TrainAborted`` past the budget.  Caller re-places onto its mesh."""
         self.rollbacks += 1
         if self._snap is None:
+            self._report("train_aborted", reason="no_snapshot")
             raise TrainAborted(
                 "non-finite learn step before any good snapshot existed"
             )
         if self.strikes >= self.max_nan_strikes:
+            self._report("train_aborted", reason="strike_budget",
+                         strikes=self.strikes)
             raise TrainAborted(
                 f"{self.strikes} consecutive non-finite learn steps "
                 f"(budget {self.max_nan_strikes}); replay looks poisoned"

@@ -18,6 +18,7 @@ from typing import Any, Dict, Optional
 
 import numpy as np
 
+from rainbow_iqn_apex_tpu.obs import registry as obs_registry
 from rainbow_iqn_apex_tpu.utils.logging import MetricsLogger
 
 
@@ -27,14 +28,29 @@ class ServeMetrics:
     One instance is shared by the batcher (enqueue/shed), the worker (batch
     stats, request completion latencies) and the swap watcher (swap events);
     ``emit`` snapshots-and-resets the rolling window into one JSONL row.
+
+    Backed by the shared obs/ MetricRegistry (role "serve"): every recording
+    mirrors into registry counters/histograms so the /metrics exposition and
+    the JSONL rows read the same numbers.  The window/percentile logic (and
+    the whole ``record_*``/``emit``/``stats`` API) is unchanged — the
+    registry is an additional sink, not a replacement surface.
     """
 
     def __init__(
         self,
         logger: Optional[MetricsLogger] = None,
         latency_window: int = 65536,
+        registry: Optional[obs_registry.MetricRegistry] = None,
     ):
         self.logger = logger
+        self.registry = registry if registry is not None else obs_registry.get()
+        self._c_requests = self.registry.counter("serve_requests_total", "serve")
+        self._c_shed = self.registry.counter("serve_shed_total", "serve")
+        self._c_batches = self.registry.counter("serve_batches_total", "serve")
+        self._c_swaps = self.registry.counter("serve_swaps_total", "serve")
+        self._c_padded = self.registry.counter("serve_padded_rows_total", "serve")
+        self._g_queue = self.registry.gauge("serve_queue_depth", "serve")
+        self._h_latency = self.registry.histogram("serve_latency_ms", "serve")
         self._lock = threading.Lock()
         self._lat_ms: collections.deque = collections.deque(maxlen=latency_window)
         self._reset_window()
@@ -60,15 +76,21 @@ class ServeMetrics:
             self._win_queue_depth_sum += queue_depth
             self.total_requests += n_requests
             self.total_batches += 1
+        self._c_requests.inc(n_requests)
+        self._c_batches.inc()
+        self._c_padded.inc(padded)
+        self._g_queue.set(queue_depth)
 
     def record_latency_ms(self, latency_ms: float) -> None:
         with self._lock:
             self._lat_ms.append(latency_ms)
+        self._h_latency.observe(latency_ms)
 
     def record_shed(self, n: int = 1) -> None:
         with self._lock:
             self._win_shed += n
             self.total_shed += n
+        self._c_shed.inc(n)
 
     def record_swap(self, **fields: Any) -> None:
         """A completed (or failed) weight swap; always emitted immediately —
@@ -76,6 +98,7 @@ class ServeMetrics:
         periodic row."""
         with self._lock:
             self.total_swaps += 1
+        self._c_swaps.inc()
         if self.logger is not None:
             self.logger.log("swap", **fields)
 

@@ -28,6 +28,7 @@ import jax
 import numpy as np
 
 from rainbow_iqn_apex_tpu.config import Config
+from rainbow_iqn_apex_tpu.obs.export import ObsHTTPServer
 from rainbow_iqn_apex_tpu.serving.batcher import (
     MicroBatcher,
     ServeFuture,
@@ -100,6 +101,13 @@ class PolicyServer:
         self._metrics_interval_s = max(cfg.serve_metrics_interval_s, 0.0)
         self._worker: Optional[threading.Thread] = None
         self._started = False
+        # obs/: /metrics (Prometheus text off the shared registry ServeMetrics
+        # records into) + /healthz (shed/queue/worker-liveness status)
+        self.obs_http: Optional[ObsHTTPServer] = None
+        if int(getattr(cfg, "obs_http_port", 0) or 0) > 0:
+            self.obs_http = ObsHTTPServer(
+                self.metrics.registry, self.healthz, port=cfg.obs_http_port
+            )
 
     @classmethod
     def from_checkpoint(
@@ -157,6 +165,8 @@ class PolicyServer:
         self._worker.start()
         if self.watcher is not None:
             self.watcher.start()
+        if self.obs_http is not None:
+            self.obs_http.start()
         return self
 
     def stop(self, drain: bool = True) -> Dict[str, Any]:
@@ -177,6 +187,8 @@ class PolicyServer:
             if self._owns_checkpointer:
                 self._owns_checkpointer = False  # idempotent double-stop
                 self.watcher.ckpt.close()
+        if self.obs_http is not None:
+            self.obs_http.stop()
         self.metrics.emit(final=True)
         if self.metrics.logger is not None:
             self.metrics.logger.close()
@@ -229,6 +241,26 @@ class PolicyServer:
         version = self.engine.load_params(params)
         self.metrics.record_swap(ok=True, params_version=version, source="direct")
         return version
+
+    def healthz(self) -> Dict[str, Any]:
+        """Live status for /healthz: failing = the worker thread died under a
+        started server (nothing will drain the queue); degraded = shedding in
+        the current window or the queue is within 20% of its shed bound."""
+        snap = self.metrics.snapshot()
+        depth = self.batcher.depth()
+        worker_alive = self._worker is not None and self._worker.is_alive()
+        status = "ok"
+        if snap.get("shed", 0) > 0 or depth >= 0.8 * self.cfg.serve_queue_bound:
+            status = "degraded"
+        if self._started and not worker_alive:
+            status = "failing"
+        return {
+            "status": status,
+            "queue_depth": depth,
+            "worker_alive": worker_alive,
+            "params_version": self.engine.params_version,
+            **snap,
+        }
 
     def stats(self) -> Dict[str, Any]:
         return {

@@ -21,6 +21,7 @@ from rainbow_iqn_apex_tpu.utils.prefetch import BatchPrefetcher, make_replay_pre
 from rainbow_iqn_apex_tpu.config import Config
 from rainbow_iqn_apex_tpu.envs import make_vector_env
 from rainbow_iqn_apex_tpu.eval import evaluate
+from rainbow_iqn_apex_tpu.obs import RunObs
 from rainbow_iqn_apex_tpu.replay.buffer import PrioritizedReplay
 from rainbow_iqn_apex_tpu.utils import faults
 from rainbow_iqn_apex_tpu.utils.checkpoint import (
@@ -68,12 +69,13 @@ def train(cfg: Config, max_frames: Optional[int] = None) -> Dict[str, Any]:
     metrics = MetricsLogger(os.path.join(run_dir, "metrics.jsonl"), cfg.run_id)
     ckpt = Checkpointer(os.path.join(cfg.checkpoint_dir, cfg.run_id))
     faults.install_from(cfg)
+    obs_run = RunObs(cfg, metrics, role="learner")
     # deferred: the parallel package's __init__ imports the apex drivers,
     # which import THIS module (priority_beta) — a module-level import here
     # would be circular for `--role single` entry
     from rainbow_iqn_apex_tpu.parallel.supervisor import TrainSupervisor
 
-    sup = TrainSupervisor(cfg, metrics=metrics)
+    sup = TrainSupervisor(cfg, metrics=metrics, registry=obs_run.registry)
 
     frames = 0
     restored = maybe_resume(cfg, ckpt, agent.state)
@@ -93,7 +95,8 @@ def train(cfg: Config, max_frames: Optional[int] = None) -> Dict[str, Any]:
     try:
         while frames < total_frames:
             stacked = stacker.push(obs)
-            actions = agent.act(stacked)
+            with obs_run.span("act"):
+                actions = agent.act(stacked)
             new_obs, rewards, terminals, truncs, ep_returns = env.step(actions)
             # store the pre-step frame with the transition's reward/terminal
             # (reference memory layout: SURVEY §2 row 5 frame-dedup scheme).
@@ -121,11 +124,16 @@ def train(cfg: Config, max_frames: Optional[int] = None) -> Dict[str, Any]:
                     )
                     if prefetcher is not None:
                         idx, batch = prefetcher.get()
-                        info = agent.learn_batch(sup.poison_maybe(batch))
+                        with obs_run.span("learn_step"):
+                            info = agent.learn_batch(sup.poison_maybe(batch))
                     else:
-                        sample = memory.sample(cfg.batch_size, priority_beta(cfg, frames))
+                        with obs_run.span("replay_sample"):
+                            sample = memory.sample(
+                                cfg.batch_size, priority_beta(cfg, frames)
+                            )
                         idx = sample.idx
-                        info = agent.learn(sup.poison_maybe(sample))
+                        with obs_run.span("learn_step"):
+                            info = agent.learn(sup.poison_maybe(sample))
                     sup.maybe_stall()
                     if not sup.step_ok(info):
                         # non-finite step: quarantine the sampled rows
@@ -139,9 +147,10 @@ def train(cfg: Config, max_frames: Optional[int] = None) -> Dict[str, Any]:
                     memory.update_priorities(idx, np.asarray(info["priorities"]))
 
                     step = agent.step
+                    obs_run.after_learn_step(step)
                     if step % cfg.metrics_interval == 0:
                         metrics.log(
-                            "train",
+                            "learn",
                             step=step,
                             frames=frames,
                             fps=metrics.fps(frames),
@@ -149,6 +158,14 @@ def train(cfg: Config, max_frames: Optional[int] = None) -> Dict[str, Any]:
                             q_mean=float(info["q_mean"]),
                             grad_norm=float(info["grad_norm"]),
                             mean_return=float(np.mean(returns)) if returns else float("nan"),
+                        )
+                        obs_run.periodic(
+                            step,
+                            frames,
+                            replay_size=len(memory),
+                            replay_occupancy=round(
+                                len(memory) / max(cfg.memory_capacity, 1), 4
+                            ),
                         )
                     if cfg.eval_interval and step % cfg.eval_interval == 0:
                         last_eval = evaluate(cfg, agent, seed=cfg.seed + 977)
@@ -163,6 +180,7 @@ def train(cfg: Config, max_frames: Optional[int] = None) -> Dict[str, Any]:
         if prefetcher is not None:
             prefetcher.close()
         sup.close()
+        obs_run.close(agent.step, frames)
     final_eval = evaluate(cfg, agent, seed=cfg.seed + 977)
     metrics.log("eval", step=agent.step, **final_eval)
     sup.save_checkpoint(

@@ -33,6 +33,7 @@ import numpy as np
 from rainbow_iqn_apex_tpu.agents.agent import put_frames
 from rainbow_iqn_apex_tpu.config import Config
 from rainbow_iqn_apex_tpu.envs import make_vector_env
+from rainbow_iqn_apex_tpu.obs import RunObs
 from rainbow_iqn_apex_tpu.ops.learn import build_act_step, init_train_state
 from rainbow_iqn_apex_tpu.parallel.multihost import shift_stack
 from rainbow_iqn_apex_tpu.replay.device import DeviceReplay, build_device_learn
@@ -126,6 +127,7 @@ def train_anakin(cfg: Config, max_frames: Optional[int] = None) -> Dict[str, Any
     run_dir = os.path.join(cfg.results_dir, cfg.run_id)
     metrics = MetricsLogger(os.path.join(run_dir, "metrics.jsonl"), cfg.run_id)
     ckpt = Checkpointer(os.path.join(cfg.checkpoint_dir, cfg.run_id))
+    obs_run = RunObs(cfg, metrics, role="learner")
 
     frames = 0
     ticks = 0
@@ -145,56 +147,75 @@ def train_anakin(cfg: Config, max_frames: Optional[int] = None) -> Dict[str, Any
     returns: collections.deque = collections.deque(maxlen=100)
     device = jax.devices()[0]
 
-    while frames < total_frames:
-        frame_d = put_frames(obs)  # flat-byte staging (rank-3 put penalty)
-        keep_d = jax.device_put((~prev_cuts).astype(np.uint8), device)
-        key, k = jax.random.split(key)
-        actions_d, stack, ds = act_append(ts.params, stack, ds, frame_d, keep_d, prev, k)
-        actions = np.asarray(actions_d)
-        new_obs, rewards, terminals, truncs, ep_returns = env.step(actions)
-        # held for NEXT tick's append: reference memory layout (pre-step
-        # frame + this step's action/reward/terminal, SURVEY §2 row 5); the
-        # fresh-transition priority is the running max, exactly the
-        # reference's single-process insertion rule.
-        prev = (
-            frame_d,
-            actions_d,
-            jax.device_put(rewards.astype(np.float32), device),
-            jax.device_put(terminals, device),
-            jax.device_put(truncs, device),
-        )
-        prev_cuts = terminals | truncs
-        obs = new_obs
-        frames += lanes
-        ticks += 1
-        for r in ep_returns[~np.isnan(ep_returns)]:
-            returns.append(float(r))
+    try:
+        while frames < total_frames:
+            frame_d = put_frames(obs)  # flat-byte staging (rank-3 put penalty)
+            keep_d = jax.device_put((~prev_cuts).astype(np.uint8), device)
+            key, k = jax.random.split(key)
+            with obs_run.span("act_append"):
+                actions_d, stack, ds = act_append(
+                    ts.params, stack, ds, frame_d, keep_d, prev, k
+                )
+                actions = np.asarray(actions_d)
+            new_obs, rewards, terminals, truncs, ep_returns = env.step(actions)
+            # held for NEXT tick's append: reference memory layout (pre-step
+            # frame + this step's action/reward/terminal, SURVEY §2 row 5); the
+            # fresh-transition priority is the running max, exactly the
+            # reference's single-process insertion rule.
+            prev = (
+                frame_d,
+                actions_d,
+                jax.device_put(rewards.astype(np.float32), device),
+                jax.device_put(terminals, device),
+                jax.device_put(truncs, device),
+            )
+            prev_cuts = terminals | truncs
+            obs = new_obs
+            frames += lanes
+            ticks += 1
+            for r in ep_returns[~np.isnan(ep_returns)]:
+                returns.append(float(r))
 
-        # warmness from host-side lockstep counters (appends lag one tick)
-        stored = min(max(ticks - 1, 0), seg) * lanes
-        if stored >= cfg.learn_start and ticks - 1 > cfg.multi_step:
-            steps_due = frames // cfg.replay_ratio - learn_steps
-            for _ in range(max(steps_due, 0)):
-                key, k = jax.random.split(key)
-                ts, ds, info = fused(ts, ds, k, jnp.float32(priority_beta(cfg, frames)))
-                learn_steps += 1
-                if learn_steps % cfg.metrics_interval == 0:
-                    metrics.log(
-                        "train",
-                        step=learn_steps,
-                        frames=frames,
-                        fps=metrics.fps(frames),
-                        loss=float(info["loss"]),
-                        q_mean=float(info["q_mean"]),
-                        grad_norm=float(info["grad_norm"]),
-                        mean_return=float(np.mean(returns)) if returns else float("nan"),
-                    )
-                if cfg.eval_interval and learn_steps % cfg.eval_interval == 0:
-                    metrics.log("eval", step=learn_steps, **_eval(cfg, env, ts))
-                if cfg.checkpoint_interval and learn_steps % cfg.checkpoint_interval == 0:
-                    ckpt.save(learn_steps, ts, {"frames": frames})
-                    _save_replay(cfg, ds)
+            # warmness from host-side lockstep counters (appends lag one tick)
+            stored = min(max(ticks - 1, 0), seg) * lanes
+            if stored >= cfg.learn_start and ticks - 1 > cfg.multi_step:
+                steps_due = frames // cfg.replay_ratio - learn_steps
+                for _ in range(max(steps_due, 0)):
+                    key, k = jax.random.split(key)
+                    with obs_run.span("learn_step"):
+                        ts, ds, info = fused(
+                            ts, ds, k, jnp.float32(priority_beta(cfg, frames))
+                        )
+                    learn_steps += 1
+                    # no block_on: this loop's dispatches stay async between
+                    # metrics intervals, and a per-step barrier would kill the
+                    # host/device overlap that IS the anakin design.  StepTimer
+                    # laps then measure dispatch gaps — steady-state the device
+                    # queue throttles the host, so steps_per_sec stays true.
+                    obs_run.after_learn_step(learn_steps)
+                    if learn_steps % cfg.metrics_interval == 0:
+                        metrics.log(
+                            "learn",
+                            step=learn_steps,
+                            frames=frames,
+                            fps=metrics.fps(frames),
+                            loss=float(info["loss"]),
+                            q_mean=float(info["q_mean"]),
+                            grad_norm=float(info["grad_norm"]),
+                            mean_return=float(np.mean(returns)) if returns else float("nan"),
+                        )
+                        obs_run.periodic(
+                            learn_steps, frames,
+                            replay_occupancy=round(stored / cfg.memory_capacity, 4),
+                        )
+                    if cfg.eval_interval and learn_steps % cfg.eval_interval == 0:
+                        metrics.log("eval", step=learn_steps, **_eval(cfg, env, ts))
+                    if cfg.checkpoint_interval and learn_steps % cfg.checkpoint_interval == 0:
+                        ckpt.save(learn_steps, ts, {"frames": frames})
+                        _save_replay(cfg, ds)
 
+    finally:
+        obs_run.close(learn_steps, frames)
     final_eval = _eval(cfg, env, ts)
     metrics.log("eval", step=learn_steps, **final_eval)
     ckpt.save(learn_steps, ts, {"frames": frames})
@@ -429,6 +450,7 @@ def train_anakin_fused(cfg: Config, max_frames: Optional[int] = None) -> Dict[st
     run_dir = os.path.join(cfg.results_dir, cfg.run_id)
     metrics = MetricsLogger(os.path.join(run_dir, "metrics.jsonl"), cfg.run_id)
     ckpt = Checkpointer(os.path.join(cfg.checkpoint_dir, cfg.run_id))
+    obs_run = RunObs(cfg, metrics, role="learner")
 
     frames = 0
     ds = replay.init_state()
@@ -462,37 +484,45 @@ def train_anakin_fused(cfg: Config, max_frames: Optional[int] = None) -> Dict[st
     def crossed(interval: int, before: int, after: int) -> bool:
         return interval > 0 and before // interval != after // interval
 
-    while frames < total_frames:
-        key, k = jax.random.split(key)
-        carry, (out_ret, loss, q_mean, grad_norm) = segment(carry, k)
-        ts, ds = carry[0], carry[1]
-        frames += T * lanes
-        prev_steps = learn_steps
-        learn_steps = int(ts.step)  # the in-graph counter is authoritative
-        for r in np.asarray(out_ret)[~np.isnan(np.asarray(out_ret))]:
-            returns.append(float(r))
+    try:
+        while frames < total_frames:
+            key, k = jax.random.split(key)
+            with obs_run.span("segment", ticks=T):
+                carry, (out_ret, loss, q_mean, grad_norm) = segment(carry, k)
+                ts, ds = carry[0], carry[1]
+                frames += T * lanes
+                prev_steps = learn_steps
+                learn_steps = int(ts.step)  # in-graph counter is authoritative
+            # the segment IS the dispatch unit here; the int(ts.step) readback
+            # above already synced, so the lap needs no extra block
+            obs_run.after_learn_step(learn_steps)
+            for r in np.asarray(out_ret)[~np.isnan(np.asarray(out_ret))]:
+                returns.append(float(r))
 
-        if crossed(cfg.metrics_interval, prev_steps, learn_steps):
-            l = np.asarray(loss)
-            metrics.log(
-                "train",
-                step=learn_steps,
-                frames=frames,
-                fps=metrics.fps(frames),
-                loss=float(np.nanmean(l)) if np.any(~np.isnan(l)) else float("nan"),
-                q_mean=float(np.nanmean(np.asarray(q_mean)))
-                if np.any(~np.isnan(np.asarray(q_mean))) else float("nan"),
-                grad_norm=float(np.nanmean(np.asarray(grad_norm)))
-                if np.any(~np.isnan(np.asarray(grad_norm))) else float("nan"),
-                mean_return=float(np.mean(returns)) if returns else float("nan"),
-            )
-        if crossed(cfg.eval_interval, prev_steps, learn_steps):
-            metrics.log("eval", step=learn_steps,
-                        **run_eval(carry[0].params, learn_steps))
-        if crossed(cfg.checkpoint_interval, prev_steps, learn_steps):
-            ckpt.save(learn_steps, ts, {"frames": frames})
-            _save_replay(cfg, ds)
+            if crossed(cfg.metrics_interval, prev_steps, learn_steps):
+                l = np.asarray(loss)
+                metrics.log(
+                    "learn",
+                    step=learn_steps,
+                    frames=frames,
+                    fps=metrics.fps(frames),
+                    loss=float(np.nanmean(l)) if np.any(~np.isnan(l)) else float("nan"),
+                    q_mean=float(np.nanmean(np.asarray(q_mean)))
+                    if np.any(~np.isnan(np.asarray(q_mean))) else float("nan"),
+                    grad_norm=float(np.nanmean(np.asarray(grad_norm)))
+                    if np.any(~np.isnan(np.asarray(grad_norm))) else float("nan"),
+                    mean_return=float(np.mean(returns)) if returns else float("nan"),
+                )
+                obs_run.periodic(learn_steps, frames)
+            if crossed(cfg.eval_interval, prev_steps, learn_steps):
+                metrics.log("eval", step=learn_steps,
+                            **run_eval(carry[0].params, learn_steps))
+            if crossed(cfg.checkpoint_interval, prev_steps, learn_steps):
+                ckpt.save(learn_steps, ts, {"frames": frames})
+                _save_replay(cfg, ds)
 
+    finally:
+        obs_run.close(learn_steps, frames)
     final_eval = run_eval(carry[0].params, learn_steps)
     metrics.log("eval", step=learn_steps, **final_eval)
     ckpt.save(learn_steps, ts, {"frames": frames})

@@ -38,6 +38,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from rainbow_iqn_apex_tpu.config import Config
+from rainbow_iqn_apex_tpu.obs import RunObs
 from rainbow_iqn_apex_tpu.ops.r2d2 import (
     build_r2d2_act_step,
     init_r2d2_state,
@@ -328,6 +329,7 @@ def train_anakin_r2d2(cfg: Config,
     run_dir = os.path.join(cfg.results_dir, cfg.run_id)
     metrics = MetricsLogger(os.path.join(run_dir, "metrics.jsonl"), cfg.run_id)
     ckpt = Checkpointer(os.path.join(cfg.checkpoint_dir, cfg.run_id))
+    obs_run = RunObs(cfg, metrics, role="learner")
 
     frames = 0
     ss = ss0
@@ -356,37 +358,43 @@ def train_anakin_r2d2(cfg: Config,
     def crossed(interval: int, before: int, after: int) -> bool:
         return interval > 0 and before // interval != after // interval
 
-    while frames < total_frames:
-        key, k = jax.random.split(key)
-        carry, (out_ret, loss, q_mean, grad_norm) = segment(carry, k)
-        ts, ss = carry[0], carry[1]
-        frames += T * lanes
-        prev_steps = learn_steps
-        learn_steps = int(ts.step)
-        for r in np.asarray(out_ret)[~np.isnan(np.asarray(out_ret))]:
-            returns.append(float(r))
+    try:
+        while frames < total_frames:
+            key, k = jax.random.split(key)
+            with obs_run.span("segment", ticks=T):
+                carry, (out_ret, loss, q_mean, grad_norm) = segment(carry, k)
+                ts, ss = carry[0], carry[1]
+                frames += T * lanes
+                prev_steps = learn_steps
+                learn_steps = int(ts.step)
+            obs_run.after_learn_step(learn_steps)
+            for r in np.asarray(out_ret)[~np.isnan(np.asarray(out_ret))]:
+                returns.append(float(r))
 
-        if crossed(cfg.metrics_interval, prev_steps, learn_steps):
-            l = np.asarray(loss)
-            metrics.log(
-                "train",
-                step=learn_steps,
-                frames=frames,
-                fps=metrics.fps(frames),
-                loss=float(np.nanmean(l)) if np.any(~np.isnan(l)) else float("nan"),
-                q_mean=float(np.nanmean(np.asarray(q_mean)))
-                if np.any(~np.isnan(np.asarray(q_mean))) else float("nan"),
-                grad_norm=float(np.nanmean(np.asarray(grad_norm)))
-                if np.any(~np.isnan(np.asarray(grad_norm))) else float("nan"),
-                mean_return=float(np.mean(returns)) if returns else float("nan"),
-            )
-        if crossed(cfg.eval_interval, prev_steps, learn_steps):
-            metrics.log("eval", step=learn_steps,
-                        **run_eval(carry[0].params, learn_steps))
-        if crossed(cfg.checkpoint_interval, prev_steps, learn_steps):
-            ckpt.save(learn_steps, ts, {"frames": frames})
-            _save_replay(cfg, ss)
+            if crossed(cfg.metrics_interval, prev_steps, learn_steps):
+                l = np.asarray(loss)
+                metrics.log(
+                    "learn",
+                    step=learn_steps,
+                    frames=frames,
+                    fps=metrics.fps(frames),
+                    loss=float(np.nanmean(l)) if np.any(~np.isnan(l)) else float("nan"),
+                    q_mean=float(np.nanmean(np.asarray(q_mean)))
+                    if np.any(~np.isnan(np.asarray(q_mean))) else float("nan"),
+                    grad_norm=float(np.nanmean(np.asarray(grad_norm)))
+                    if np.any(~np.isnan(np.asarray(grad_norm))) else float("nan"),
+                    mean_return=float(np.mean(returns)) if returns else float("nan"),
+                )
+                obs_run.periodic(learn_steps, frames)
+            if crossed(cfg.eval_interval, prev_steps, learn_steps):
+                metrics.log("eval", step=learn_steps,
+                            **run_eval(carry[0].params, learn_steps))
+            if crossed(cfg.checkpoint_interval, prev_steps, learn_steps):
+                ckpt.save(learn_steps, ts, {"frames": frames})
+                _save_replay(cfg, ss)
 
+    finally:
+        obs_run.close(learn_steps, frames)
     final_eval = run_eval(carry[0].params, learn_steps)
     metrics.log("eval", step=learn_steps, **final_eval)
     ckpt.save(learn_steps, ts, {"frames": frames})
@@ -451,6 +459,7 @@ def _train_anakin_r2d2_hostfed(cfg: Config,
     run_dir = os.path.join(cfg.results_dir, cfg.run_id)
     metrics = MetricsLogger(os.path.join(run_dir, "metrics.jsonl"), cfg.run_id)
     ckpt = Checkpointer(os.path.join(cfg.checkpoint_dir, cfg.run_id))
+    obs_run = RunObs(cfg, metrics, role="learner")
 
     frames = 0
     ss = replay.init_state()
@@ -485,69 +494,77 @@ def _train_anakin_r2d2_hostfed(cfg: Config,
         eval_agent.state = ts
         return evaluate_r2d2(cfg, eval_agent, seed=cfg.seed + 977)
 
-    while frames < total_frames:
-        frame_d = put_frames(obs)
-        keep_d = jax.device_put((~prev_cuts).astype(np.uint8), device)
-        key, k = jax.random.split(key)
-        actions_d, stack, ss, lstm, pre = act_append(
-            ts.params, stack, ss, lstm, frame_d, keep_d, prev, k
-        )
-        actions = np.asarray(actions_d)
-        new_obs, rewards, terminals, truncs, ep_returns = env.step(actions)
-        prev = (
-            frame_d,
-            actions_d,
-            jax.device_put(rewards.astype(np.float32), device),
-            jax.device_put(terminals, device),
-            jax.device_put(truncs, device),
-            pre[0],
-            pre[1],
-        )
-        prev_cuts = terminals | truncs
-        obs = new_obs
-        frames += lanes
-        for r in ep_returns[~np.isnan(ep_returns)]:
-            returns.append(float(r))
-
-        # warm gate on the ring's own sequence count (one scalar readback
-        # per tick until it opens — the fused path avoids even this)
-        if not warm and int(jax.device_get(ss.filled)) >= learn_start_seqs:
-            warm = True
-            # cadence counts from the warm-open point: without this, the
-            # first tick would owe ~learn_start/frames_per_step catch-up
-            # steps against a minimally-filled ring (heavy early sample
-            # reuse, ADVICE r3) — the fused path's static cadence has no
-            # such burst, and A/B parity with it matters more than parity
-            # with train_r2d2's cold-start spike.  Both counters are
-            # latched so a resumed run (restored frames/learn_steps) keeps
-            # its cadence instead of stalling against the old totals.
-            warm_open_frames = frames
-            warm_open_steps = learn_steps
-        if warm:
-            steps_due = ((frames - warm_open_frames) // frames_per_step
-                         - (learn_steps - warm_open_steps))
-            for _ in range(max(steps_due, 0)):
-                key, k = jax.random.split(key)
-                ts, ss, info = learn(
-                    ts, ss, k, jnp.float32(priority_beta(cfg, frames))
+    try:
+        while frames < total_frames:
+            frame_d = put_frames(obs)
+            keep_d = jax.device_put((~prev_cuts).astype(np.uint8), device)
+            key, k = jax.random.split(key)
+            with obs_run.span("act_append"):
+                actions_d, stack, ss, lstm, pre = act_append(
+                    ts.params, stack, ss, lstm, frame_d, keep_d, prev, k
                 )
-                learn_steps += 1
-                if learn_steps % cfg.metrics_interval == 0:
-                    metrics.log(
-                        "train", step=learn_steps, frames=frames,
-                        fps=metrics.fps(frames), loss=float(info["loss"]),
-                        q_mean=float(info["q_mean"]),
-                        grad_norm=float(info["grad_norm"]),
-                        mean_return=float(np.mean(returns))
-                        if returns else float("nan"),
-                    )
-                if cfg.eval_interval and learn_steps % cfg.eval_interval == 0:
-                    metrics.log("eval", step=learn_steps, **run_eval(ts))
-                if (cfg.checkpoint_interval
-                        and learn_steps % cfg.checkpoint_interval == 0):
-                    ckpt.save(learn_steps, ts, {"frames": frames})
-                    _save_replay(cfg, ss)
+                actions = np.asarray(actions_d)
+            new_obs, rewards, terminals, truncs, ep_returns = env.step(actions)
+            prev = (
+                frame_d,
+                actions_d,
+                jax.device_put(rewards.astype(np.float32), device),
+                jax.device_put(terminals, device),
+                jax.device_put(truncs, device),
+                pre[0],
+                pre[1],
+            )
+            prev_cuts = terminals | truncs
+            obs = new_obs
+            frames += lanes
+            for r in ep_returns[~np.isnan(ep_returns)]:
+                returns.append(float(r))
 
+            # warm gate on the ring's own sequence count (one scalar readback
+            # per tick until it opens — the fused path avoids even this)
+            if not warm and int(jax.device_get(ss.filled)) >= learn_start_seqs:
+                warm = True
+                # cadence counts from the warm-open point: without this, the
+                # first tick would owe ~learn_start/frames_per_step catch-up
+                # steps against a minimally-filled ring (heavy early sample
+                # reuse, ADVICE r3) — the fused path's static cadence has no
+                # such burst, and A/B parity with it matters more than parity
+                # with train_r2d2's cold-start spike.  Both counters are
+                # latched so a resumed run (restored frames/learn_steps) keeps
+                # its cadence instead of stalling against the old totals.
+                warm_open_frames = frames
+                warm_open_steps = learn_steps
+            if warm:
+                steps_due = ((frames - warm_open_frames) // frames_per_step
+                             - (learn_steps - warm_open_steps))
+                for _ in range(max(steps_due, 0)):
+                    key, k = jax.random.split(key)
+                    with obs_run.span("learn_step"):
+                        ts, ss, info = learn(
+                            ts, ss, k, jnp.float32(priority_beta(cfg, frames))
+                        )
+                    learn_steps += 1
+                    # no block_on (see train_anakin.py): keep the dispatch async
+                    obs_run.after_learn_step(learn_steps)
+                    if learn_steps % cfg.metrics_interval == 0:
+                        metrics.log(
+                            "learn", step=learn_steps, frames=frames,
+                            fps=metrics.fps(frames), loss=float(info["loss"]),
+                            q_mean=float(info["q_mean"]),
+                            grad_norm=float(info["grad_norm"]),
+                            mean_return=float(np.mean(returns))
+                            if returns else float("nan"),
+                        )
+                        obs_run.periodic(learn_steps, frames)
+                    if cfg.eval_interval and learn_steps % cfg.eval_interval == 0:
+                        metrics.log("eval", step=learn_steps, **run_eval(ts))
+                    if (cfg.checkpoint_interval
+                            and learn_steps % cfg.checkpoint_interval == 0):
+                        ckpt.save(learn_steps, ts, {"frames": frames})
+                        _save_replay(cfg, ss)
+
+    finally:
+        obs_run.close(learn_steps, frames)
     final_eval = run_eval(ts)
     metrics.log("eval", step=learn_steps, **final_eval)
     ckpt.save(learn_steps, ts, {"frames": frames})

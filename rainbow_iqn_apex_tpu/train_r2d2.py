@@ -19,6 +19,7 @@ import numpy as np
 from rainbow_iqn_apex_tpu.agents.agent import FrameStacker
 from rainbow_iqn_apex_tpu.config import Config
 from rainbow_iqn_apex_tpu.envs import make_env, make_vector_env
+from rainbow_iqn_apex_tpu.obs import RunObs
 from rainbow_iqn_apex_tpu.ops.r2d2 import (
     as_actor_input,
     build_r2d2_act_step,
@@ -145,6 +146,7 @@ def train_r2d2(cfg: Config, max_frames: Optional[int] = None) -> Dict[str, Any]:
     run_dir = os.path.join(cfg.results_dir, cfg.run_id)
     metrics = MetricsLogger(os.path.join(run_dir, "metrics.jsonl"), cfg.run_id)
     ckpt = Checkpointer(os.path.join(cfg.checkpoint_dir, cfg.run_id))
+    obs_run = RunObs(cfg, metrics, role="learner")
 
     frames = 0
     restored = maybe_resume(cfg, ckpt, agent.state)
@@ -161,51 +163,63 @@ def train_r2d2(cfg: Config, max_frames: Optional[int] = None) -> Dict[str, Any]:
     returns: collections.deque = collections.deque(maxlen=100)
     learn_start_seqs = max(cfg.learn_start // seq_total, 8)
 
-    while frames < total_frames:
-        state_c, state_h = np.asarray(lstm_state[0]), np.asarray(lstm_state[1])
-        stacked = stacker.push(obs)  # actor sees the frame-stacked input
-        actions, lstm_state = agent.act(stacked, lstm_state)
-        new_obs, rewards, terminals, truncs, ep_returns = env.step(actions)
-        cuts = terminals | truncs  # truncation ends the sequence window too
-        # the replay stores SINGLE frames; the learn step re-stacks on device
-        memory.append_batch(
-            obs, actions, rewards, terminals, state_c, state_h, truncations=truncs
-        )
-        lstm_state = _mask_reset(lstm_state, cuts)
-        stacker.reset_lanes(cuts)
-        obs = new_obs
-        frames += lanes
-        for r in ep_returns[~np.isnan(ep_returns)]:
-            returns.append(float(r))
+    try:
+        while frames < total_frames:
+            state_c, state_h = np.asarray(lstm_state[0]), np.asarray(lstm_state[1])
+            stacked = stacker.push(obs)  # actor sees the frame-stacked input
+            with obs_run.span("act"):
+                actions, lstm_state = agent.act(stacked, lstm_state)
+            new_obs, rewards, terminals, truncs, ep_returns = env.step(actions)
+            cuts = terminals | truncs  # truncation ends the sequence window too
+            # the replay stores SINGLE frames; the learn step re-stacks on device
+            memory.append_batch(
+                obs, actions, rewards, terminals, state_c, state_h, truncations=truncs
+            )
+            lstm_state = _mask_reset(lstm_state, cuts)
+            stacker.reset_lanes(cuts)
+            obs = new_obs
+            frames += lanes
+            for r in ep_returns[~np.isnan(ep_returns)]:
+                returns.append(float(r))
 
-        if len(memory) >= learn_start_seqs:
-            # Cadence normalised to the SAME per-transition reuse as the
-            # feedforward path: an IQN step consumes batch_size transitions
-            # per replay_ratio frames; an R2D2 step consumes batch_size
-            # sequences x seq_len trained steps, so one learn step per
-            # replay_ratio * seq_len env frames gives identical reuse.
-            frames_per_step = cfg.replay_ratio * cfg.r2d2_seq_len
-            steps_due = frames // frames_per_step - agent.step
-            for _ in range(max(steps_due, 0)):
-                sample = memory.sample(cfg.batch_size, priority_beta(cfg, frames))
-                info = agent.learn(sample)
-                memory.update_priorities(sample.idx, np.asarray(info["priorities"]))
-                step = agent.step
-                if step % cfg.metrics_interval == 0:
-                    metrics.log(
-                        "train",
-                        step=step,
-                        frames=frames,
-                        fps=metrics.fps(frames),
-                        loss=float(info["loss"]),
-                        q_mean=float(info["q_mean"]),
-                        mean_return=float(np.mean(returns)) if returns else float("nan"),
-                        sequences=len(memory),
-                    )
-                if cfg.checkpoint_interval and step % cfg.checkpoint_interval == 0:
-                    ckpt.save(step, agent.state, {"frames": frames})
-                    save_replay_snapshot(cfg, memory)
+            if len(memory) >= learn_start_seqs:
+                # Cadence normalised to the SAME per-transition reuse as the
+                # feedforward path: an IQN step consumes batch_size transitions
+                # per replay_ratio frames; an R2D2 step consumes batch_size
+                # sequences x seq_len trained steps, so one learn step per
+                # replay_ratio * seq_len env frames gives identical reuse.
+                frames_per_step = cfg.replay_ratio * cfg.r2d2_seq_len
+                steps_due = frames // frames_per_step - agent.step
+                for _ in range(max(steps_due, 0)):
+                    with obs_run.span("replay_sample"):
+                        sample = memory.sample(
+                            cfg.batch_size, priority_beta(cfg, frames)
+                        )
+                    with obs_run.span("learn_step"):
+                        info = agent.learn(sample)
+                    memory.update_priorities(sample.idx, np.asarray(info["priorities"]))
+                    step = agent.step
+                    # the priority write-back above already synced on the step's
+                    # outputs; a second barrier would be redundant
+                    obs_run.after_learn_step(step)
+                    if step % cfg.metrics_interval == 0:
+                        metrics.log(
+                            "learn",
+                            step=step,
+                            frames=frames,
+                            fps=metrics.fps(frames),
+                            loss=float(info["loss"]),
+                            q_mean=float(info["q_mean"]),
+                            mean_return=float(np.mean(returns)) if returns else float("nan"),
+                            sequences=len(memory),
+                        )
+                        obs_run.periodic(step, frames, replay_size=len(memory))
+                    if cfg.checkpoint_interval and step % cfg.checkpoint_interval == 0:
+                        ckpt.save(step, agent.state, {"frames": frames})
+                        save_replay_snapshot(cfg, memory)
 
+    finally:
+        obs_run.close(agent.step, frames)
     final_eval = evaluate_r2d2(cfg, agent, seed=cfg.seed + 977)
     metrics.log("eval", step=agent.step, **final_eval)
     ckpt.save(agent.step, agent.state, {"frames": frames})

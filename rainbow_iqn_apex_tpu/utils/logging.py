@@ -4,6 +4,16 @@ Parity: the reference logs episode scores to stdout and plots curves
 (SURVEY.md §5 "Metrics/logging"); the build contract upgrades this to
 structured JSONL rows (one object per line, machine-readable) plus the same
 human-readable stdout stream.
+
+Every row carries the shared obs/ envelope (schema version, absolute ``ts``
+wall clock, ``host`` process index — obs/schema.py) and is STRICT JSON:
+``json.dumps(float("nan"))`` emits bare ``NaN``, which is invalid JSON and
+broke downstream parsers on PR 2's fault rows, so non-finite floats are
+sanitized (NaN -> null, +/-inf -> "inf"/"-inf") before serialisation.
+
+Observers: ``add_observer(fn)`` registers a callback invoked with every
+sanitized row — obs/health.RunHealth uses this to fold fault/serve rows into
+the run's health state without coupling to their emitters.
 """
 
 from __future__ import annotations
@@ -12,16 +22,25 @@ import json
 import os
 import sys
 import time
-from typing import Any, Dict, Optional
+from typing import Any, Callable, Dict, List, Optional
+
+from rainbow_iqn_apex_tpu.obs.schema import SCHEMA_VERSION, sanitize
 
 
 class MetricsLogger:
     """Append-only JSONL metrics with wall-clock stamps and an FPS meter."""
 
-    def __init__(self, path: Optional[str], run_id: str = "run", echo: bool = True):
+    def __init__(
+        self,
+        path: Optional[str],
+        run_id: str = "run",
+        echo: bool = True,
+        host: int = 0,
+    ):
         self.path = path
         self.echo = echo
         self.run_id = run_id
+        self.host = int(host)
         self._fh = None
         if path:
             os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
@@ -29,20 +48,40 @@ class MetricsLogger:
         self._t0 = time.time()
         self._last_t: Optional[float] = None
         self._last_frames = 0
+        self._observers: List[Callable[[Dict[str, Any]], None]] = []
+
+    def add_observer(self, fn: Callable[[Dict[str, Any]], None]) -> None:
+        """Register a callback receiving every sanitized row dict."""
+        self._observers.append(fn)
 
     def log(self, kind: str, **fields: Any) -> Dict[str, Any]:
-        row = {
-            "t": round(time.time() - self._t0, 3),
-            "run": self.run_id,
-            "kind": kind,
-            **fields,
-        }
+        now = time.time()
+        row = sanitize(
+            {
+                "t": round(now - self._t0, 3),
+                "ts": round(now, 3),
+                "host": self.host,
+                "run": self.run_id,
+                "kind": kind,
+                "schema": SCHEMA_VERSION,
+                **fields,
+            }
+        )
         if self._fh:
-            self._fh.write(json.dumps(row) + "\n")
+            # allow_nan=False is the backstop: sanitize() already cleared
+            # non-finite floats, so a bare NaN can never reach the file
+            self._fh.write(json.dumps(row, allow_nan=False) + "\n")
+        for fn in self._observers:
+            try:
+                fn(row)
+            except Exception:
+                pass  # a broken observer must never kill the training loop
         if self.echo:
+            skip = ("t", "ts", "host", "run", "kind", "schema")
             pretty = " ".join(
                 f"{k}={v:.4g}" if isinstance(v, float) else f"{k}={v}"
-                for k, v in fields.items()
+                for k, v in row.items()
+                if k not in skip
             )
             print(f"[{row['t']:9.1f}s] {kind:8s} {pretty}", file=sys.stderr)
         return row

@@ -64,6 +64,7 @@ class StepTimer:
             "steps": n,
             "mean_s": sum(laps) / n,
             "p50_s": laps[n // 2],
-            "p90_s": laps[int(n * 0.9)],
+            "p90_s": laps[min(int(n * 0.9), n - 1)],
+            "p99_s": laps[min(int(n * 0.99), n - 1)],
             "steps_per_sec": n / sum(laps),
         }

@@ -1,0 +1,248 @@
+#!/usr/bin/env python
+"""obs_report: one command from a run dir's JSONL to "is this run healthy
+and where is the time going".
+
+    python scripts/obs_report.py <run_dir | metrics.jsonl> [--json]
+
+Reads every *.jsonl under the run dir (a run writes metrics.jsonl; serving
+side-cars land next to it), validates each line against the obs/ schema
+(strict JSON — a bare NaN is a lint error, not a parse pass), and prints:
+
+  * per-role throughput: env frames/sec (learn rows), learner steps/sec and
+    learn-step p50/p99 (timing rows), serve request/batch totals;
+  * replay occupancy, batch occupancy + pad tax (serve rows);
+  * compile counts and span aggregates (timing rows);
+  * fault totals by event, shed totals, dead hosts;
+  * final eval and overall health (last health row + worst status seen).
+
+Exit codes: 0 = report printed; 1 = no rows found (empty/missing run);
+2 = report printed but some lines failed lint (broken producer).
+
+The schema is versioned (obs/schema.py); this tool is the reference
+consumer the golden-schema test keeps honest.  docs/OBSERVABILITY.md walks
+through reading a report.
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import sys
+from typing import Any, Dict, List, Tuple
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO)
+
+from rainbow_iqn_apex_tpu.obs.schema import validate_row  # noqa: E402
+from scripts.lint_jsonl import lint_line  # noqa: E402
+
+
+def find_jsonl(path: str) -> List[str]:
+    if os.path.isfile(path):
+        return [path]
+    hits = sorted(glob.glob(os.path.join(path, "**", "*.jsonl"), recursive=True))
+    return hits
+
+
+def load_rows(paths: List[str]) -> Tuple[List[Dict[str, Any]], List[str]]:
+    rows, errors = [], []
+    for path in paths:
+        with open(path) as fh:
+            for lineno, line in enumerate(fh, 1):
+                if not line.strip():
+                    continue
+                err = lint_line(line)
+                if err is not None:
+                    errors.append(f"{path}:{lineno}: {err}")
+                    continue
+                row = json.loads(line)
+                schema_errs = validate_row(row)
+                if schema_errs:
+                    errors.append(f"{path}:{lineno}: {'; '.join(schema_errs)}")
+                rows.append(row)
+    return rows, errors
+
+
+def _last(rows: List[Dict[str, Any]], kind: str) -> Dict[str, Any]:
+    for row in reversed(rows):
+        if row.get("kind") == kind:
+            return row
+    return {}
+
+
+def _last_with(rows: List[Dict[str, Any]], kind: str, key: str) -> Dict[str, Any]:
+    """Last row of ``kind`` that carries ``key`` — the final flush at close
+    emits without per-loop gauges, so "last row" alone can hide them."""
+    for row in reversed(rows):
+        if row.get("kind") == kind and row.get(key) is not None:
+            return row
+    return {}
+
+
+def _mean(vals: List[float]) -> float:
+    return sum(vals) / len(vals) if vals else 0.0
+
+
+def aggregate(rows: List[Dict[str, Any]]) -> Dict[str, Any]:
+    by_kind: Dict[str, List[Dict[str, Any]]] = {}
+    for row in rows:
+        by_kind.setdefault(str(row.get("kind")), []).append(row)
+
+    learn = by_kind.get("learn", [])
+    timing = by_kind.get("timing", [])
+    serve = by_kind.get("serve", [])
+    health = by_kind.get("health", [])
+    faults = by_kind.get("fault", [])
+
+    last_learn = _last(rows, "learn")
+    last_timing = _last(rows, "timing")
+    last_health = _last(rows, "health")
+    last_eval = _last(rows, "eval")
+
+    fault_counts: Dict[str, int] = {}
+    for row in faults:
+        ev = str(row.get("event", "unknown"))
+        fault_counts[ev] = fault_counts.get(ev, 0) + 1
+
+    serve_requests = sum(int(r.get("requests", 0)) for r in serve)
+    serve_batches = sum(int(r.get("batches", 0)) for r in serve)
+    shed_total = sum(int(r.get("shed", 0)) for r in serve)
+
+    statuses = [str(r.get("status", "ok")) for r in health]
+    order = {"ok": 0, "degraded": 1, "failing": 2}
+    worst = max(statuses, key=lambda s: order.get(s, 0)) if statuses else None
+
+    # the final flush at close() resets span windows right after the last
+    # periodic row, so the very last timing row's spans can be empty — show
+    # the last window that actually observed spans
+    span_stats = last_timing.get("spans") or {}
+    if not any(s.get("count") for s in span_stats.values()):
+        for row in reversed(timing):
+            spans = row.get("spans") or {}
+            if any(s.get("count") for s in spans.values()):
+                span_stats = spans
+                break
+    report = {
+        "rows": len(rows),
+        "row_kinds": {k: len(v) for k, v in sorted(by_kind.items())},
+        "roles": {
+            "actor": {
+                "frames": int(last_learn.get("frames", 0)),
+                "fps_last": float(last_learn.get("fps") or 0.0),
+                "fps_mean": round(
+                    _mean([float(r.get("fps") or 0.0)
+                           for r in learn if r.get("fps")]), 2),
+            },
+            "learner": {
+                "steps": int(last_learn.get("step", 0)
+                             or last_timing.get("step", 0)),
+                "steps_per_sec": float(
+                    last_timing.get("learn_steps_per_sec", 0.0) or 0.0),
+                "step_p50_s": last_timing.get("learn_p50_s"),
+                "step_p99_s": last_timing.get("learn_p99_s"),
+            },
+            "replay": {
+                "size": _last_with(rows, "health", "replay_size")
+                .get("replay_size"),
+                "occupancy": _last_with(rows, "health", "replay_occupancy")
+                .get("replay_occupancy"),
+            },
+            "serve": {
+                "requests": serve_requests,
+                "batches": serve_batches,
+                "shed": shed_total,
+                "batch_occupancy_mean": round(
+                    _mean([float(r.get("batch_occupancy_mean", 0.0))
+                           for r in serve if r.get("batches")]), 3),
+                "pad_fraction_mean": round(
+                    _mean([float(r.get("pad_fraction", 0.0))
+                           for r in serve if r.get("batches")]), 4),
+                "latency_p99_ms": _last(rows, "serve").get("latency_p99_ms"),
+            },
+        },
+        "compiles": last_timing.get("compiles"),
+        "spans": span_stats,
+        "faults": fault_counts,
+        "shed_total": shed_total,
+        "final_eval": {
+            k: v for k, v in last_eval.items()
+            if k.startswith("score") or k in ("episodes", "human_normalized")
+        },
+        "health": {
+            "last_status": last_health.get("status"),
+            "worst_status": worst,
+            "rows": len(health),
+            "hosts_dead": last_health.get("hosts_dead", []),
+        },
+    }
+    return report
+
+
+def render(report: Dict[str, Any]) -> str:
+    roles = report["roles"]
+    lines = [
+        "== obs_report ==",
+        f"rows: {report['rows']}  kinds: {report['row_kinds']}",
+        (f"actor:   frames={roles['actor']['frames']}  "
+         f"fps last={roles['actor']['fps_last']:.1f} "
+         f"mean={roles['actor']['fps_mean']:.1f}"),
+        (f"learner: steps={roles['learner']['steps']}  "
+         f"steps/s={roles['learner']['steps_per_sec']:.2f}  "
+         f"step p50={roles['learner']['step_p50_s']}s "
+         f"p99={roles['learner']['step_p99_s']}s"),
+        (f"replay:  size={roles['replay']['size']}  "
+         f"occupancy={roles['replay']['occupancy']}"),
+        (f"serve:   requests={roles['serve']['requests']}  "
+         f"batches={roles['serve']['batches']}  "
+         f"shed={roles['serve']['shed']}  "
+         f"batch_occupancy={roles['serve']['batch_occupancy_mean']}  "
+         f"pad_tax={roles['serve']['pad_fraction_mean']}  "
+         f"latency_p99_ms={roles['serve']['latency_p99_ms']}"),
+        f"compiles: {report['compiles']}",
+    ]
+    for name, snap in sorted((report["spans"] or {}).items()):
+        lines.append(f"span {name}: {snap}")
+    lines.append(f"faults: {report['faults'] or 'none'}")
+    lines.append(f"final_eval: {report['final_eval'] or 'none'}")
+    h = report["health"]
+    lines.append(
+        f"health: last={h['last_status']} worst={h['worst_status']} "
+        f"rows={h['rows']} hosts_dead={h['hosts_dead']}"
+    )
+    return "\n".join(lines)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("path", help="run dir (or one .jsonl file)")
+    ap.add_argument("--json", action="store_true",
+                    help="emit the report as one JSON object")
+    args = ap.parse_args(argv)
+
+    paths = find_jsonl(args.path)
+    if not paths:
+        print(f"obs_report: no .jsonl under {args.path}", file=sys.stderr)
+        return 1
+    rows, errors = load_rows(paths)
+    if not rows:
+        print(f"obs_report: {len(paths)} file(s) but zero rows", file=sys.stderr)
+        return 1
+    report = aggregate(rows)
+    report["lint_errors"] = len(errors)
+    if args.json:
+        print(json.dumps(report, indent=2, sort_keys=True))
+    else:
+        print(render(report))
+    if errors:
+        for err in errors[:20]:
+            print(f"LINT {err}", file=sys.stderr)
+        if len(errors) > 20:
+            print(f"LINT ... {len(errors) - 20} more", file=sys.stderr)
+        return 2
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -197,6 +197,41 @@ CKPT_CORRUPT_SIGNATURES = (
 TIMEOUT_SIGNATURES = ("PROBE_TIMEOUT", "TimeoutError", "DEADLINE_EXCEEDED")
 
 
+def health_attribution(metrics_glob) -> dict:
+    """Soak attribution from obs/ ``health`` rows (docs/OBSERVABILITY.md):
+    a phase's rc says whether it exited clean; the health rows say whether
+    the RUN it drove was actually healthy while it ran (a chaos soak can
+    exit rc=0 while degraded the whole window, and a timeout can kill a
+    perfectly healthy run).  Reads every metrics.jsonl the glob matches and
+    returns status counts + the last/worst status seen, or rows=0 when the
+    phase wrote no health rows (pre-obs artifact or a crash before the first
+    flush)."""
+    import glob as _glob
+
+    counts = {"ok": 0, "degraded": 0, "failing": 0}
+    last = None
+    for path in sorted(_glob.glob(metrics_glob)):
+        try:
+            with open(path) as f:
+                for line in f:
+                    try:
+                        row = json.loads(line)
+                    except ValueError:
+                        continue  # lint_jsonl's job, not attribution's
+                    if row.get("kind") == "health":
+                        status = row.get("status")
+                        if status in counts:
+                            counts[status] += 1
+                            last = status
+        except OSError:
+            continue
+    order = {"ok": 0, "degraded": 1, "failing": 2}
+    worst = max((s for s, n in counts.items() if n),
+                key=lambda s: order[s], default=None)
+    return {"rows": sum(counts.values()), "counts": counts,
+            "last": last, "worst": worst}
+
+
 def classify_phase(rc: int, tail: str) -> str:
     """Explicit cause for a phase outcome:
 
@@ -230,9 +265,12 @@ def _tail_of(path: str, n: int = 4000) -> str:
 
 
 def run_phase(name: str, argv, out_name: str, extra_env=None,
-              strip_platform_pin: bool = True) -> int:
+              strip_platform_pin: bool = True, health_glob=None) -> int:
     """Run one capture phase, stdout -> results/relay_watch/<out_name>,
-    wait without killing, commit the artifact."""
+    wait without killing, commit the artifact.  ``health_glob`` (a
+    metrics.jsonl glob for the runs the phase drives) adds obs health-row
+    soak attribution to the phase_done row — the phase rc alone conflates
+    "exited clean" with "ran healthy"."""
     env = dict(os.environ)
     if DRY_RUN:  # CPU rehearsal: the relay env must not leak in
         env["JAX_PLATFORMS"] = "cpu"
@@ -254,8 +292,9 @@ def run_phase(name: str, argv, out_name: str, extra_env=None,
     dt = time.monotonic() - t0
     cause = classify_phase(p.returncode,
                            _tail_of(err_path) + _tail_of(out_path))
+    health = health_attribution(health_glob) if health_glob else None
     log_event(event="phase_done", phase=name, rc=p.returncode,
-              elapsed_s=round(dt, 1), cause=cause)
+              elapsed_s=round(dt, 1), cause=cause, health=health)
     git_commit([out_path, err_path, LOG],
                f"relay_watch: {name} captured on live TPU window "
                f"(rc={p.returncode}, {dt:.0f}s, cause={cause})")
@@ -390,10 +429,21 @@ def capture_chain() -> bool:
             json.dump({"completed": sorted(done_phases)}, f)
         os.replace(tmp, state_path)  # atomic: never a half-written state
 
+    # obs soak attribution: the jaxsuite phases drive real training runs, so
+    # their phase_done rows carry the runs' health-row summary (rc alone
+    # can't distinguish "exited clean" from "ran healthy")
+    var_glob_dir = (jaxsuite_dir + "_var" if DRY_RUN
+                    else os.path.join("results", "jaxsuite_var_tpu"))
+    health_globs = {
+        "jaxsuite_tpu": os.path.join(jaxsuite_dir, "runs", "*", "metrics.jsonl"),
+        "jaxsuite_var_tpu": os.path.join(
+            var_glob_dir, "runs", "*", "metrics.jsonl"),
+    }
     for name, argv, out_name, extra_env in phases:
         if name in done_phases:
             continue
-        rc = run_phase(name, argv, out_name, extra_env)
+        rc = run_phase(name, argv, out_name, extra_env,
+                       health_glob=health_globs.get(name))
         if rc == 0:
             done_phases.add(name)
             if not DRY_RUN:

@@ -61,8 +61,8 @@ def test_fused_smoke_end_to_end(tmp_path):
     metrics_path = os.path.join(cfg.results_dir, cfg.run_id, "metrics.jsonl")
     rows = [json.loads(l) for l in open(metrics_path)]
     kinds = {r["kind"] for r in rows}
-    assert "train" in kinds and "eval" in kinds
-    train_rows = [r for r in rows if r["kind"] == "train"]
+    assert "learn" in kinds and "eval" in kinds
+    train_rows = [r for r in rows if r["kind"] == "learn"]
     assert all(np.isfinite(r["loss"]) for r in train_rows)
 
 

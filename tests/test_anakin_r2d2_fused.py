@@ -77,8 +77,8 @@ def test_fused_r2d2_smoke_end_to_end(tmp_path):
     rows = [json.loads(l) for l in open(
         os.path.join(cfg.results_dir, cfg.run_id, "metrics.jsonl"))]
     kinds = {r["kind"] for r in rows}
-    assert "train" in kinds and "eval" in kinds
-    train_rows = [r for r in rows if r["kind"] == "train"]
+    assert "learn" in kinds and "eval" in kinds
+    train_rows = [r for r in rows if r["kind"] == "learn"]
     assert all(np.isfinite(r["loss"]) for r in train_rows)
 
 

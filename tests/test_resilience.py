@@ -344,7 +344,7 @@ def test_nan_step_rolls_back_and_training_continues(tmp_path):
     assert "nonfinite_step" in events
     assert "rollback" in events
     # loss is finite after the rollback (the guarded loop never logs NaN)
-    train_rows = [r for r in rows if r["kind"] == "train"]
+    train_rows = [r for r in rows if r["kind"] == "learn"]
     assert train_rows and all(np.isfinite(r["loss"]) for r in train_rows)
 
 
@@ -498,7 +498,7 @@ def test_nan_step_rolls_back_in_apex_driver(tmp_path):
         r["kind"] == "fault" and r["event"] == "rollback" for r in rows
     )
     assert all(
-        np.isfinite(r["loss"]) for r in rows if r["kind"] == "train"
+        np.isfinite(r["loss"]) for r in rows if r["kind"] == "learn"
     )
     # the heartbeat file for this (single) host exists and was refreshed
     hb = tmp_path / "results" / cfg.run_id / "heartbeats" / "h0.json"
