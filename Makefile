@@ -2,7 +2,7 @@
 
 PY ?= python
 
-.PHONY: test test-fast serve-smoke serve-bench chaos-smoke obs-smoke
+.PHONY: test test-fast serve-smoke serve-bench chaos-smoke obs-smoke soak-smoke
 
 # tier-1: fast unit + integration tests on the virtual 8-device CPU mesh
 test-fast:
@@ -26,6 +26,18 @@ serve-bench:
 # subset of tier-1 (docs/RESILIENCE.md)
 chaos-smoke:
 	JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_resilience.py -q -m chaos
+
+# elastic soak smoke: a real multi-process kill/revive schedule must HEAL —
+# 2 actor hosts killed, 1 revived (respawn -> lease rejoin -> shard
+# readmission), the other evicted after its FailureBudget, stale-epoch spool
+# rows fenced, no actor acting past max-weight-lag, final health ok; the
+# harness asserts all of it from its own JSONL (docs/RESILIENCE.md).  The
+# same path runs tier-1 under the `chaos` marker (tests/test_elastic.py).
+soak-smoke:
+	rm -rf /tmp/ria_soak_smoke
+	JAX_PLATFORMS=cpu $(PY) scripts/chaos_soak.py --frames 2000 \
+	  --kill-schedule seeded --out /tmp/ria_soak_smoke
+	$(PY) scripts/lint_jsonl.py /tmp/ria_soak_smoke/results
 
 # obs smoke: a short anakin run must yield a lintable, reportable run dir —
 # obs_report prints per-role throughput / learn-step percentiles / health,

@@ -61,6 +61,18 @@ class Config:
     heartbeat_interval_s: float = 0.0  # per-host liveness file cadence; 0 off
     heartbeat_timeout_s: float = 30.0  # peer file older than this = dead host
 
+    # ---- elasticity (parallel/elastic.py; docs/RESILIENCE.md "heal") --------------
+    max_weight_lag: int = 0  # actor staleness fence: pause acting (shed
+    # frames, 'actor_fenced' rows) once the adopted weight version trails the
+    # published one by more than this many publishes; 0 disables fencing but
+    # keeps the weight_version_lag gauge live (IMPACT, arXiv:1912.00167:
+    # unboundedly stale actors corrupt learning silently)
+    respawn_attempts: int = 3  # RoleSupervisor: restarts per dead actor role
+    # before permanent eviction ('actor_evicted' fault row)
+    respawn_base_s: float = 0.2  # respawn backoff base (doubles per attempt,
+    # deterministic jitter — the shared RetryPolicy schedule)
+    respawn_max_s: float = 5.0  # respawn backoff ceiling
+
     # ---- environment (SURVEY §2 row 2) -------------------------------------------
     env_id: str = "toy:catch"  # "toy:catch", "toy:chain", or "atari:<Game>"
     history_length: int = 4  # frame-stack depth

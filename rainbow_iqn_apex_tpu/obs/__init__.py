@@ -37,12 +37,26 @@ from rainbow_iqn_apex_tpu.obs.schema import (
     sanitize,
     validate_row,
 )
-from rainbow_iqn_apex_tpu.obs.trace import (
-    TraceWindow,
-    Tracer,
-    install_compile_counter,
-    sample_device_gauges,
+
+# obs.trace imports jax; resolve its names lazily (PEP 562) so jax-free
+# consumers (schema/registry/health users like the chaos-soak processes)
+# can import the package without paying the device-runtime import.
+_TRACE_EXPORTS = (
+    "TraceWindow",
+    "Tracer",
+    "install_compile_counter",
+    "sample_device_gauges",
 )
+
+
+def __getattr__(name: str):
+    if name in _TRACE_EXPORTS:
+        import importlib
+
+        return getattr(
+            importlib.import_module("rainbow_iqn_apex_tpu.obs.trace"), name
+        )
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
 
 __all__ = [
     "Counter",
@@ -90,7 +104,15 @@ class RunObs:
         registry: Optional[MetricRegistry] = None,
         start_http: bool = True,
     ):
+        from rainbow_iqn_apex_tpu.obs.trace import (
+            TraceWindow,
+            Tracer,
+            install_compile_counter,
+            sample_device_gauges,
+        )
         from rainbow_iqn_apex_tpu.utils.profiling import StepTimer
+
+        self._sample_device_gauges = sample_device_gauges
 
         self.cfg = cfg
         self.metrics = metrics
@@ -142,7 +164,7 @@ class RunObs:
         """Emit 'timing' + 'health' rows for the window ending now."""
         self._steps.set(step)
         self._frames.set(frames)
-        sample_device_gauges(self.registry, self.role)
+        self._sample_device_gauges(self.registry, self.role)
         stats = self.timer.stats()
         timing: Dict[str, Any] = {
             f"learn_{k}": round(float(v), 6) for k, v in stats.items()

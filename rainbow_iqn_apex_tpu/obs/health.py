@@ -52,6 +52,15 @@ class RunHealth:
         self._lock = threading.Lock()
         self.fault_counts: collections.Counter = collections.Counter()
         self.dead_hosts: set = set()
+        # elasticity (docs/RESILIENCE.md "heal"): hosts whose lease came
+        # back (host_alive) leave dead_hosts; hosts the RoleSupervisor
+        # permanently evicted leave dead_hosts too — a deliberately resized
+        # fleet is healthy at its new size, not degraded forever — but stay
+        # listed so the report shows the run shrank.  Fenced actors hold the
+        # run degraded until they resume.
+        self.evicted_hosts: set = set()
+        self.fenced_hosts: set = set()
+        self.readmits = 0
         self.total_shed = 0
         self._last_strikes = 0
         self._aborted = False
@@ -78,6 +87,32 @@ class RunHealth:
                     self.total_shed += shed
                     self._win_shed += shed
                 self.registry.counter("shed_total", "serve").inc(shed)
+        elif kind == "host_alive":
+            host = row.get("alive_host")
+            with self._lock:
+                if host is not None:
+                    self.dead_hosts.discard(host)
+                    self.evicted_hosts.discard(host)
+            self.registry.counter("host_alive_total", "health").inc()
+        elif kind == "shard_readmit":
+            with self._lock:
+                self.readmits += 1
+            self.registry.counter("shard_readmit_total", "health").inc()
+        elif kind == "actor_fenced":
+            # fenced_host: set when a controller relays another host's fence
+            # state (lease-carried); the envelope host is the emitter
+            host = row.get("fenced_host", row.get("host", 0))
+            resumed = row.get("action") == "resume"
+            with self._lock:
+                if resumed:
+                    self.fenced_hosts.discard(host)
+                else:
+                    self.fenced_hosts.add(host)
+                    # the fence edge itself is a degradation signal for the
+                    # window it happened in (frames are being shed)
+                    self.fault_counts["actor_fenced"] += 1
+                    self._win_faults["actor_fenced"] += 1
+            self.registry.counter("actor_fenced_total", "health").inc()
 
     def note_fault(self, event: str, row: Optional[Dict[str, Any]] = None) -> None:
         with self._lock:
@@ -98,6 +133,13 @@ class RunHealth:
                 host = (row or {}).get("dead_host")
                 if host is not None:
                     self.dead_hosts.add(host)
+            elif event == "actor_evicted":
+                # permanent, deliberate fleet resize: the host stops holding
+                # the run degraded but stays on the books as evicted
+                host = (row or {}).get("role_host")
+                if host is not None:
+                    self.dead_hosts.discard(host)
+                    self.evicted_hosts.add(host)
         self.registry.counter(f"fault_{event}_total", "supervisor").inc()
 
     def note_finite_step(self) -> None:
@@ -125,6 +167,7 @@ class RunHealth:
             sum(self._win_faults.values()) > 0
             or self._win_shed > 0
             or self.dead_hosts
+            or self.fenced_hosts
         ):
             return "degraded"
         return "ok"
@@ -155,6 +198,9 @@ class RunHealth:
                 "nan_strikes": int(self._last_strikes),
                 "shed_total": int(self.total_shed),
                 "hosts_dead": sorted(self.dead_hosts),
+                "hosts_evicted": sorted(self.evicted_hosts),
+                "hosts_fenced": sorted(self.fenced_hosts),
+                "readmits": int(self.readmits),
             }
             self._win_faults.clear()
             self._win_shed = 0

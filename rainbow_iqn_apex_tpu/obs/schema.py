@@ -44,7 +44,11 @@ REQUIRED_KEYS: Dict[str, frozenset] = {
     "timing": frozenset({"step"}),  # StepTimer + span aggregates
     "span": frozenset({"name", "span_id", "parent_id", "dur_ms"}),
     "trace": frozenset({"event", "step"}),  # --trace-dir window open/close
-
+    # elasticity rows (parallel/elastic.py; docs/RESILIENCE.md "heal"):
+    "host_alive": frozenset({"alive_host", "epoch"}),  # lease revival edge
+    "shard_readmit": frozenset({"shard", "epoch"}),  # drop_shard reversed
+    "actor_fenced": frozenset({"lag", "max_lag"}),  # staleness fence edge
+    # (``action`` is "fence" or "resume"; frames shed ride in the gauges)
 }
 
 HEALTH_STATUSES = ("ok", "degraded", "failing")

@@ -1,30 +1,78 @@
-from rainbow_iqn_apex_tpu.parallel.apex import (
-    ActorPriorityEstimator,
-    ApexDriver,
-    train_apex,
-)
-from rainbow_iqn_apex_tpu.parallel.apex_r2d2 import R2D2ApexDriver, train_apex_r2d2
-from rainbow_iqn_apex_tpu.parallel.mesh import (
-    actor_mesh,
-    batch_sharding,
-    learner_mesh,
-    parse_mesh_shape,
-    replicated,
-    split_devices,
-)
-from rainbow_iqn_apex_tpu.parallel.sharded_replay import ShardedReplay
+"""parallel/ — meshes, apex drivers, sharded replay, and the elastic fleet.
 
-__all__ = [
-    "ActorPriorityEstimator",
-    "ApexDriver",
-    "R2D2ApexDriver",
-    "train_apex",
-    "train_apex_r2d2",
-    "ShardedReplay",
-    "actor_mesh",
-    "batch_sharding",
-    "learner_mesh",
-    "parse_mesh_shape",
-    "replicated",
-    "split_devices",
-]
+Exports resolve lazily (PEP 562): the apex drivers pull in jax at import
+time, but `parallel.elastic` and `parallel.sharded_replay` are deliberately
+jax-free so respawned actor processes (scripts/chaos_soak.py,
+RoleSupervisor children) can import them without paying the device-runtime
+import tax.  An eager ``from .apex import ...`` here would defeat that —
+importing any submodule executes this file first.
+"""
+
+from typing import TYPE_CHECKING
+
+_EXPORTS = {
+    "ActorPriorityEstimator": "rainbow_iqn_apex_tpu.parallel.apex",
+    "ApexDriver": "rainbow_iqn_apex_tpu.parallel.apex",
+    "train_apex": "rainbow_iqn_apex_tpu.parallel.apex",
+    "R2D2ApexDriver": "rainbow_iqn_apex_tpu.parallel.apex_r2d2",
+    "train_apex_r2d2": "rainbow_iqn_apex_tpu.parallel.apex_r2d2",
+    "actor_mesh": "rainbow_iqn_apex_tpu.parallel.mesh",
+    "batch_sharding": "rainbow_iqn_apex_tpu.parallel.mesh",
+    "learner_mesh": "rainbow_iqn_apex_tpu.parallel.mesh",
+    "parse_mesh_shape": "rainbow_iqn_apex_tpu.parallel.mesh",
+    "replicated": "rainbow_iqn_apex_tpu.parallel.mesh",
+    "split_devices": "rainbow_iqn_apex_tpu.parallel.mesh",
+    "ShardedReplay": "rainbow_iqn_apex_tpu.parallel.sharded_replay",
+    "HeartbeatMonitor": "rainbow_iqn_apex_tpu.parallel.elastic",
+    "HeartbeatWriter": "rainbow_iqn_apex_tpu.parallel.elastic",
+    "Lease": "rainbow_iqn_apex_tpu.parallel.elastic",
+    "RoleSupervisor": "rainbow_iqn_apex_tpu.parallel.elastic",
+    "StalenessFence": "rainbow_iqn_apex_tpu.parallel.elastic",
+    "WeightMailbox": "rainbow_iqn_apex_tpu.parallel.elastic",
+}
+
+__all__ = sorted(_EXPORTS)
+
+
+def __getattr__(name: str):
+    module = _EXPORTS.get(name)
+    if module is None:
+        raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
+    import importlib
+
+    return getattr(importlib.import_module(module), name)
+
+
+def __dir__():
+    return __all__
+
+
+if TYPE_CHECKING:  # static analyzers see the eager imports
+    from rainbow_iqn_apex_tpu.parallel.apex import (  # noqa: F401
+        ActorPriorityEstimator,
+        ApexDriver,
+        train_apex,
+    )
+    from rainbow_iqn_apex_tpu.parallel.apex_r2d2 import (  # noqa: F401
+        R2D2ApexDriver,
+        train_apex_r2d2,
+    )
+    from rainbow_iqn_apex_tpu.parallel.elastic import (  # noqa: F401
+        HeartbeatMonitor,
+        HeartbeatWriter,
+        Lease,
+        RoleSupervisor,
+        StalenessFence,
+        WeightMailbox,
+    )
+    from rainbow_iqn_apex_tpu.parallel.mesh import (  # noqa: F401
+        actor_mesh,
+        batch_sharding,
+        learner_mesh,
+        parse_mesh_shape,
+        replicated,
+        split_devices,
+    )
+    from rainbow_iqn_apex_tpu.parallel.sharded_replay import (  # noqa: F401
+        ShardedReplay,
+    )

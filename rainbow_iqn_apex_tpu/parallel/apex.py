@@ -196,25 +196,41 @@ class ApexDriver:
         # two apex drivers can't drift)
         self._global_is_weights = make_global_is_weights(self._batch_sh)
         self.actor_params = None
+        # weight-staleness fencing (parallel/elastic.py): every publish
+        # stamps a monotonically increasing version so actors — in-process
+        # or external (WeightMailbox readers) — can measure their lag in
+        # publishes and fence past cfg.max_weight_lag
+        self.weights_version = 0
+        self.actor_weights_version = 0
         self.publish_weights()  # initial broadcast
 
     # ------------------------------------------------------------- weight sync
-    def publish_weights(self) -> None:
-        """Learner -> actor-mesh broadcast (the Redis SET + actor GET pair)."""
+    def publish_weights(self) -> int:
+        """Learner -> actor-mesh broadcast (the Redis SET + actor GET pair).
+        Returns the new monotonically increasing weight version; the actor
+        mesh adopts it atomically with the params."""
         p = self.state.params
         if self.cfg.bf16_weight_sync:
             p = self._uncast(jax.device_put(self._cast(p), replicated(self.amesh)))
         else:
             p = jax.device_put(p, replicated(self.amesh))
         self.actor_params = p
+        self.weights_version += 1
+        self.actor_weights_version = self.weights_version
+        return self.weights_version
 
     # ---------------------------------------------------------------- resume
     def load_state(self, state, extra: Optional[Dict[str, Any]] = None) -> None:
         """Place a restored TrainState onto the learner mesh, pick up the
         saved RNG stream when the checkpoint carries one, and re-publish
-        actor weights."""
+        actor weights.  The weight-version counter resumes from the
+        checkpoint too — a restarted learner must publish versions ABOVE the
+        ones out-of-process actors already hold, or the staleness fence's
+        lag arithmetic fails open exactly in the restart window."""
         self.state = jax.device_put(state, replicated(self.lmesh))
         self.key = jnp.asarray(rng_from_extra(extra or {}, self.key))
+        saved = int((extra or {}).get("weights_version", 0))
+        self.weights_version = max(self.weights_version, saved)
         self.publish_weights()
 
     def restore(self, ckpt) -> Dict[str, Any]:
@@ -408,21 +424,38 @@ def train_apex(cfg: Config, max_frames: Optional[int] = None) -> Dict[str, Any]:
     # (spec, seed, call order), identical on every host — supervised control
     # flow can never diverge the SPMD program around a collective.
     sup = TrainSupervisor(cfg, metrics=metrics, registry=obs_run.registry)
-    from rainbow_iqn_apex_tpu.parallel.multihost import (
+    from rainbow_iqn_apex_tpu.parallel.elastic import (
         HeartbeatMonitor,
         HeartbeatWriter,
+        StalenessFence,
         heartbeat_dir,
+        next_lease_epoch,
     )
 
     heartbeat = monitor = None
     if cfg.heartbeat_interval_s > 0:
         heartbeat = HeartbeatWriter(
-            heartbeat_dir(cfg), cfg.process_id, cfg.heartbeat_interval_s
-        ).start()
+            heartbeat_dir(cfg), cfg.process_id, cfg.heartbeat_interval_s,
+            role="apex", shard=cfg.process_id * max(shards, 1),
+            # every (re)start claims a fresh incarnation epoch: a relaunched
+            # host's death/revival fires as a NEW transition instead of
+            # being deduped against the previous incarnation's report
+            epoch=next_lease_epoch(heartbeat_dir(cfg), cfg.process_id),
+        )
+        heartbeat.set_weight_version(driver.weights_version)
+        heartbeat.start()
         if is_main:
             monitor = HeartbeatMonitor(
                 heartbeat_dir(cfg), cfg.heartbeat_timeout_s, self_id=cfg.process_id
             )
+    # staleness fence (parallel/elastic.py): the fused loop adopts the
+    # published version atomically with the params, so lag is structurally 0
+    # here and the fence can never fire — observe() keeps the
+    # weight_version_lag gauge live with the same contract out-of-process
+    # actors (scripts/chaos_soak.py, WeightMailbox readers) fence on.
+    fence = StalenessFence(
+        cfg.max_weight_lag, metrics=metrics, registry=obs_run.registry
+    )
 
     frames = 0
     last_pub = 0
@@ -589,9 +622,19 @@ def train_apex(cfg: Config, max_frames: Optional[int] = None) -> Dict[str, Any]:
                     obs_run.after_learn_step(step)
                     if step - last_pub >= cfg.weight_publish_interval:
                         with obs_run.span("publish_weights"):
-                            driver.publish_weights()
+                            version = driver.publish_weights()
                         last_pub = step
+                        obs_run.registry.gauge(
+                            "weights_version", "learner"
+                        ).set(version)
+                        if heartbeat is not None:
+                            heartbeat.set_weight_version(version)
                     if step % cfg.metrics_interval == 0:
+                        fence.observe(
+                            driver.actor_weights_version,
+                            driver.weights_version,
+                            step=step,
+                        )
                         metrics.log(
                             "learn",
                             step=step,
@@ -616,18 +659,32 @@ def train_apex(cfg: Config, max_frames: Optional[int] = None) -> Dict[str, Any]:
                                 ).get(), 4,
                             ),
                             weight_staleness=step - last_pub,
+                            weights_version=driver.weights_version,
+                            weight_version_lag=fence.lag,
                         )
                         if monitor is not None:
                             # a preempted host stops heartbeating; the
                             # host_dead row is the external supervisor's
                             # restart/reshard signal — a hung collective
-                            # would otherwise wedge this loop silently
-                            for hid in monitor.newly_dead():
+                            # would otherwise wedge this loop silently.
+                            # poll() reports BOTH edges once per lease
+                            # epoch: the revival side is what lets an
+                            # external controller readmit the host's shard
+                            # instead of treating recovery as noise.
+                            dead, alive = monitor.poll()
+                            for lease in dead:
                                 # dead_host, not host: the envelope's `host`
                                 # key is the EMITTING process index
                                 metrics.log(
-                                    "fault", event="host_dead", dead_host=hid,
+                                    "fault", event="host_dead",
+                                    dead_host=lease.host, epoch=lease.epoch,
                                     step=step, frames=frames,
+                                )
+                            for lease in alive:
+                                metrics.log(
+                                    "host_alive", alive_host=lease.host,
+                                    epoch=lease.epoch, step=step,
+                                    frames=frames,
                                 )
                     if is_main and cfg.eval_interval and step % cfg.eval_interval == 0:
                         metrics.log(
@@ -642,7 +699,8 @@ def train_apex(cfg: Config, max_frames: Optional[int] = None) -> Dict[str, Any]:
                         # hosts retry in lockstep too.
                         sup.save_checkpoint(
                             ckpt, step, host_state(driver.state),
-                            {"frames": frames, **rng_extra(driver.key)},
+                            {"frames": frames, "weights_version": driver.weights_version,
+                             **rng_extra(driver.key)},
                         )
                         sup.save_replay(cfg, memory)  # per-host shard
 
@@ -658,7 +716,8 @@ def train_apex(cfg: Config, max_frames: Optional[int] = None) -> Dict[str, Any]:
         metrics.log("eval", step=driver.step, **final_eval)
     sup.save_checkpoint(
         ckpt, driver.step, host_state(driver.state),
-        {"frames": frames, **rng_extra(driver.key)}, critical=True,
+        {"frames": frames, "weights_version": driver.weights_version,
+                             **rng_extra(driver.key)}, critical=True,
     )
     sup.save_replay(cfg, memory, critical=True)
     ckpt.wait()

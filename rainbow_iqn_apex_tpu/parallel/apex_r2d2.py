@@ -162,25 +162,36 @@ class R2D2ApexDriver:
             self._put_lanes(local_zeros),
             self._put_lanes(local_zeros),
         )
+        self.weights_version = 0
+        self.actor_weights_version = 0
         self.publish_weights()
 
     def _next_key(self):
         self.key, k = jax.random.split(self.key)
         return k
 
-    def publish_weights(self) -> None:
+    def publish_weights(self) -> int:
+        """Same version-stamped publish contract as ApexDriver (the two
+        drivers must not drift on the staleness-fencing surface)."""
         p = self.state.params
         if self.cfg.bf16_weight_sync:
             p = self._uncast(jax.device_put(self._cast(p), self._rep_a))
         else:
             p = jax.device_put(p, self._rep_a)
         self.actor_params = p
+        self.weights_version += 1
+        self.actor_weights_version = self.weights_version
+        return self.weights_version
 
     def load_state(self, state, extra: Optional[Dict[str, Any]] = None) -> None:
         """Place a restored R2D2TrainState onto the learner mesh, pick up
-        the saved RNG stream when present, re-publish actor weights."""
+        the saved RNG stream when present, re-publish actor weights.  The
+        weight-version counter resumes from the checkpoint (same fence
+        contract as ApexDriver.load_state)."""
         self.state = jax.device_put(state, replicated(self.lmesh))
         self.key = jnp.asarray(rng_from_extra(extra or {}, self.key))
+        saved = int((extra or {}).get("weights_version", 0))
+        self.weights_version = max(self.weights_version, saved)
         self.publish_weights()
 
     def restore(self, ckpt) -> Dict[str, Any]:
@@ -344,6 +355,33 @@ def train_apex_r2d2(cfg: Config, max_frames: Optional[int] = None) -> Dict[str, 
     faults.install_from(cfg)
     obs_run = RunObs(cfg, metrics, role="learner")
     sup = TrainSupervisor(cfg, metrics=metrics, registry=obs_run.registry)
+    # lease + staleness-fence wiring, identical to train_apex (the two
+    # drivers must not drift on the elastic surface — docs/RESILIENCE.md)
+    from rainbow_iqn_apex_tpu.parallel.elastic import (
+        HeartbeatMonitor,
+        HeartbeatWriter,
+        StalenessFence,
+        heartbeat_dir,
+        next_lease_epoch,
+    )
+
+    heartbeat = monitor = None
+    if cfg.heartbeat_interval_s > 0:
+        heartbeat = HeartbeatWriter(
+            heartbeat_dir(cfg), cfg.process_id, cfg.heartbeat_interval_s,
+            role="apex_r2d2", shard=cfg.process_id,
+            epoch=next_lease_epoch(heartbeat_dir(cfg), cfg.process_id),
+        )
+        heartbeat.set_weight_version(driver.weights_version)
+        heartbeat.start()
+        if is_main:
+            monitor = HeartbeatMonitor(
+                heartbeat_dir(cfg), cfg.heartbeat_timeout_s,
+                self_id=cfg.process_id,
+            )
+    fence = StalenessFence(
+        cfg.max_weight_lag, metrics=metrics, registry=obs_run.registry
+    )
 
     frames = 0
     last_pub = 0
@@ -473,9 +511,19 @@ def train_apex_r2d2(cfg: Config, max_frames: Optional[int] = None) -> Dict[str, 
                     obs_run.after_learn_step(step)
                     if step - last_pub >= cfg.weight_publish_interval:
                         with obs_run.span("publish_weights"):
-                            driver.publish_weights()
+                            version = driver.publish_weights()
                         last_pub = step
+                        obs_run.registry.gauge(
+                            "weights_version", "learner"
+                        ).set(version)
+                        if heartbeat is not None:
+                            heartbeat.set_weight_version(version)
                     if step % cfg.metrics_interval == 0:
+                        fence.observe(
+                            driver.actor_weights_version,
+                            driver.weights_version,
+                            step=step,
+                        )
                         metrics.log(
                             "learn",
                             step=step,
@@ -495,7 +543,25 @@ def train_apex_r2d2(cfg: Config, max_frames: Optional[int] = None) -> Dict[str, 
                                 len(memory) / max(memory.capacity, 1), 4
                             ),
                             weight_staleness=step - last_pub,
+                            weights_version=driver.weights_version,
+                            weight_version_lag=fence.lag,
                         )
+                        if monitor is not None:
+                            # same lease-edge reporting as train_apex: one
+                            # host_dead/host_alive row per lease epoch
+                            dead, alive = monitor.poll()
+                            for lease in dead:
+                                metrics.log(
+                                    "fault", event="host_dead",
+                                    dead_host=lease.host, epoch=lease.epoch,
+                                    step=step, frames=frames,
+                                )
+                            for lease in alive:
+                                metrics.log(
+                                    "host_alive", alive_host=lease.host,
+                                    epoch=lease.epoch, step=step,
+                                    frames=frames,
+                                )
                     if is_main and cfg.eval_interval and step % cfg.eval_interval == 0:
                         metrics.log(
                             "eval", step=step, **_eval_r2d2_learner(cfg, env, driver)
@@ -506,7 +572,8 @@ def train_apex_r2d2(cfg: Config, max_frames: Optional[int] = None) -> Dict[str, 
                         # retry decisions are deterministic -> lockstep
                         sup.save_checkpoint(
                             ckpt, step, host_state(driver.state),
-                            {"frames": frames, **rng_extra(driver.key)},
+                            {"frames": frames, "weights_version": driver.weights_version,
+                             **rng_extra(driver.key)},
                         )
                         sup.save_replay(cfg, memory)
     finally:
@@ -514,13 +581,16 @@ def train_apex_r2d2(cfg: Config, max_frames: Optional[int] = None) -> Dict[str, 
             prefetcher.close()
         sup.close()
         obs_run.close(driver.step, frames)
+        if heartbeat is not None:
+            heartbeat.stop()
 
     final_eval = _eval_r2d2_learner(cfg, env, driver) if is_main else {}
     if is_main:
         metrics.log("eval", step=driver.step, **final_eval)
     sup.save_checkpoint(
         ckpt, driver.step, host_state(driver.state),
-        {"frames": frames, **rng_extra(driver.key)}, critical=True,
+        {"frames": frames, "weights_version": driver.weights_version,
+                             **rng_extra(driver.key)}, critical=True,
     )
     sup.save_replay(cfg, memory, critical=True)
     ckpt.wait()

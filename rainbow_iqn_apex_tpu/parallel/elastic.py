@@ -1,0 +1,541 @@
+"""Elastic self-healing fleet layer: close the detect -> degrade -> HEAL loop.
+
+Ape-X throughput scales linearly with actor count (arXiv:1803.00933), so a
+permanently-lost actor host is a permanent throughput tax.  PR 2 made host
+loss *survivable* (heartbeat staleness -> ``host_dead`` -> survivors-only
+replay sampling) and PR 3 made it *visible* (RunHealth degraded), but the
+fleet never recovered: dropped shards were one-way, dead roles stayed dead,
+and actors kept acting on unboundedly stale weights — which IMPACT
+(arXiv:1912.00167) shows corrupts learning silently long before anything
+crashes.  This module adds the missing half:
+
+- **Role leases** (`HeartbeatWriter`/`HeartbeatMonitor`, grown from PR 2's
+  heartbeats): every heartbeat file is now a lease row carrying
+  (role, shard, lease epoch, weight_version).  The monitor reports BOTH
+  edges — ``host_dead`` when a lease expires and ``host_alive`` when a
+  host beats again — each fired once per lease epoch, so a respawned
+  incarnation (epoch+1) is a new event while a flapping stale file is not.
+- **Weight mailbox + staleness fence** (`WeightMailbox`, `StalenessFence`):
+  the learner publishes a monotonically increasing weight version; actors
+  track ``weight_version_lag`` and past ``cfg.max_weight_lag`` publishes
+  they PAUSE acting (shed frames, emit ``actor_fenced`` rows) instead of
+  polluting replay with off-policy-beyond-budget experience.
+- **Respawn supervision** (`RoleSupervisor`): dead actor processes are
+  restarted under the shared `RetryPolicy` backoff and `FailureBudget` —
+  bounded restarts with a fresh lease epoch per incarnation, then permanent
+  eviction with an ``actor_evicted`` fault row (the `train_aborted` of the
+  fleet layer).
+
+The readmission half lives in `ShardedReplay.readmit_shard` (epoch-fenced;
+parallel/sharded_replay.py); `scripts/chaos_soak.py` drives the whole loop
+through a seeded kill/revive schedule.  Everything here is deliberately
+jax-free so respawned actor processes pay no device-runtime import tax.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+from rainbow_iqn_apex_tpu.utils import faults
+
+
+def heartbeat_dir(cfg) -> str:
+    return os.path.join(cfg.results_dir, cfg.run_id, "heartbeats")
+
+
+def next_lease_epoch(directory: str, process_id: int) -> int:
+    """Claim this host's next incarnation epoch.  Every process START —
+    first launch, scheduler restart, crash-loop relaunch — gets a bumped
+    epoch, which is what makes the monitor's once-per-epoch transition
+    dedupe see a relaunched incarnation as a NEW death/revival instead of
+    suppressing it, and what epoch-fences the dead incarnation's writes.
+
+    The claim is one empty O_EXCL marker file per epoch (``h<i>.e<k>``),
+    NOT a read-modify-write counter: a double-launch of the same host id
+    (scheduler races its own zombie — exactly the split-brain epoch fencing
+    exists for) must end up with two DIFFERENT epochs, and O_EXCL is the
+    one primitive that guarantees it.  Markers are a few bytes each and
+    bounded by the restart count.  A supervisor that assigns epochs
+    explicitly (RoleSupervisor) does not need this; it exists for
+    self-managed launches (launch_apex.sh, `--resume auto` under an
+    external scheduler)."""
+    os.makedirs(directory, exist_ok=True)
+    epoch = 0
+    while True:
+        try:
+            fd = os.open(
+                os.path.join(directory, f"h{process_id}.e{epoch}"),
+                os.O_CREAT | os.O_EXCL | os.O_WRONLY,
+            )
+            os.close(fd)
+            return epoch
+        except FileExistsError:
+            epoch += 1
+
+
+# ------------------------------------------------------------- lease writing
+class HeartbeatWriter:
+    """Daemon thread re-writing this host's lease file every ``interval_s``.
+
+    The file doubles as PR 2's liveness heartbeat and this PR's role lease:
+    the payload carries (role, shard, lease epoch, weight_version) so the
+    monitor can tell a respawned incarnation (new epoch) from a flapping
+    file, and an external observer can see what the host was FOR.  Writes
+    are atomic (tmp + rename) so a reader never sees a torn JSON.  The
+    ``heartbeat_loss`` fault point suppresses writes (a preempted host,
+    manufactured); ``lease_lost`` does the same for a live process whose
+    renewals stop (a zombie incarnation — the split-brain shape epoch
+    fencing exists for)."""
+
+    def __init__(self, directory: str, process_id: int, interval_s: float,
+                 injector: Optional[faults.FaultInjector] = None,
+                 role: str = "host", shard: Optional[int] = None,
+                 epoch: int = 0):
+        self.directory = directory
+        self.process_id = int(process_id)
+        self.interval_s = float(interval_s)
+        self.injector = injector if injector is not None else faults.get()
+        self.path = os.path.join(directory, f"h{process_id}.json")
+        self.payload: Dict = {"role": role, "epoch": int(epoch)}
+        if shard is not None:
+            self.payload["shard"] = int(shard)
+        self.beats = 0
+        self.suppressed = 0
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def set_weight_version(self, version: int) -> None:
+        """Stamp the weight version this host currently acts with; rides in
+        every subsequent lease renewal (external staleness monitoring)."""
+        self.payload["weight_version"] = int(version)
+
+    def beat(self) -> None:
+        """One lease renewal (also usable inline, without the thread)."""
+        if self.injector.enabled:
+            hb = self.injector.fire("heartbeat_loss")
+            ll = self.injector.fire("lease_lost")
+            if hb or ll:
+                self.suppressed += 1
+                return
+        os.makedirs(self.directory, exist_ok=True)
+        row = {
+            "process_id": self.process_id,
+            "t_mono": time.monotonic(),
+            "t_wall": time.time(),
+            **self.payload,
+        }
+        tmp = self.path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(row, f)
+        os.replace(tmp, self.path)
+        self.beats += 1
+
+    def _run(self) -> None:
+        while not self._stop.is_set():
+            try:
+                self.beat()
+            except OSError:
+                pass  # a flaky FS write is itself a missed beat; keep going
+            self._stop.wait(self.interval_s)
+
+    def start(self) -> "HeartbeatWriter":
+        if self._thread is None:
+            self.beat()  # first beat synchronously: exists before any check
+            self._thread = threading.Thread(
+                target=self._run, name="heartbeat-writer", daemon=True
+            )
+            self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+            self._thread = None
+
+
+@dataclasses.dataclass(frozen=True)
+class Lease:
+    """One host's lease as last observed on disk."""
+
+    host: int
+    age_s: float
+    fresh: bool  # age <= the monitor's timeout
+    role: str = "host"
+    shard: Optional[int] = None
+    epoch: int = 0
+    weight_version: int = -1
+    fenced: bool = False  # the host's staleness fence is currently closed
+    payload_ok: bool = True  # False: mtime was readable, the JSON was not
+
+
+# ---------------------------------------------------------- lease monitoring
+class HeartbeatMonitor:
+    """Scan peer lease files; report dead AND revived hosts, edge-triggered.
+
+    Staleness is judged by file mtime (monotone-ish on one filesystem and
+    immune to clock skew between hosts writing wall-clock payloads).  A host
+    with NO file yet is not dead — it may simply not have started; only a
+    file that existed and stopped updating is a death signal.
+
+    Transition dedupe fires **once per lease epoch**: a host reported dead
+    stays reported until it is observed ALIVE (a fresh beat) — NOT until its
+    file merely becomes unobservable.  The previous implementation forgot a
+    reported host the moment its file vanished (eviction cleanup, a torn
+    read racing a rename), so a lingering stale file re-emitted ``host_dead``
+    on every poll after such a gap; regression-tested in
+    tests/test_multihost.py.  A stale file carrying a HIGHER epoch than the
+    one reported is a new incarnation that died before it was ever seen
+    fresh — that is a fresh death and fires again.
+    """
+
+    def __init__(self, directory: str, timeout_s: float, self_id: Optional[int] = None):
+        self.directory = directory
+        self.timeout_s = float(timeout_s)
+        self.self_id = self_id
+        # host -> lease epoch at which its death was reported; entries are
+        # removed ONLY by an observed fresh beat (the bugfix above)
+        self._dead_epochs: Dict[int, int] = {}
+
+    def leases(self) -> Dict[int, Lease]:
+        """host id -> Lease for every readable lease file."""
+        out: Dict[int, Lease] = {}
+        try:
+            names = os.listdir(self.directory)
+        except FileNotFoundError:
+            return out
+        now = time.time()
+        for name in names:
+            if not (name.startswith("h") and name.endswith(".json")):
+                continue
+            path = os.path.join(self.directory, name)
+            try:
+                hid = int(name[1:-5])
+                age = now - os.path.getmtime(path)
+            except (ValueError, OSError):
+                continue  # torn tmp file or a peer mid-rename
+            payload: Dict = {}
+            payload_ok = True
+            try:  # payload is best-effort: mtime alone decides liveness
+                with open(path) as f:
+                    payload = json.load(f)
+            except (OSError, ValueError):
+                payload_ok = False
+            shard = payload.get("shard")
+            out[hid] = Lease(
+                host=hid,
+                age_s=age,
+                fresh=age <= self.timeout_s,
+                role=str(payload.get("role", "host")),
+                shard=None if shard is None else int(shard),
+                epoch=int(payload.get("epoch", 0) or 0),
+                weight_version=int(payload.get("weight_version", -1)),
+                fenced=bool(payload.get("fenced", False)),
+                payload_ok=payload_ok,
+            )
+        return out
+
+    def ages(self) -> Dict[int, float]:
+        """host id -> seconds since its lease file was last written."""
+        return {hid: lease.age_s for hid, lease in self.leases().items()}
+
+    def check(self) -> List[int]:
+        """All hosts currently considered dead (stale past timeout)."""
+        return sorted(
+            hid
+            for hid, lease in self.leases().items()
+            if not lease.fresh and hid != self.self_id
+        )
+
+    def poll(self) -> Tuple[List[Lease], List[Lease]]:
+        """(newly_dead, newly_alive) lease lists — the edges since the last
+        poll, each fired once per (host, epoch)."""
+        newly_dead: List[Lease] = []
+        newly_alive: List[Lease] = []
+        for hid, lease in sorted(self.leases().items()):
+            if hid == self.self_id:
+                continue
+            if lease.fresh:
+                # the alive edge's epoch is LOAD-BEARING (readmission fences
+                # on it): if the payload read raced the writer's rename,
+                # defer the edge to the next poll rather than hand the
+                # controller a default epoch 0 — the file is being actively
+                # rewritten every interval, so the retry is imminent.  The
+                # DEATH edge below deliberately does not defer: a torn final
+                # write from a dying host may never become readable, and a
+                # conservative epoch-0 death report (re-fired if a real
+                # higher epoch surfaces later) beats missing the death.
+                if not lease.payload_ok:
+                    continue
+                if hid in self._dead_epochs:
+                    del self._dead_epochs[hid]
+                    newly_alive.append(lease)
+            else:
+                reported = self._dead_epochs.get(hid)
+                if reported is None or lease.epoch > reported:
+                    self._dead_epochs[hid] = lease.epoch
+                    newly_dead.append(lease)
+        return newly_dead, newly_alive
+
+    def newly_dead(self) -> List[int]:
+        """Hosts that died since the last poll (compat shim over ``poll``;
+        callers that also want the revival edge use ``poll`` directly)."""
+        dead, _ = self.poll()
+        return [lease.host for lease in dead]
+
+
+# ------------------------------------------------------------ weight mailbox
+class WeightMailbox:
+    """Version-stamped weight publication for out-of-process actors.
+
+    The in-process apex loop broadcasts params over the mesh; processes
+    outside the SPMD program (soak actors, external fleets) instead watch
+    this tiny JSON file.  ``publish`` is atomic (tmp + rename) so a reader
+    never sees a torn row; the version is monotonically increasing, which is
+    what makes the staleness fence's lag arithmetic meaningful."""
+
+    def __init__(self, path: str):
+        self.path = path
+
+    def publish(self, version: int, step: int = 0, **extra: Any) -> None:
+        os.makedirs(os.path.dirname(self.path) or ".", exist_ok=True)
+        row = {"version": int(version), "step": int(step),
+               "ts": round(time.time(), 3), **extra}
+        tmp = self.path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(row, f)
+        os.replace(tmp, self.path)
+
+    def read(self) -> Optional[Dict[str, Any]]:
+        try:
+            with open(self.path) as f:
+                return json.load(f)
+        except (OSError, ValueError):
+            return None  # unpublished yet, or a reader racing the rename
+
+    def version(self) -> int:
+        row = self.read()
+        return int(row["version"]) if row else -1
+
+
+# ----------------------------------------------------------- staleness fence
+class StalenessFence:
+    """Pause acting when the adopted weight version trails the published one
+    by more than ``max_lag`` publishes (IMPACT: unbounded staleness corrupts
+    learning silently — shedding frames is strictly better than feeding
+    replay off-policy-beyond-budget experience).
+
+    ``observe`` returns True when acting is allowed.  Fence/resume edges are
+    emitted once per episode as ``actor_fenced`` rows (``action`` is
+    "fence" or "resume"); frames refused while fenced accumulate in
+    ``shed_frames``.  ``max_lag <= 0`` disables fencing but keeps the
+    ``weight_version_lag`` gauge live."""
+
+    def __init__(self, max_lag: int, metrics=None, registry=None,
+                 role: str = "actor"):
+        self.max_lag = int(max_lag)
+        self.metrics = metrics
+        self.registry = registry
+        self.role = role
+        self.fenced = False
+        self.fences = 0
+        self.shed_frames = 0
+        self.lag = 0
+
+    def _gauge(self, name: str, value: float) -> None:
+        if self.registry is not None:
+            self.registry.gauge(name, self.role).set(value)
+
+    def observe(self, held_version: int, published_version: int,
+                step: int = 0, frames_at_stake: int = 0) -> bool:
+        self.lag = max(int(published_version) - int(held_version), 0)
+        self._gauge("weight_version_lag", self.lag)
+        if self.max_lag <= 0:
+            return True
+        if self.lag > self.max_lag:
+            if not self.fenced:
+                self.fenced = True
+                self.fences += 1
+                if self.metrics is not None:
+                    self.metrics.log("actor_fenced", action="fence",
+                                     lag=self.lag, max_lag=self.max_lag,
+                                     step=int(step))
+            self.shed_frames += int(frames_at_stake)
+            self._gauge("actor_shed_frames", self.shed_frames)
+            return False
+        if self.fenced:
+            self.fenced = False
+            if self.metrics is not None:
+                self.metrics.log("actor_fenced", action="resume",
+                                 lag=self.lag, max_lag=self.max_lag,
+                                 step=int(step))
+        return True
+
+
+# -------------------------------------------------------- respawn supervision
+class RoleSupervisor:
+    """Process-level respawn-with-backoff under the shared FailureBudget.
+
+    Roles are registered with a ``spawn(epoch)`` callable returning a
+    process-like object (``poll()`` -> rc or None, ``kill()``).  ``poll``
+    drives the state machine:
+
+        running --exit--> backoff (delay = RetryPolicy schedule, fault row
+                          ``actor_dead``) --due--> running at epoch+1
+                          (fault row ``actor_respawn``)
+        running --exit, budget exhausted--> evicted (permanent; fault row
+                          ``actor_evicted`` — the fleet layer's
+                          ``train_aborted``)
+
+    The backoff schedule comes from `faults.RetryPolicy.delays()` — the one
+    retry policy training IO and serving hot-swap already share — so two
+    soaks with the same seed respawn identically."""
+
+    def __init__(self, backoff: faults.RetryPolicy,
+                 budget: Optional[faults.FailureBudget] = None,
+                 metrics=None, registry=None,
+                 clock: Callable[[], float] = time.monotonic,
+                 healthy_uptime_s: float = 60.0):
+        self.backoff = backoff
+        self.budget = budget if budget is not None else faults.FailureBudget(
+            max_failures=max(backoff.attempts - 1, 1)
+        )
+        self.metrics = metrics
+        self.registry = registry
+        self.clock = clock
+        # an incarnation that survives this long clears its role's strike
+        # count (FailureBudget.clear): the budget bounds CONSECUTIVE crash
+        # loops, not lifetime preemptions — a host preempted once a day for
+        # a week is healthy infrastructure, not a candidate for eviction
+        self.healthy_uptime_s = float(healthy_uptime_s)
+        self._delays = list(backoff.delays()) or [backoff.base_delay_s]
+        self._roles: Dict[str, Dict[str, Any]] = {}
+
+    @classmethod
+    def from_config(cls, cfg, metrics=None, registry=None,
+                    clock: Callable[[], float] = time.monotonic
+                    ) -> "RoleSupervisor":
+        """The Config wiring for the respawn knobs: a role gets exactly
+        ``respawn_attempts`` RESTARTS before eviction (the budget poisons on
+        failure N+1, matching docs/RESILIENCE.md and launch_apex.sh's shell
+        mirror), backed off from ``respawn_base_s`` to ``respawn_max_s``
+        with the shared seeded jitter.  scripts/chaos_soak.py defaults its
+        CLI to the same fields."""
+        attempts = max(int(cfg.respawn_attempts), 1)
+        return cls(
+            faults.RetryPolicy(
+                attempts=attempts + 1,
+                base_delay_s=cfg.respawn_base_s,
+                max_delay_s=cfg.respawn_max_s,
+                seed=getattr(cfg, "seed", 0),
+            ),
+            budget=faults.FailureBudget(attempts + 1),
+            metrics=metrics, registry=registry, clock=clock,
+        )
+
+    # ------------------------------------------------------------- registry
+    def register(self, role_id: str, spawn: Callable[[int], Any],
+                 epoch: int = 0, proc: Any = None,
+                 meta: Optional[Dict[str, Any]] = None) -> Any:
+        """Track ``role_id``; spawns immediately at ``epoch`` unless a live
+        ``proc`` for that epoch is handed in.  ``meta`` fields (e.g.
+        ``role_host``, the host id RunHealth keys eviction on) ride in every
+        event row this role emits."""
+        if proc is None:
+            proc = spawn(epoch)
+        self._roles[role_id] = {
+            "spawn": spawn, "proc": proc, "epoch": int(epoch),
+            "state": "running", "due": 0.0, "meta": dict(meta or {}),
+            "since": self.clock(),
+        }
+        self._observe()
+        return proc
+
+    def _observe(self) -> None:
+        if self.registry is None:
+            return
+        states = [r["state"] for r in self._roles.values()]
+        self.registry.gauge("roles_running", "supervisor").set(
+            states.count("running"))
+        self.registry.gauge("roles_evicted", "supervisor").set(
+            states.count("evicted"))
+
+    def _report(self, event: str, **fields: Any) -> None:
+        if self.metrics is not None:
+            self.metrics.log("fault", event=event, **fields)
+
+    # ----------------------------------------------------------- supervision
+    def poll(self, step: int = 0) -> List[Dict[str, Any]]:
+        """One supervision sweep; returns the transition events it emitted."""
+        events: List[Dict[str, Any]] = []
+        for role_id, r in self._roles.items():
+            if r["state"] == "running":
+                rc = r["proc"].poll() if r["proc"] is not None else 1
+                if rc is None:
+                    if (self.budget.failures(role_id)
+                            and self.clock() - r["since"]
+                            >= self.healthy_uptime_s):
+                        # the incarnation proved healthy: strikes are for
+                        # consecutive crash loops, not lifetime preemptions
+                        self.budget.clear(role_id)
+                    continue
+                n = self.budget.record(role_id)
+                if self.budget.poisoned(role_id):
+                    r["state"] = "evicted"
+                    ev = {"event": "actor_evicted", "role": role_id, "rc": rc,
+                          "failures": n, "epoch": r["epoch"], "step": step,
+                          **r["meta"]}
+                else:
+                    delay = self._delays[min(n - 1, len(self._delays) - 1)]
+                    r["state"] = "backoff"
+                    r["due"] = self.clock() + delay
+                    ev = {"event": "actor_dead", "role": role_id, "rc": rc,
+                          "failures": n, "epoch": r["epoch"], "step": step,
+                          "respawn_in_s": round(delay, 3), **r["meta"]}
+                self._report(**ev)
+                events.append(ev)
+            elif r["state"] == "backoff" and self.clock() >= r["due"]:
+                r["epoch"] += 1
+                r["proc"] = r["spawn"](r["epoch"])
+                r["state"] = "running"
+                r["since"] = self.clock()
+                ev = {"event": "actor_respawn", "role": role_id,
+                      "epoch": r["epoch"],
+                      "attempt": self.budget.failures(role_id), "step": step,
+                      **r["meta"]}
+                self._report(**ev)
+                events.append(ev)
+        self._observe()
+        return events
+
+    # ------------------------------------------------------------- inspection
+    def state(self, role_id: str) -> str:
+        return self._roles[role_id]["state"]
+
+    def epoch(self, role_id: str) -> int:
+        return self._roles[role_id]["epoch"]
+
+    def proc(self, role_id: str) -> Any:
+        return self._roles[role_id]["proc"]
+
+    def evicted(self) -> List[str]:
+        return sorted(r for r, s in self._roles.items()
+                      if s["state"] == "evicted")
+
+    def all_settled(self) -> bool:
+        """No respawn pending: every role is either running or evicted."""
+        return all(r["state"] != "backoff" for r in self._roles.values())
+
+    def stop_all(self) -> None:
+        for r in self._roles.values():
+            proc = r["proc"]
+            if proc is not None and proc.poll() is None:
+                try:
+                    proc.kill()
+                except OSError:
+                    pass

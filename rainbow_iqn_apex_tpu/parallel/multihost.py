@@ -28,17 +28,11 @@ runs end-to-end.  Real pods swap the fabric for ICI/DCN with no code change.
 from __future__ import annotations
 
 import dataclasses
-import json
-import os
-import threading
-import time
-from typing import Dict, List, Optional, Tuple
+from typing import Optional, Tuple
 
 import jax
 import jax.numpy as jnp
 import numpy as np
-
-from rainbow_iqn_apex_tpu.utils import faults
 
 
 def initialize(
@@ -148,128 +142,18 @@ def plan_hosts(cfg, lanes_total: int) -> HostPlan:
 # Multi-host degradation (docs/RESILIENCE.md): a preempted actor host stops
 # making progress silently — the survivors' next collective just hangs.  The
 # only cross-host channel that needs no collective is the shared filesystem
-# the run already writes to, so liveness is a per-host heartbeat FILE: each
-# host re-writes ``heartbeats/h<i>.json`` on an interval, and any host can
-# cheaply detect a peer whose file has gone stale.  Detection is the part a
-# hung collective cannot give you; the report (a ``host_dead`` metrics row
-# naming the host) is what lets an external supervisor restart or reshard
-# the run instead of letting it wedge until the job timeout.
-
-
-def heartbeat_dir(cfg) -> str:
-    return os.path.join(cfg.results_dir, cfg.run_id, "heartbeats")
-
-
-class HeartbeatWriter:
-    """Daemon thread re-writing this host's heartbeat file every
-    ``interval_s``.  Writes are atomic (tmp + rename) so a reader never sees
-    a torn JSON.  The ``heartbeat_loss`` fault point suppresses writes —
-    a preempted host, manufactured."""
-
-    def __init__(self, directory: str, process_id: int, interval_s: float,
-                 injector: Optional[faults.FaultInjector] = None):
-        self.directory = directory
-        self.process_id = int(process_id)
-        self.interval_s = float(interval_s)
-        self.injector = injector if injector is not None else faults.get()
-        self.path = os.path.join(directory, f"h{process_id}.json")
-        self.payload: Dict = {}  # callers may stuff step/frames in here
-        self.beats = 0
-        self.suppressed = 0
-        self._stop = threading.Event()
-        self._thread: Optional[threading.Thread] = None
-
-    def beat(self) -> None:
-        """One heartbeat write (also usable inline, without the thread)."""
-        if self.injector.enabled and self.injector.fire("heartbeat_loss"):
-            self.suppressed += 1
-            return
-        os.makedirs(self.directory, exist_ok=True)
-        row = {
-            "process_id": self.process_id,
-            "t_mono": time.monotonic(),
-            "t_wall": time.time(),
-            **self.payload,
-        }
-        tmp = self.path + ".tmp"
-        with open(tmp, "w") as f:
-            json.dump(row, f)
-        os.replace(tmp, self.path)
-        self.beats += 1
-
-    def _run(self) -> None:
-        while not self._stop.is_set():
-            try:
-                self.beat()
-            except OSError:
-                pass  # a flaky FS write is itself a missed beat; keep going
-            self._stop.wait(self.interval_s)
-
-    def start(self) -> "HeartbeatWriter":
-        if self._thread is None:
-            self.beat()  # first beat synchronously: exists before any check
-            self._thread = threading.Thread(
-                target=self._run, name="heartbeat-writer", daemon=True
-            )
-            self._thread.start()
-        return self
-
-    def stop(self) -> None:
-        self._stop.set()
-        if self._thread is not None:
-            self._thread.join(timeout=5)
-            self._thread = None
-
-
-class HeartbeatMonitor:
-    """Scan peer heartbeat files; report hosts gone stale past ``timeout_s``.
-
-    Staleness is judged by file mtime (monotonic-ish on one filesystem and
-    immune to clock skew between hosts writing wall-clock payloads).  A host
-    with NO file yet is not dead — it may simply not have started; only a
-    file that existed and stopped updating is a death signal.  ``check()``
-    returns the CURRENT dead set; ``newly_dead()`` returns only hosts that
-    died since the last call (the edge, for once-per-transition reporting).
-    """
-
-    def __init__(self, directory: str, timeout_s: float, self_id: Optional[int] = None):
-        self.directory = directory
-        self.timeout_s = float(timeout_s)
-        self.self_id = self_id
-        self._reported: set = set()
-
-    def ages(self) -> Dict[int, float]:
-        """host id -> seconds since its heartbeat file was last written."""
-        out: Dict[int, float] = {}
-        try:
-            names = os.listdir(self.directory)
-        except FileNotFoundError:
-            return out
-        now = time.time()
-        for name in names:
-            if not (name.startswith("h") and name.endswith(".json")):
-                continue
-            try:
-                hid = int(name[1:-5])
-                out[hid] = now - os.path.getmtime(os.path.join(self.directory, name))
-            except (ValueError, OSError):
-                continue  # torn tmp file or a peer mid-rename
-        return out
-
-    def check(self) -> List[int]:
-        """All hosts currently considered dead (stale past timeout)."""
-        return sorted(
-            hid
-            for hid, age in self.ages().items()
-            if age > self.timeout_s and hid != self.self_id
-        )
-
-    def newly_dead(self) -> List[int]:
-        dead = set(self.check())
-        fresh = sorted(dead - self._reported)
-        # a host that comes BACK (file re-written) re-arms its edge report
-        self._reported = dead
-        return fresh
+# the run already writes to, so liveness is a per-host heartbeat FILE.  The
+# writer/monitor pair grew into a role-lease registry (payload carries role,
+# shard, lease epoch, weight_version; the monitor reports host_dead AND
+# host_alive edges once per epoch) and moved to parallel/elastic.py so
+# respawned actor processes can import it without paying the jax import;
+# re-exported here because this is where every existing caller found it.
+from rainbow_iqn_apex_tpu.parallel.elastic import (  # noqa: F401,E402
+    HeartbeatMonitor,
+    HeartbeatWriter,
+    Lease,
+    heartbeat_dir,
+)
 
 
 # --------------------------------------------------------- shared SPMD helpers

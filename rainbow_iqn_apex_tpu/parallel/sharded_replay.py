@@ -18,6 +18,7 @@ from typing import List, Optional, Sequence, Tuple
 import numpy as np
 
 from rainbow_iqn_apex_tpu.replay.buffer import PrioritizedReplay, SampledBatch
+from rainbow_iqn_apex_tpu.utils import faults
 
 
 class ShardedReplay:
@@ -43,6 +44,13 @@ class ShardedReplay:
         # append/sample/write-back so the learner keeps training on the
         # survivors instead of wedging (docs/RESILIENCE.md)
         self._dead: set = set()
+        # elasticity (docs/RESILIENCE.md "heal"): each shard carries the
+        # lease epoch of the incarnation allowed to write it.  drop ->
+        # readmit bumps the epoch, so a zombie pre-eviction incarnation's
+        # appends/write-backs are fenced off instead of corrupting the
+        # readmitted shard (split-brain protection).
+        self._epoch: List[int] = [0] * len(self.shards)
+        self._fenced_writes = 0
         self._reg = None  # obs registry (attach_registry); None = untracked
 
     def attach_registry(self, registry, role: str = "replay") -> None:
@@ -115,8 +123,15 @@ class ShardedReplay:
 
     @property
     def sampleable(self) -> bool:
-        alive = [s for k, s in enumerate(self.shards) if k not in self._dead]
-        return bool(alive) and all(s.sampleable for s in alive)
+        """ANY alive shard with priority mass makes the aggregate
+        sampleable: ``sample`` already hands a zero-mass shard a zero
+        multinomial count, and requiring ALL alive shards to hold data
+        would let one cold readmitted shard (an explicitly supported
+        healing state) halt a learner whose surviving shards are full."""
+        return any(
+            s.sampleable
+            for k, s in enumerate(self.shards) if k not in self._dead
+        )
 
     # -------------------------------------------------------------- degradation
     def drop_shard(self, k: int) -> None:
@@ -134,6 +149,114 @@ class ShardedReplay:
     @property
     def dead_shards(self) -> Tuple[int, ...]:
         return tuple(sorted(self._dead))
+
+    # -------------------------------------------------------------- elasticity
+    def shard_epoch(self, k: int) -> int:
+        """The lease epoch currently allowed to write shard ``k``."""
+        return self._epoch[k]
+
+    @property
+    def fenced_writes(self) -> int:
+        """Appends/write-backs rejected by epoch fencing (lifetime)."""
+        return self._fenced_writes
+
+    def readmit_shard(self, k: int, epoch: Optional[int] = None,
+                      reseed_priority: bool = True) -> int:
+        """Reverse ``drop_shard``: a rejoining host re-registers its (empty
+        or snapshot-restored) shard under a NEW lease epoch.  Sampling
+        rebalances over the survivor set automatically (the proportional
+        split sees the shard's mass again), and the shard's default append
+        priority is re-seeded from the survivors' current max so a cold
+        rejoining shard's fresh experience competes immediately instead of
+        starving behind a year of accumulated priority mass.  Returns the
+        epoch that now owns the shard; the ``shard_rejoin`` fault point
+        makes the re-registration itself fail once (callers retry under the
+        shared RetryPolicy)."""
+        if not 0 <= k < len(self.shards):
+            raise ValueError(f"no shard {k} (have {len(self.shards)})")
+        if k not in self._dead:
+            raise ValueError(f"shard {k} is not dead; nothing to readmit")
+        injector = faults.get()
+        if injector.enabled and injector.fire("shard_rejoin"):
+            raise OSError(f"injected shard_rejoin failure for shard {k}")
+        new_epoch = self._epoch[k] + 1 if epoch is None else int(epoch)
+        # equal epoch is legal: a false-positive drop (lease blip) readmits
+        # the SAME incarnation, whose writes stay valid; only an OLDER epoch
+        # — a superseded incarnation — is an error
+        if new_epoch < self._epoch[k]:
+            raise ValueError(
+                f"readmission epoch {new_epoch} is older than the fenced "
+                f"epoch {self._epoch[k]} for shard {k}"
+            )
+        if reseed_priority:
+            survivor_max = [
+                s.max_priority for j, s in enumerate(self.shards)
+                if j != k and j not in self._dead
+            ]
+            if survivor_max:
+                self.shards[k].max_priority = max(
+                    max(survivor_max), self.shards[k].max_priority
+                )
+        self._dead.discard(k)
+        self._epoch[k] = new_epoch
+        if self._reg is not None:
+            self._reg.counter("replay_shard_readmits", self._role).inc()
+        self._observe()
+        return new_epoch
+
+    def _fence(self, k: int, epoch: Optional[int]) -> bool:
+        """True when a write stamped ``epoch`` may land on shard ``k``."""
+        if k in self._dead:
+            return False
+        if epoch is not None and int(epoch) != self._epoch[k]:
+            self._fenced_writes += 1
+            if self._reg is not None:
+                self._reg.counter("replay_fenced_writes", self._role).inc()
+            return False
+        return True
+
+    def append_shard(
+        self,
+        k: int,
+        frames: np.ndarray,
+        actions: np.ndarray,
+        rewards: np.ndarray,
+        terminals: np.ndarray,
+        priorities: Optional[np.ndarray] = None,
+        truncations: Optional[np.ndarray] = None,
+        epoch: Optional[int] = None,
+    ) -> bool:
+        """Epoch-fenced single-shard append (the elastic ingest path: one
+        producer host feeds exactly its own shard).  Returns False — and
+        drops the rows — when the shard is dead or ``epoch`` names a stale
+        incarnation; True when the rows landed."""
+        if not 0 <= k < len(self.shards):
+            raise ValueError(f"no shard {k} (have {len(self.shards)})")
+        if not self._fence(k, epoch):
+            return False
+        self.shards[k].append_batch(
+            frames, actions, rewards, terminals, priorities, truncations
+        )
+        if self._reg is not None:
+            self._reg.counter("replay_appended_rows", self._role).inc(
+                len(actions)
+            )
+        self._observe()
+        return True
+
+    def update_shard_priorities(
+        self, k: int, local_idx: np.ndarray, td_abs: np.ndarray,
+        epoch: Optional[int] = None,
+    ) -> bool:
+        """Epoch-fenced per-shard priority write-back (same fence as
+        ``append_shard``; a stale incarnation's TD estimates must not skew
+        the readmitted shard's sampling distribution)."""
+        if not 0 <= k < len(self.shards):
+            raise ValueError(f"no shard {k} (have {len(self.shards)})")
+        if not self._fence(k, epoch):
+            return False
+        self.shards[k].update_priorities(local_idx, td_abs)
+        return True
 
     # ------------------------------------------------------------------ sample
     def sample(self, batch_size: int, beta: float) -> SampledBatch:
@@ -209,6 +332,10 @@ class ShardedReplay:
             rng_state=np.frombuffer(
                 json.dumps(self.rng.bit_generator.state).encode(), np.uint8
             ),
+            # elasticity state: writer epochs + dead set, so a resumed run
+            # keeps fencing the same stale incarnations it fenced before
+            shard_epochs=np.asarray(self._epoch, np.int64),
+            dead_shards=np.asarray(sorted(self._dead), np.int64),
         )
 
     def restore(self, path_prefix: str) -> None:
@@ -233,6 +360,12 @@ class ShardedReplay:
             self.rng.bit_generator.state = json.loads(
                 np.asarray(meta["rng_state"], np.uint8).tobytes().decode()
             )
+            if "shard_epochs" in meta:  # pre-elastic metas carry neither
+                epochs = np.asarray(meta["shard_epochs"], np.int64)
+                if len(epochs) == len(self.shards):
+                    self._epoch = [int(e) for e in epochs]
+                self._dead = {int(k) for k in np.asarray(
+                    meta["dead_shards"], np.int64)}
         except snapshot_io.MISSING:
             pass
 

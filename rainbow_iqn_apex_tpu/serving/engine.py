@@ -22,6 +22,7 @@ parallel/apex.py.
 from __future__ import annotations
 
 import threading
+import time
 from typing import Any, List, Optional, Sequence, Tuple
 
 import jax
@@ -87,6 +88,10 @@ class InferenceEngine:
         self._swap_lock = threading.Lock()
         self._params = jax.device_put(params, self._rep)
         self.params_version = 0
+        # staleness monitoring (the serving mirror of the training side's
+        # weight-version stamp, parallel/elastic.py): when the weights last
+        # changed, so healthz can report weights_age_s externally
+        self.weights_loaded_at = time.monotonic()
 
     # ------------------------------------------------------------- hot swap
     def load_params(self, params: Any) -> int:
@@ -100,7 +105,12 @@ class InferenceEngine:
         with self._swap_lock:
             self._params = jax.device_put(params, self._rep)
             self.params_version += 1
+            self.weights_loaded_at = time.monotonic()
             return self.params_version
+
+    def weights_age_s(self) -> float:
+        """Seconds since the served weights last changed."""
+        return time.monotonic() - self.weights_loaded_at
 
     @property
     def params(self) -> Any:

@@ -259,6 +259,13 @@ class PolicyServer:
             "queue_depth": depth,
             "worker_alive": worker_alive,
             "params_version": self.engine.params_version,
+            # serving staleness, externally monitorable (the serving mirror
+            # of the actor-side weight_version_lag gauge): which weight
+            # version is live and how long since it changed
+            "weights_version": self.engine.params_version,
+            "weights_age_s": round(self.engine.weights_age_s(), 3),
+            "weights_step": None if self.watcher is None
+            else self.watcher.last_step,
             **snap,
         }
 

@@ -14,6 +14,12 @@ engine keeps serving the previous params.  A failing step is retried up to
 ``max_restore_failures`` times (a transient I/O blip on a networked FS must
 not strand the server on stale weights) and then poisoned — no retry storm
 against a genuinely bad file.
+
+Swaps adopt the training side's weight-version discipline
+(parallel/elastic.py): each successful swap bumps the engine's monotone
+``params_version``, ``healthz()`` reports ``weights_version`` +
+``weights_age_s`` so serving staleness is externally monitorable, and a
+swap never rolls BACKWARDS to an older checkpoint step unless forced.
 """
 
 from __future__ import annotations
@@ -75,6 +81,7 @@ class CheckpointWatcher:
         self.metrics = metrics
         self.max_restore_failures = int(max_restore_failures)
         self.last_step: Optional[int] = None
+        self._refused_backward: Optional[int] = None  # dedupe metric rows
         # the shared bounded-failure policy (utils/faults.py): training's
         # supervisor and the serving hot-swap count strikes the same way
         self._budget = FailureBudget(max_restore_failures)
@@ -97,6 +104,24 @@ class CheckpointWatcher:
                 return {"ok": False, "step": target, "reason": "poisoned"}
             if target == self.last_step and not force:
                 return {"ok": True, "step": target, "reason": "already_loaded"}
+            if (self.last_step is not None and target < self.last_step
+                    and not force):
+                # never roll the fleet BACKWARDS: the checkpoint step is the
+                # weight-version stamp (parallel/elastic.py semantics), and a
+                # listing that momentarily surfaces an older step (pruned dir
+                # resync, explicit reload(step=) typo) must not regress live
+                # traffic to stale weights.  Deliberate rollback = force=True.
+                # The metrics row fires once per refused step, not once per
+                # poll — a training lineage legitimately restarted from an
+                # older checkpoint would otherwise spam a swap row every
+                # poll_interval_s until its step count caught up.
+                event = {"ok": False, "step": target,
+                         "loaded_step": self.last_step,
+                         "reason": "older_than_loaded"}
+                if self.metrics is not None and target != self._refused_backward:
+                    self._refused_backward = target
+                    self.metrics.record_swap(**event)
+                return event
             try:
                 params = restore_params(self.ckpt, self.template, step=target)
                 version = self.swap_fn(params)
@@ -113,6 +138,10 @@ class CheckpointWatcher:
             self.last_step = target
             # a recovered step (forced or retried) is whole again — un-poison
             self._budget.clear(target)
+            # any successful swap closes the refused-backward episode: a
+            # LATER regression to the same old step is a new incident and
+            # must emit its own telemetry row
+            self._refused_backward = None
             event = {"ok": True, "step": target, "params_version": version}
             if self.metrics is not None:
                 self.metrics.record_swap(**event)

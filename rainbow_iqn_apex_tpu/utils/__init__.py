@@ -1,4 +1,33 @@
-from rainbow_iqn_apex_tpu.utils.checkpoint import Checkpointer
-from rainbow_iqn_apex_tpu.utils.logging import MetricsLogger
+"""utils/ — checkpointing, logging, faults, prefetch, profiling.
 
-__all__ = ["Checkpointer", "MetricsLogger"]
+Lazy exports (PEP 562): `utils.checkpoint` imports jax + orbax, which the
+jax-free callers (`utils.faults` users like parallel/elastic.py and the
+chaos-soak actor children) must not pay for just by touching the package.
+"""
+
+from typing import TYPE_CHECKING
+
+_EXPORTS = {
+    "Checkpointer": "rainbow_iqn_apex_tpu.utils.checkpoint",
+    "MetricsLogger": "rainbow_iqn_apex_tpu.utils.logging",
+}
+
+__all__ = sorted(_EXPORTS)
+
+
+def __getattr__(name: str):
+    module = _EXPORTS.get(name)
+    if module is None:
+        raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
+    import importlib
+
+    return getattr(importlib.import_module(module), name)
+
+
+def __dir__():
+    return __all__
+
+
+if TYPE_CHECKING:
+    from rainbow_iqn_apex_tpu.utils.checkpoint import Checkpointer  # noqa: F401
+    from rainbow_iqn_apex_tpu.utils.logging import MetricsLogger  # noqa: F401

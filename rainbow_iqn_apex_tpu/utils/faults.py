@@ -41,6 +41,10 @@ POINTS = (
     "nan_loss",  # the sampled batch is poisoned with non-finite rewards
     "stalled_step",  # the learn step blocks (wedged device / collective)
     "heartbeat_loss",  # a host stops writing its heartbeat file (preemption)
+    "actor_exit",  # an actor process exits mid-run (OOM kill, crash loop)
+    "lease_lost",  # a LIVE process stops renewing its lease (zombie / split
+    # brain: the incarnation epoch fencing exists for)
+    "shard_rejoin",  # shard readmission fails once (re-registration raced)
 )
 
 ENV_VAR = "RIA_FAULTS"

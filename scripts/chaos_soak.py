@@ -1,0 +1,574 @@
+#!/usr/bin/env python
+"""chaos_soak: drive the elastic fleet layer through a seeded kill/revive
+schedule with REAL processes, and assert the run heals (docs/RESILIENCE.md).
+
+    python scripts/chaos_soak.py --frames 2000 --kill-schedule seeded
+    python scripts/chaos_soak.py --frames 600 --out /tmp/soak --json
+
+Topology (everything jax-free, so the soak runs anywhere in seconds):
+
+    parent = learner + elastic controller          actor children (one per
+      ShardedReplay (one shard per actor host)       host, respawnable)
+      WeightMailbox publish (version-stamped)  --->  adopt + StalenessFence
+      spool ingest (epoch-fenced append_shard) <---  spool JSONL rows
+      HeartbeatMonitor.poll (lease edges)      <---  HeartbeatWriter lease
+      RoleSupervisor (respawn w/ backoff, FailureBudget eviction)
+
+Seeded schedule (`--kill-schedule seeded`): host 1 is killed mid-run via the
+``actor_exit`` fault point and REVIVED — the supervisor respawns it at lease
+epoch+1, its lease edge fires ``host_alive``, its shard is readmitted
+(``shard_readmit``), and its leftover epoch-0 spool rows are rejected by the
+epoch fence.  Host 2 is killed and every respawn is poisoned, so the
+FailureBudget exhausts and it is permanently evicted (``actor_evicted``).
+Host 3 lives but adopts weights slowly, so the staleness fence pauses it
+(``actor_fenced``) instead of letting it act past ``max_weight_lag``.  The
+``lease_lost`` point briefly suppresses host 3's renewals (below the death
+timeout), and ``shard_rejoin`` makes the first readmission attempt fail so
+the retry path runs.
+
+The harness asserts, from its own JSONL (exit 0 only if ALL hold):
+  * the final health row is ``status=ok`` (the run HEALED, not just survived);
+  * a ``shard_readmit`` row exists and a post-readmit sample drew from the
+    readmitted shard;
+  * the unrevived host was evicted after its FailureBudget;
+  * no actor row ever acted with ``weight_version_lag > max_weight_lag``;
+  * stale-epoch spool rows were fenced (``fenced_writes > 0``);
+  * the whole run dir lints against the obs/ schema (strict JSON).
+
+`make soak-smoke` runs this at --frames 2000; the `chaos`-marked tier-1 test
+(tests/test_elastic.py) runs a smaller budget.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO)
+
+from rainbow_iqn_apex_tpu.utils import faults  # noqa: E402
+
+FRAME = 8  # tiny synthetic frames: the soak exercises plumbing, not learning
+LANES = 2  # env lanes per actor host
+
+
+# ---------------------------------------------------------------- actor child
+def actor_main(args) -> int:
+    """One actor host: lease renewal, weight adoption + staleness fence,
+    spool production.  Deliberately jax-free (~0.3s cold start)."""
+    from rainbow_iqn_apex_tpu.parallel.elastic import (
+        HeartbeatWriter,
+        StalenessFence,
+        WeightMailbox,
+    )
+    from rainbow_iqn_apex_tpu.utils.logging import MetricsLogger
+
+    if args.poison:
+        return 1  # a crash-looping binary: dies before it ever leases
+
+    injector = faults.FaultInjector(
+        os.environ.get(faults.ENV_VAR, ""), seed=args.seed
+    )
+    hb_dir = os.path.join(args.dir, "heartbeats")
+    lease = HeartbeatWriter(
+        hb_dir, args.host, args.hb_interval, injector=injector,
+        role="actor", shard=args.shard, epoch=args.epoch,
+    ).start()
+    metrics = MetricsLogger(
+        os.path.join(args.dir, f"actor_h{args.host}_e{args.epoch}.jsonl"),
+        run_id=args.run_id, echo=False, host=args.host,
+    )
+    fence = StalenessFence(args.max_weight_lag, metrics=metrics)
+    mailbox = WeightMailbox(os.path.join(args.dir, "weights.json"))
+    spool_path = os.path.join(
+        args.dir, "spool", f"h{args.host}_e{args.epoch}.jsonl"
+    )
+    os.makedirs(os.path.dirname(spool_path), exist_ok=True)
+    rng = np.random.default_rng(args.seed + 101 * args.host + args.epoch)
+    held = -1
+    produced = 0
+    with open(spool_path, "a", buffering=1) as spool:
+        for tick in range(1, args.max_ticks + 1):
+            if injector.enabled and injector.fire("actor_exit"):
+                metrics.log("fault", event="actor_exit", tick=tick)
+                metrics.close()
+                os._exit(3)  # the kill: no flush, no lease farewell
+            published = mailbox.version()
+            if held < 0 or tick % args.adopt_every == 0:
+                held = published
+                lease.set_weight_version(held)
+            acted = fence.observe(
+                held, published, step=tick, frames_at_stake=LANES
+            )
+            # the lease carries the fence state, so the learner-side
+            # controller (and its RunHealth) sees a fenced actor without
+            # tailing this process's local JSONL
+            lease.payload["fenced"] = fence.fenced
+            if acted and published >= 0:
+                row = {
+                    "epoch": args.epoch,
+                    "tick": tick,
+                    "weight_version": held,
+                    "f": rng.integers(0, 255, (LANES, FRAME, FRAME)).tolist(),
+                    "a": rng.integers(0, 4, LANES).tolist(),
+                    "r": np.round(rng.normal(size=LANES), 4).tolist(),
+                    "d": (rng.random(LANES) < 0.05).tolist(),
+                }
+                spool.write(json.dumps(row) + "\n")
+                produced += 1
+            if tick % 25 == 0 or not acted:
+                metrics.log(
+                    "actor", tick=tick, acted=bool(acted), lag=fence.lag,
+                    weight_version=held, produced=produced,
+                    shed_frames=fence.shed_frames,
+                )
+            time.sleep(args.tick_s)
+    lease.stop()
+    metrics.close()
+    return 0
+
+
+# ------------------------------------------------------------- learner parent
+class SpoolIngestor:
+    """Tail every spool file for a shard; feed rows through the epoch fence.
+
+    Ingest is deliberately throttled (``max_rows`` per poll) so a killed
+    host leaves unconsumed rows behind — exactly the at-least-once leftovers
+    the epoch fence must reject after readmission."""
+
+    def __init__(self, spool_dir: str, memory, max_rows: int = 1):
+        self.spool_dir = spool_dir
+        self.memory = memory
+        self.max_rows = max_rows
+        self._offsets: dict = {}  # path -> byte offset consumed
+
+    def poll_shard(self, shard: int, host: int) -> int:
+        """Ingest up to ``max_rows`` spool rows for ``shard``; returns the
+        number of transitions ACCEPTED by the fence."""
+        accepted = 0
+        try:
+            names = sorted(os.listdir(self.spool_dir))
+        except FileNotFoundError:
+            return 0
+        budget = self.max_rows
+        for name in names:
+            if budget <= 0:
+                break
+            if not name.startswith(f"h{host}_e") or not name.endswith(".jsonl"):
+                continue
+            path = os.path.join(self.spool_dir, name)
+            off = self._offsets.get(path, 0)
+            with open(path) as f:
+                f.seek(off)
+                while budget > 0:
+                    line = f.readline()
+                    if not line or not line.endswith("\n"):
+                        break  # EOF or a row mid-write; retry next poll
+                    off = f.tell()
+                    budget -= 1
+                    try:
+                        row = json.loads(line)
+                    except ValueError:
+                        continue  # torn row: skip, never wedge the learner
+                    ok = self.memory.append_shard(
+                        shard,
+                        np.asarray(row["f"], np.uint8),
+                        np.asarray(row["a"], np.int32),
+                        np.asarray(row["r"], np.float32),
+                        np.asarray(row["d"], bool),
+                        epoch=int(row.get("epoch", 0)),
+                    )
+                    if ok:
+                        accepted += len(row["a"])
+            self._offsets[path] = off
+        return accepted
+
+
+def soak_main(args) -> int:
+    from rainbow_iqn_apex_tpu.obs.health import RunHealth
+    from rainbow_iqn_apex_tpu.obs.registry import MetricRegistry
+    from rainbow_iqn_apex_tpu.parallel.elastic import (
+        HeartbeatMonitor,
+        RoleSupervisor,
+        WeightMailbox,
+    )
+    from rainbow_iqn_apex_tpu.parallel.sharded_replay import ShardedReplay
+    from rainbow_iqn_apex_tpu.utils.logging import MetricsLogger
+
+    run_id = f"soak_{args.seed}"
+    run_dir = os.path.join(args.out, "results", run_id)
+    os.makedirs(run_dir, exist_ok=True)
+    hb_dir = os.path.join(run_dir, "heartbeats")
+    spool_dir = os.path.join(run_dir, "spool")
+    hosts = list(range(1, args.actors + 1))  # parent is host 0
+    shard_of = {h: h - 1 for h in hosts}
+
+    metrics = MetricsLogger(
+        os.path.join(run_dir, "metrics.jsonl"), run_id=run_id,
+        echo=not args.quiet, host=0,
+    )
+    registry = MetricRegistry()
+    health = RunHealth(registry, metrics, role="soak")
+    metrics.add_observer(health.observe_row)
+
+    memory = ShardedReplay.build(
+        args.actors, args.actors * 2048, args.actors * LANES,
+        frame_shape=(FRAME, FRAME), history=1, n_step=1, gamma=0.9,
+        seed=args.seed,
+    )
+    memory.attach_registry(registry)
+    ingest = SpoolIngestor(spool_dir, memory)
+    mailbox = WeightMailbox(os.path.join(run_dir, "weights.json"))
+    monitor = HeartbeatMonitor(hb_dir, args.hb_timeout, self_id=0)
+
+    # the first readmission attempt fails (shard_rejoin point) so the
+    # retry path is part of every soak, not just the happy path
+    faults.install(faults.FaultInjector("shard_rejoin@1", seed=args.seed))
+
+    # seeded kill schedule: deterministic child-side actor_exit ticks
+    rng = np.random.default_rng(args.seed)
+    seeded = args.kill_schedule == "seeded"
+    revive_host = hosts[0] if seeded else None
+    poison_host = hosts[1] if seeded and len(hosts) > 1 else None
+    kill_tick = {}
+    if seeded:
+        kill_tick[revive_host] = int(120 + rng.integers(0, 40))
+        if poison_host is not None:
+            kill_tick[poison_host] = int(160 + rng.integers(0, 40))
+    slow_host = hosts[-1]  # slow weight adoption: the fence's customer
+
+    def spawn_host(host: int):
+        def spawn(epoch: int):
+            import subprocess
+
+            argv = [
+                sys.executable, os.path.abspath(__file__), "--actor",
+                "--dir", run_dir, "--run-id", run_id,
+                "--host", str(host), "--shard", str(shard_of[host]),
+                "--epoch", str(epoch), "--seed", str(args.seed),
+                "--hb-interval", str(args.hb_interval),
+                "--max-weight-lag", str(args.max_weight_lag),
+                "--adopt-every",
+                str(40 if host == slow_host else 3),
+                # children tick twice as fast as the throttled ingest, so a
+                # killed host always leaves unconsumed spool rows behind for
+                # the epoch fence to reject after readmission
+                "--tick-s", str(args.tick_s / 2),
+                "--max-ticks", "100000",
+            ]
+            env = dict(os.environ)
+            env["PYTHONPATH"] = _REPO + os.pathsep + env.get("PYTHONPATH", "")
+            spec = []
+            if epoch == 0 and host in kill_tick:
+                spec.append(f"actor_exit@{kill_tick[host]}")
+            if host == slow_host:
+                # a short renewal gap, below the death timeout: the point
+                # fires without manufacturing a false-positive drop
+                spec.append("lease_lost@8,lease_lost@9")
+            if epoch > 0 and host == poison_host:
+                argv.append("--poison")  # crash loop: budget must exhaust
+            env[faults.ENV_VAR] = ",".join(spec)
+            return subprocess.Popen(argv, env=env,
+                                    stdout=subprocess.DEVNULL,
+                                    stderr=subprocess.STDOUT)
+
+        return spawn
+
+    from rainbow_iqn_apex_tpu.config import Config
+
+    sup = RoleSupervisor.from_config(
+        Config(respawn_attempts=args.respawn_attempts,
+               respawn_base_s=args.respawn_base_s,
+               respawn_max_s=2 * args.respawn_base_s,
+               seed=args.seed),
+        metrics=metrics, registry=registry,
+    )
+    for h in hosts:
+        sup.register(f"actor_h{h}", spawn_host(h), epoch=0,
+                     meta={"role_host": h})
+
+    version = 0
+    mailbox.publish(version, step=0)
+    frames = 0
+    step = 0
+    readmitted: dict = {}  # host -> readmit epoch
+    fenced_state: dict = {}  # host -> last lease-reported fence state
+    post_readmit_draw = False
+    deadline = time.monotonic() + args.deadline_s
+    last_health = {"status": "none"}
+    samples = 0
+
+    def relay_fence_edges() -> bool:
+        """Emit fence/resume edges off fresh leases into the parent's
+        metrics funnel (where RunHealth observes them); returns True while
+        any live actor is still fenced."""
+        any_fenced = False
+        for hid, lease in monitor.leases().items():
+            if not (lease.fresh and lease.payload_ok):
+                continue
+            if lease.fenced != fenced_state.get(hid, False):
+                fenced_state[hid] = lease.fenced
+                metrics.log(
+                    "actor_fenced",
+                    action="fence" if lease.fenced else "resume",
+                    fenced_host=hid,
+                    lag=max(version - lease.weight_version, 0),
+                    max_lag=args.max_weight_lag, step=step,
+                )
+            any_fenced |= lease.fenced
+        return any_fenced
+
+    def story_done() -> bool:
+        if not seeded:  # no-kill soak: the frame budget is the whole story
+            return frames >= args.frames
+        evicted_ok = poison_host is None or f"actor_h{poison_host}" in sup.evicted()
+        return (
+            frames >= args.frames
+            and revive_host in readmitted
+            and evicted_ok
+            and post_readmit_draw
+            and memory.fenced_writes > 0
+            and sup.all_settled()
+        )
+
+    try:
+        tick = 0
+        while not story_done() and time.monotonic() < deadline:
+            tick += 1
+            # 1. ingest: every live shard's spool, epoch-fenced
+            for h in hosts:
+                k = shard_of[h]
+                if k in memory.dead_shards:
+                    continue
+                frames += ingest.poll_shard(k, h)
+            # 2. "learn": sample + priority write-back once warm
+            if len(memory) >= args.learn_start and memory.sampleable:
+                step += 1
+                batch = memory.sample(16, beta=0.6)
+                memory.update_priorities(
+                    batch.idx, np.abs(rng.normal(size=len(batch.idx))) + 0.1
+                )
+                samples += 1
+                if revive_host in readmitted:
+                    lo = shard_of[revive_host] * memory.shard_capacity
+                    hi = lo + memory.shard_capacity
+                    if ((batch.idx >= lo) & (batch.idx < hi)).any():
+                        post_readmit_draw = True
+                if step % args.publish_every == 0:
+                    version += 1
+                    mailbox.publish(version, step=step)
+                    registry.gauge("weights_version", "soak").set(version)
+            # 3. lease edges -> degrade / heal
+            dead, alive = monitor.poll()
+            for lease in dead:
+                k = shard_of.get(lease.host)
+                metrics.log("fault", event="host_dead", dead_host=lease.host,
+                            epoch=lease.epoch, step=step, frames=frames)
+                if fenced_state.pop(lease.host, False):
+                    # the fence died with its incarnation; close the episode
+                    # so a kill mid-fence can't hold health degraded forever
+                    metrics.log("actor_fenced", action="resume",
+                                fenced_host=lease.host, lag=0,
+                                max_lag=args.max_weight_lag, step=step)
+                if k is not None and k not in memory.dead_shards:
+                    try:
+                        memory.drop_shard(k)
+                    except RuntimeError:
+                        pass  # never drop the last survivor
+            for lease in alive:
+                k = shard_of.get(lease.host)
+                metrics.log("host_alive", alive_host=lease.host,
+                            epoch=lease.epoch, step=step, frames=frames)
+                if k is None or k not in memory.dead_shards:
+                    continue
+                epoch = faults.retry_call(
+                    lambda: memory.readmit_shard(k, epoch=lease.epoch),
+                    faults.RetryPolicy(attempts=3, base_delay_s=0.01,
+                                       max_delay_s=0.05, seed=args.seed),
+                    retry_on=(OSError,),
+                    on_retry=lambda att, e: metrics.log(
+                        "fault", event="shard_rejoin_retry", attempt=att,
+                        shard=k, error=str(e)[:120]),
+                )
+                readmitted[lease.host] = epoch
+                metrics.log("shard_readmit", shard=k, epoch=epoch,
+                            step=step, frames=frames)
+            # 4. fence edges relayed off the leases: RunHealth holds the run
+            # degraded while any live actor is fenced, without the learner
+            # tailing actor-local JSONL
+            relay_fence_edges()
+            # 5. respawn supervision (emits actor_dead/respawn/evicted rows)
+            sup.poll(step=step)
+            # 6. periodic health
+            if tick % 25 == 0:
+                last_health = health.tick(
+                    step, frames, replay_size=len(memory),
+                    dead_shards=list(memory.dead_shards),
+                    fenced_writes=memory.fenced_writes,
+                )
+            time.sleep(args.tick_s)
+        # final settle: publishing has stopped, so a still-fenced slow
+        # adopter unfences within one adoption interval — wait for the live
+        # fences to clear (bounded), flush the window holding the last heal
+        # events (it may legitimately read degraded), then close one CLEAN
+        # window — a healed run must end ok, and a still-broken one must not
+        settle_deadline = time.monotonic() + 5.0
+        while relay_fence_edges() and time.monotonic() < settle_deadline:
+            time.sleep(args.tick_s)
+        health.tick(step, frames)
+        time.sleep(args.tick_s)
+        monitor.poll()
+        last_health = health.tick(
+            step + 1, frames, replay_size=len(memory),
+            dead_shards=list(memory.dead_shards),
+            fenced_writes=memory.fenced_writes,
+        )
+    finally:
+        sup.stop_all()
+        metrics.close()
+        faults.install(None)  # don't leak the soak's injector to callers
+
+    # ----------------------------------------------------- harness assertions
+    failures = []
+    if last_health.get("status") != "ok":
+        failures.append(f"final health is {last_health.get('status')!r}, "
+                        f"not 'ok' ({last_health})")
+    if frames < args.frames:
+        failures.append(f"only {frames}/{args.frames} frames ingested "
+                        "before the deadline")
+    if seeded:
+        if revive_host not in readmitted:
+            failures.append(f"host {revive_host} was never readmitted")
+        if not post_readmit_draw:
+            failures.append(
+                "no post-readmit sample drew from the revived shard")
+        if (poison_host is not None
+                and f"actor_h{poison_host}" not in sup.evicted()):
+            failures.append(f"host {poison_host} was not evicted")
+        if memory.fenced_writes <= 0:
+            failures.append("epoch fence never rejected a stale spool row")
+
+    # fence law, asserted from the actors' OWN rows: an actor may lag, but
+    # must never ACT past the budget
+    fence_rows = 0
+    for name in sorted(os.listdir(run_dir)):
+        if not (name.startswith("actor_h") and name.endswith(".jsonl")):
+            continue
+        for line in open(os.path.join(run_dir, name)):
+            try:
+                row = json.loads(line)
+            except ValueError:
+                failures.append(f"{name}: non-JSON actor row")
+                continue
+            if row.get("kind") == "actor" and row.get("acted"):
+                if int(row.get("lag", 0)) > args.max_weight_lag:
+                    failures.append(
+                        f"{name}: acted with lag {row['lag']} > "
+                        f"{args.max_weight_lag}")
+            if row.get("kind") == "actor_fenced":
+                fence_rows += 1
+    if seeded and fence_rows == 0:
+        failures.append("no actor_fenced row: the staleness fence never "
+                        "exercised")
+    if seeded and registry.counter("actor_fenced_total", "health").get() == 0:
+        failures.append("RunHealth never observed a fence episode (the "
+                        "lease-carried fence relay broke)")
+
+    # the run dir must lint against the obs schema (the three new row kinds
+    # included) — a soak that heals but emits unparseable telemetry failed
+    from scripts.lint_jsonl import lint_file  # noqa: E402
+
+    lint_errors = []
+    for name in sorted(os.listdir(run_dir)):
+        if name.endswith(".jsonl"):
+            lint_errors += lint_file(os.path.join(run_dir, name))
+    if lint_errors:
+        failures.append(f"lint errors: {lint_errors[:5]}")
+
+    summary = {
+        "ok": not failures,
+        "frames": frames,
+        "learn_steps": step,
+        "samples": samples,
+        "weights_version": version,
+        "readmitted": {str(h): e for h, e in readmitted.items()},
+        "evicted": sup.evicted(),
+        "fenced_writes": memory.fenced_writes,
+        "fence_rows": fence_rows,
+        "final_health": last_health.get("status"),
+        "failures": failures,
+    }
+    with open(os.path.join(run_dir, "soak_summary.json"), "w") as f:
+        json.dump(summary, f, indent=2)
+    out = json.dumps(summary, indent=2) if args.json else (
+        f"chaos_soak: {'OK' if summary['ok'] else 'FAILED'} "
+        f"frames={frames} readmitted={summary['readmitted']} "
+        f"evicted={summary['evicted']} fenced={memory.fenced_writes} "
+        f"health={summary['final_health']}"
+        + ("".join(f"\n  FAIL {f}" for f in failures))
+    )
+    print(out)
+    return 0 if summary["ok"] else 1
+
+
+def parse_args(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--frames", type=int, default=2000,
+                    help="min transitions ingested before the soak can end")
+    ap.add_argument("--kill-schedule", default="seeded",
+                    choices=["seeded", "none"])
+    ap.add_argument("--actors", type=int, default=3)
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--out", default="/tmp/ria_chaos_soak")
+    ap.add_argument("--json", action="store_true")
+    ap.add_argument("--quiet", action="store_true")
+    ap.add_argument("--deadline-s", type=float, default=90.0)
+    ap.add_argument("--learn-start", type=int, default=64)
+    ap.add_argument("--publish-every", type=int, default=5)
+    ap.add_argument("--max-weight-lag", type=int, default=2)
+    # respawn knobs default to the Config fields (the single source the
+    # docs/RESILIENCE.md table names); the backoff base is raised above the
+    # training default because of an ordering constraint: the lease must be
+    # declared dead (hb-timeout, polled every tick) BEFORE the respawned
+    # incarnation leases back in (respawn-base-s minus jitter, plus child
+    # start-up) — otherwise the drop/readmit pair never fires
+    from rainbow_iqn_apex_tpu.config import Config as _Config
+
+    _cfg = _Config()
+    ap.add_argument("--respawn-attempts", type=int,
+                    default=_cfg.respawn_attempts)
+    ap.add_argument("--respawn-base-s", type=float,
+                    default=max(_cfg.respawn_base_s, 1.0))
+    ap.add_argument("--hb-interval", type=float, default=0.05)
+    ap.add_argument("--hb-timeout", type=float, default=0.3)
+    ap.add_argument("--tick-s", type=float, default=0.01)
+    # internal: actor-child mode
+    ap.add_argument("--actor", action="store_true", help=argparse.SUPPRESS)
+    ap.add_argument("--dir", help=argparse.SUPPRESS)
+    ap.add_argument("--run-id", default="soak", help=argparse.SUPPRESS)
+    ap.add_argument("--host", type=int, default=1, help=argparse.SUPPRESS)
+    ap.add_argument("--shard", type=int, default=0, help=argparse.SUPPRESS)
+    ap.add_argument("--epoch", type=int, default=0, help=argparse.SUPPRESS)
+    ap.add_argument("--adopt-every", type=int, default=3,
+                    help=argparse.SUPPRESS)
+    ap.add_argument("--max-ticks", type=int, default=100000,
+                    help=argparse.SUPPRESS)
+    ap.add_argument("--poison", action="store_true", help=argparse.SUPPRESS)
+    return ap.parse_args(argv)
+
+
+def main(argv=None) -> int:
+    args = parse_args(argv)
+    if args.actor:
+        return actor_main(args)
+    return soak_main(args)
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

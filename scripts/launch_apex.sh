@@ -4,17 +4,51 @@
 # SURVEY.md par.2 row 10). The TPU-native launch is ONE command per host:
 # there is no external replay server to start, and learner + actors are a
 # single SPMD program over the host's slice.
+#
+# Elastic supervision (docs/RESILIENCE.md "heal"): the process-level
+# respawn half of parallel/elastic.py RoleSupervisor, in shell — a host
+# whose program dies is relaunched with exponential backoff under a bounded
+# budget, with `--resume auto` so the respawned incarnation restores the
+# newest valid checkpoint instead of starting cold.  Past the budget the
+# host is left down (permanent eviction); the surviving hosts' lease
+# monitor has long since dropped its shard and will readmit it on the next
+# successful relaunch (`host_alive` -> `shard_readmit`).  Disable with
+# RIA_RESPAWN_ATTEMPTS=0 for a scheduler that does its own restarts.
 set -euo pipefail
 
 GAME="${1:-Pong}"
 RUN_ID="${2:-apex_$(date +%s)}"
 
-exec python train_agent_apex.py \
-  --role apex \
-  --env-id "atari:${GAME}" \
-  --run-id "${RUN_ID}" \
-  --num-actors 4 --num-envs-per-actor 16 \
-  --replay-shards 2 \
-  --learner-devices 0 \
-  --t-max 200000000 \
-  "${@:3}"
+RESPAWN_ATTEMPTS="${RIA_RESPAWN_ATTEMPTS:-3}"
+BACKOFF_S="${RIA_RESPAWN_BASE_S:-5}"
+
+run_once() {
+  python train_agent_apex.py \
+    --role apex \
+    --env-id "atari:${GAME}" \
+    --run-id "${RUN_ID}" \
+    --num-actors 4 --num-envs-per-actor 16 \
+    --replay-shards 2 \
+    --learner-devices 0 \
+    --t-max 200000000 \
+    --resume auto \
+    "${@}"
+}
+
+if [[ "${RESPAWN_ATTEMPTS}" == "0" ]]; then
+  run_once "${@:3}"
+  exit $?
+fi
+
+attempt=0
+until run_once "${@:3}"; do
+  rc=$?
+  attempt=$((attempt + 1))
+  if (( attempt > RESPAWN_ATTEMPTS )); then
+    echo "launch_apex: rc=${rc}; respawn budget (${RESPAWN_ATTEMPTS}) exhausted — evicting this host" >&2
+    exit "${rc}"
+  fi
+  delay=$(( BACKOFF_S * (1 << (attempt - 1)) ))
+  echo "launch_apex: rc=${rc}; respawn ${attempt}/${RESPAWN_ATTEMPTS} in ${delay}s (--resume auto)" >&2
+  sleep "${delay}"
+done

@@ -14,6 +14,10 @@ obs/schema.py).
 Importable: ``lint_line(line) -> Optional[str]`` and
 ``lint_file(path) -> List[str]`` are what the test suite and obs_report use.
 Exit codes: 0 = clean, 1 = any error (each printed as ``path:line: why``).
+
+The validated kind set includes the elasticity rows (``host_alive``,
+``shard_readmit``, ``actor_fenced`` — obs/schema.py REQUIRED_KEYS), so a
+chaos-soak run dir lints as strictly as a training run dir.
 """
 
 from __future__ import annotations

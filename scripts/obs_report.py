@@ -165,6 +165,20 @@ def aggregate(rows: List[Dict[str, Any]]) -> Dict[str, Any]:
         "compiles": last_timing.get("compiles"),
         "spans": span_stats,
         "faults": fault_counts,
+        # elasticity (docs/RESILIENCE.md "heal"): the detect->heal story in
+        # counts — deaths vs revivals/readmits, fence episodes, respawns,
+        # permanent evictions
+        "elastic": {
+            "host_dead": fault_counts.get("host_dead", 0),
+            "host_alive": len(by_kind.get("host_alive", [])),
+            "shard_readmits": len(by_kind.get("shard_readmit", [])),
+            "fence_episodes": sum(
+                1 for r in by_kind.get("actor_fenced", [])
+                if r.get("action") != "resume"
+            ),
+            "respawns": fault_counts.get("actor_respawn", 0),
+            "evictions": fault_counts.get("actor_evicted", 0),
+        },
         "shed_total": shed_total,
         "final_eval": {
             k: v for k, v in last_eval.items()
@@ -175,6 +189,7 @@ def aggregate(rows: List[Dict[str, Any]]) -> Dict[str, Any]:
             "worst_status": worst,
             "rows": len(health),
             "hosts_dead": last_health.get("hosts_dead", []),
+            "hosts_evicted": last_health.get("hosts_evicted", []),
         },
     }
     return report
@@ -205,11 +220,19 @@ def render(report: Dict[str, Any]) -> str:
     for name, snap in sorted((report["spans"] or {}).items()):
         lines.append(f"span {name}: {snap}")
     lines.append(f"faults: {report['faults'] or 'none'}")
+    e = report["elastic"]
+    if any(e.values()):
+        lines.append(
+            f"elastic: host_dead={e['host_dead']} host_alive={e['host_alive']} "
+            f"readmits={e['shard_readmits']} fences={e['fence_episodes']} "
+            f"respawns={e['respawns']} evictions={e['evictions']}"
+        )
     lines.append(f"final_eval: {report['final_eval'] or 'none'}")
     h = report["health"]
     lines.append(
         f"health: last={h['last_status']} worst={h['worst_status']} "
-        f"rows={h['rows']} hosts_dead={h['hosts_dead']}"
+        f"rows={h['rows']} hosts_dead={h['hosts_dead']} "
+        f"hosts_evicted={h['hosts_evicted']}"
     )
     return "\n".join(lines)
 

@@ -209,6 +209,10 @@ def health_attribution(metrics_glob) -> dict:
     import glob as _glob
 
     counts = {"ok": 0, "degraded": 0, "failing": 0}
+    # elasticity rows (docs/RESILIENCE.md "heal"): a soak window that went
+    # degraded AND healed reads very differently from one that stayed
+    # degraded — the heal tallies carry that distinction into phase_done
+    heals = {"host_alive": 0, "shard_readmit": 0, "actor_fenced": 0}
     last = None
     for path in sorted(_glob.glob(metrics_glob)):
         try:
@@ -218,18 +222,21 @@ def health_attribution(metrics_glob) -> dict:
                         row = json.loads(line)
                     except ValueError:
                         continue  # lint_jsonl's job, not attribution's
-                    if row.get("kind") == "health":
+                    kind = row.get("kind")
+                    if kind == "health":
                         status = row.get("status")
                         if status in counts:
                             counts[status] += 1
                             last = status
+                    elif kind in heals:
+                        heals[kind] += 1
         except OSError:
             continue
     order = {"ok": 0, "degraded": 1, "failing": 2}
     worst = max((s for s, n in counts.items() if n),
                 key=lambda s: order[s], default=None)
     return {"rows": sum(counts.values()), "counts": counts,
-            "last": last, "worst": worst}
+            "last": last, "worst": worst, "heals": heals}
 
 
 def classify_phase(rc: int, tail: str) -> str:

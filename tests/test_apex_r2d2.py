@@ -124,11 +124,27 @@ def test_apex_r2d2_short_run_with_device_stack(tmp_path):
         eval_episodes=2,
         results_dir=str(tmp_path / "results"),
         checkpoint_dir=str(tmp_path / "ckpt"),
+        # elastic surface (PR 4): the recurrent loop must carry the same
+        # lease + staleness-fence wiring as train_apex
+        heartbeat_interval_s=0.2,
+        max_weight_lag=4,
     )
     summary = train_apex_r2d2(cfg, max_frames=1_000)
     assert summary["frames"] == 1_000
     assert summary["learn_steps"] > 0
     assert np.isfinite(summary["eval_score_mean"])
+    import json
+    import os
+
+    lease_path = os.path.join(
+        cfg.results_dir, cfg.run_id, "heartbeats", "h0.json")
+    lease = json.load(open(lease_path))
+    assert lease["role"] == "apex_r2d2" and lease["epoch"] == 0
+    assert lease["weight_version"] >= 1
+    rows = [json.loads(line) for line in open(os.path.join(
+        cfg.results_dir, cfg.run_id, "metrics.jsonl"))]
+    learn_rows = [r for r in rows if r["kind"] == "health"]
+    assert any("weight_version_lag" in r for r in learn_rows)
 
 
 @pytest.mark.slow

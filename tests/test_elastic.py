@@ -1,0 +1,442 @@
+"""Elastic fleet layer (parallel/elastic.py + ShardedReplay readmission):
+role leases expire and renew, dropped shards readmit with epoch fencing and
+deterministic sampling, the staleness fence pauses/resumes an actor lane,
+and the RoleSupervisor's FailureBudget evicts a crash-looping role after a
+bounded respawn count.  The `chaos`-marked soak at the bottom drives the
+whole detect -> degrade -> heal loop through scripts/chaos_soak.py with
+REAL child processes (docs/RESILIENCE.md "heal"; `make soak-smoke` runs the
+same harness at the full budget).
+
+Everything here is jax-free and fast; it is part of tier-1.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+from rainbow_iqn_apex_tpu.parallel.elastic import (
+    HeartbeatMonitor,
+    HeartbeatWriter,
+    RoleSupervisor,
+    StalenessFence,
+    WeightMailbox,
+)
+from rainbow_iqn_apex_tpu.parallel.sharded_replay import ShardedReplay
+from rainbow_iqn_apex_tpu.utils import faults
+from rainbow_iqn_apex_tpu.utils.logging import MetricsLogger
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _mem(seed=1, shards=2, lanes=4):
+    return ShardedReplay.build(
+        shards, 256 * shards, lanes, frame_shape=(12, 12), history=2,
+        n_step=3, gamma=0.9, seed=seed,
+    )
+
+
+def _fill(mem, rows=40, lanes=4, seed=0):
+    rng = np.random.default_rng(seed)
+    for _ in range(rows):
+        mem.append_batch(
+            rng.integers(0, 255, (lanes, 12, 12), dtype=np.uint8),
+            rng.integers(0, 4, lanes).astype(np.int32),
+            rng.normal(size=lanes).astype(np.float32),
+            rng.random(lanes) < 0.05,
+        )
+
+
+# ------------------------------------------------------------------- leases
+def test_lease_expiry_and_renewal(tmp_path):
+    """A renewing lease stays fresh; a stopped one expires once; renewing at
+    a bumped epoch fires the alive edge and re-arms the death edge."""
+    hb = str(tmp_path / "hb")
+    writer = HeartbeatWriter(hb, 1, 0.03, injector=faults.FaultInjector(""),
+                             role="actor", shard=0, epoch=0).start()
+    monitor = HeartbeatMonitor(hb, timeout_s=0.25)
+    time.sleep(0.3)  # several renewal intervals: stays fresh throughout
+    assert monitor.poll() == ([], [])
+    writer.stop()
+    time.sleep(0.35)  # past the timeout with no renewals
+    dead, alive = monitor.poll()
+    assert [lease.host for lease in dead] == [1] and alive == []
+    assert monitor.poll() == ([], [])  # edge, not level
+    # the next incarnation renews at epoch 1
+    HeartbeatWriter(hb, 1, 0.03, injector=faults.FaultInjector(""),
+                    role="actor", shard=0, epoch=1).beat()
+    dead, alive = monitor.poll()
+    assert dead == [] and [(x.host, x.epoch) for x in alive] == [(1, 1)]
+
+
+def test_lease_lost_fault_point_suppresses_renewals(tmp_path):
+    writer = HeartbeatWriter(str(tmp_path / "hb"), 0, 0.05,
+                             injector=faults.FaultInjector("lease_lost@2"))
+    writer.beat()
+    writer.beat()  # suppressed: the process lives, the lease does not
+    writer.beat()
+    assert writer.beats == 2 and writer.suppressed == 1
+
+
+# ------------------------------------------------------- drop -> readmit
+def test_drop_readmit_round_trip_deterministic_sampling():
+    """Two replicas with the same seed driven through the same
+    drop -> readmit transition draw identical sample streams, and the
+    readmitted shard is drawn from again after the transition."""
+    streams = []
+    for _ in range(2):
+        mem = _mem(seed=3)
+        _fill(mem, seed=5)
+        idx = [mem.sample(16, 0.6).idx.copy() for _ in range(3)]
+        mem.drop_shard(0)
+        idx += [mem.sample(16, 0.6).idx.copy() for _ in range(3)]
+        mem.readmit_shard(0)
+        idx += [mem.sample(16, 0.6).idx.copy() for _ in range(3)]
+        streams.append(np.concatenate(idx))
+    np.testing.assert_array_equal(streams[0], streams[1])
+    mem = _mem(seed=3)
+    _fill(mem, seed=5)
+    full = len(mem)
+    mem.drop_shard(0)
+    assert len(mem) == full // 2
+    s = mem.sample(32, 0.6)
+    assert (s.idx >= mem.shard_capacity).all()  # survivors only
+    assert mem.readmit_shard(0) == 1
+    assert len(mem) == full  # snapshot-restored contents count again
+    drawn = np.concatenate([mem.sample(32, 0.6).idx for _ in range(4)])
+    assert (drawn < mem.shard_capacity).any()  # the healed shard is drawn
+
+
+def test_readmit_reseeds_priority_from_survivors():
+    """A cold readmitted shard must not be starved: its default append
+    priority is re-seeded from the surviving shards' max."""
+    mem = _mem(seed=4)
+    _fill(mem, seed=6)
+    mem.shards[1].max_priority = 50.0  # the survivor saw big TD errors
+    mem.drop_shard(0)
+    assert mem.shards[0].max_priority < 50.0
+    mem.readmit_shard(0)
+    assert mem.shards[0].max_priority == 50.0
+
+
+def test_epoch_fencing_rejects_stale_writer():
+    """Appends and priority write-backs from a pre-eviction incarnation are
+    dropped; the readmitted epoch's writes land."""
+    mem = _mem(seed=7)
+    _fill(mem, seed=8)
+    rng = np.random.default_rng(0)
+    lanes = mem.lanes_per_shard
+    row = lambda: (  # noqa: E731
+        rng.integers(0, 255, (lanes, 12, 12), dtype=np.uint8),
+        rng.integers(0, 4, lanes).astype(np.int32),
+        rng.normal(size=lanes).astype(np.float32),
+        rng.random(lanes) < 0.05,
+    )
+    assert mem.shard_epoch(0) == 0
+    assert mem.append_shard(0, *row(), epoch=0)  # current epoch: lands
+    mem.drop_shard(0)
+    assert not mem.append_shard(0, *row(), epoch=0)  # dead: dropped
+    mem.readmit_shard(0, epoch=2)
+    assert mem.shard_epoch(0) == 2
+    before = mem.fenced_writes
+    assert not mem.append_shard(0, *row(), epoch=0)  # stale incarnation
+    assert not mem.update_shard_priorities(
+        0, np.array([0]), np.array([1.0]), epoch=0)
+    assert mem.fenced_writes == before + 2
+    assert mem.append_shard(0, *row(), epoch=2)  # the readmitted epoch
+    assert mem.update_shard_priorities(0, np.array([0]), np.array([1.0]),
+                                       epoch=2)
+    # an unstamped caller (legacy lockstep path) is not fenced
+    assert mem.append_shard(0, *row())
+
+
+def test_readmit_validations():
+    mem = _mem(seed=9)
+    _fill(mem, seed=9)
+    with pytest.raises(ValueError):
+        mem.readmit_shard(0)  # not dead
+    mem.drop_shard(1)
+    mem.readmit_shard(1, epoch=3)
+    mem.drop_shard(1)
+    with pytest.raises(ValueError):
+        mem.readmit_shard(1, epoch=2)  # older than the fenced epoch
+    assert mem.readmit_shard(1, epoch=3) == 3  # same incarnation: legal
+
+
+def test_shard_rejoin_fault_point_fails_once_then_retry_succeeds():
+    mem = _mem(seed=11)
+    _fill(mem, seed=11)
+    mem.drop_shard(0)
+    faults.install(faults.FaultInjector("shard_rejoin@1", seed=0))
+    try:
+        with pytest.raises(OSError):
+            mem.readmit_shard(0)
+        assert 0 in mem.dead_shards  # the failed rejoin left it dropped
+        epoch = faults.retry_call(
+            lambda: mem.readmit_shard(0),
+            faults.RetryPolicy(attempts=3, base_delay_s=0.0, max_delay_s=0.0),
+            retry_on=(OSError,),
+        )
+        assert epoch == 1 and 0 not in mem.dead_shards
+    finally:
+        faults.install(None)
+
+
+# ------------------------------------------------------------ staleness fence
+def test_staleness_fence_pauses_and_resumes_actor_lane(tmp_path):
+    path = str(tmp_path / "actor.jsonl")
+    logger = MetricsLogger(path, "run0", echo=False, host=3)
+    fence = StalenessFence(2, metrics=logger)
+    assert fence.observe(5, 5)  # in sync
+    assert fence.observe(3, 5)  # lag 2 == budget: still acting
+    assert not fence.observe(2, 5, frames_at_stake=16)  # lag 3: fenced
+    assert not fence.observe(2, 6, frames_at_stake=16)  # still fenced
+    assert fence.shed_frames == 32 and fence.fences == 1
+    assert fence.observe(6, 6)  # caught up: resumes
+    assert not fence.observe(0, 9, frames_at_stake=16)  # a second episode
+    assert fence.fences == 2
+    logger.close()
+    rows = [json.loads(line) for line in open(path)]
+    fence_rows = [r for r in rows if r["kind"] == "actor_fenced"]
+    # one row per edge: fence, resume, fence — not one per refused tick
+    assert [r["action"] for r in fence_rows] == ["fence", "resume", "fence"]
+    assert fence_rows[0]["lag"] == 3 and fence_rows[0]["max_lag"] == 2
+
+
+def test_staleness_fence_disabled_keeps_gauge_only():
+    from rainbow_iqn_apex_tpu.obs.registry import MetricRegistry
+
+    reg = MetricRegistry()
+    fence = StalenessFence(0, registry=reg)
+    assert fence.observe(0, 100)  # never fences when disabled
+    assert reg.gauge("weight_version_lag", "actor").get() == 100
+
+
+def test_weight_mailbox_round_trip(tmp_path):
+    mb = WeightMailbox(str(tmp_path / "w" / "weights.json"))
+    assert mb.version() == -1 and mb.read() is None
+    mb.publish(3, step=1200)
+    row = mb.read()
+    assert mb.version() == 3 and row["step"] == 1200 and "ts" in row
+
+
+# ------------------------------------------------------- respawn supervision
+def _spawn_cmd(argv):
+    def spawn(epoch):
+        return subprocess.Popen(argv, stdout=subprocess.DEVNULL,
+                                stderr=subprocess.DEVNULL)
+
+    return spawn
+
+
+def test_role_supervisor_failure_budget_exhausts_after_n_respawns(tmp_path):
+    """A crash-looping role is respawned exactly cfg.respawn_attempts times
+    with the shared backoff, then permanently evicted with an actor_evicted
+    row (the budget poisons on failure N+1 — the knob counts RESTARTS, as
+    docs/RESILIENCE.md and launch_apex.sh's shell mirror do); a healthy
+    role is untouched."""
+    from rainbow_iqn_apex_tpu.config import Config
+
+    path = str(tmp_path / "sup.jsonl")
+    logger = MetricsLogger(path, "run0", echo=False)
+    sup = RoleSupervisor.from_config(
+        Config(respawn_attempts=2, respawn_base_s=0.02, respawn_max_s=0.05,
+               seed=3),
+        metrics=logger,
+    )
+    sup.register("crashy", _spawn_cmd([sys.executable, "-c",
+                                       "import sys; sys.exit(1)"]),
+                 meta={"role_host": 7})
+    sup.register("healthy", _spawn_cmd([sys.executable, "-c",
+                                        "import time; time.sleep(30)"]))
+    deadline = time.monotonic() + 20
+    while sup.state("crashy") != "evicted" and time.monotonic() < deadline:
+        sup.poll(step=1)
+        time.sleep(0.02)
+    assert sup.state("crashy") == "evicted"
+    assert sup.evicted() == ["crashy"]
+    assert sup.epoch("crashy") == 2  # initial + 2 respawns, then the budget
+    assert sup.state("healthy") == "running"
+    sup.stop_all()
+    logger.close()
+    events = [json.loads(line) for line in open(path)]
+    seq = [e["event"] for e in events if e.get("role") == "crashy"]
+    assert seq == ["actor_dead", "actor_respawn", "actor_dead",
+                   "actor_respawn", "actor_evicted"]
+    evicted = events[-1]
+    assert evicted["event"] == "actor_evicted"
+    assert evicted["role_host"] == 7 and evicted["failures"] == 3
+
+
+def test_new_fault_points_parse_and_count():
+    inj = faults.FaultInjector("actor_exit@2,lease_lost:0.0,shard_rejoin")
+    assert not inj.fire("actor_exit") and inj.fire("actor_exit")
+    assert inj.fire("shard_rejoin")  # bare point: always
+    assert not inj.fire("lease_lost")  # p=0: never
+    assert inj.fired("actor_exit") == 1 and inj.calls("actor_exit") == 2
+
+
+# --------------------------------------------------------------- chaos soak
+@pytest.mark.chaos
+def test_chaos_soak_kill_revive_schedule_heals(tmp_path):
+    """The acceptance run, scaled down: 2 actor hosts killed, 1 revived
+    (respawn -> lease rejoin -> shard readmit), the other evicted after its
+    FailureBudget, stale-epoch spool rows fenced, no actor acting past
+    max_weight_lag, final health ok — all asserted by the harness itself
+    from the run's JSONL, then re-checked here from its summary."""
+    sys.path.insert(0, os.path.join(_REPO, "scripts"))
+    import chaos_soak
+
+    out = str(tmp_path / "soak")
+    rc = chaos_soak.main([
+        "--frames", "600", "--kill-schedule", "seeded", "--seed", "13",
+        "--out", out, "--quiet", "--deadline-s", "75",
+    ])
+    summary = json.load(open(os.path.join(
+        out, "results", "soak_13", "soak_summary.json")))
+    assert rc == 0, summary["failures"]
+    assert summary["final_health"] == "ok"
+    assert summary["readmitted"] == {"1": 1}
+    assert summary["evicted"] == ["actor_h2"]
+    assert summary["fenced_writes"] > 0
+    assert summary["fence_rows"] > 0
+    assert summary["frames"] >= 600
+
+
+def test_next_lease_epoch_bumps_per_process_start(tmp_path):
+    """Every (re)start of a self-managed host claims a fresh incarnation
+    epoch, so a crash-looping relaunch is a NEW death to the monitor's
+    once-per-epoch dedupe, not a suppressed repeat."""
+    from rainbow_iqn_apex_tpu.parallel.elastic import next_lease_epoch
+
+    hb = str(tmp_path / "hb")
+    assert next_lease_epoch(hb, 1) == 0
+    assert next_lease_epoch(hb, 1) == 1
+    assert next_lease_epoch(hb, 1) == 2
+    assert next_lease_epoch(hb, 2) == 0  # per-host counters
+
+
+def test_role_supervisor_from_config_uses_respawn_knobs():
+    from rainbow_iqn_apex_tpu.config import Config
+
+    cfg = Config(respawn_attempts=5, respawn_base_s=0.5, respawn_max_s=2.0,
+                 seed=9)
+    sup = RoleSupervisor.from_config(cfg)
+    # 5 RESTARTS before eviction = the budget poisons on the 6th failure
+    assert sup.budget.max_failures == 6
+    assert sup.backoff.attempts == 6  # backoff schedule covers all 5 respawns
+    assert sup.backoff.base_delay_s == 0.5
+    assert sup.backoff.max_delay_s == 2.0
+    assert sup.backoff.seed == 9
+
+
+def test_monitor_defers_alive_edge_on_unreadable_payload(tmp_path):
+    """The alive edge's epoch is load-bearing (readmission fences on it): a
+    fresh lease whose JSON cannot be read yet must NOT fire host_alive with
+    a defaulted epoch 0 — the edge waits for the next poll, when the
+    actively-renewing writer has landed a readable payload."""
+    import time as _time
+
+    hb = tmp_path / "hb"
+    hb.mkdir()
+    path = str(hb / "h1.json")
+    with open(path, "w") as f:
+        json.dump({"process_id": 1, "epoch": 0}, f)
+    old = _time.time() - 5
+    os.utime(path, (old, old))
+    monitor = HeartbeatMonitor(str(hb), timeout_s=0.5)
+    assert monitor.newly_dead() == [1]
+    with open(path, "w") as f:
+        f.write("{torn json")  # fresh mtime, unreadable payload
+    assert monitor.poll() == ([], [])  # deferred, NOT host_alive@epoch=0
+    with open(path, "w") as f:
+        json.dump({"process_id": 1, "epoch": 2}, f)
+    dead, alive = monitor.poll()
+    assert dead == [] and [(x.host, x.epoch) for x in alive] == [(1, 2)]
+
+
+def test_sampleable_with_one_cold_alive_shard():
+    """A cold (empty) alive shard — the state a just-readmitted host is in
+    — must not gate the aggregate: sample() hands zero-mass shards a zero
+    multinomial count, so any shard with mass makes the learner runnable."""
+    mem = _mem(seed=21)
+    rng = np.random.default_rng(2)
+    lanes = mem.lanes_per_shard
+    for _ in range(40):  # only shard 1 receives data; shard 0 stays cold
+        mem.append_shard(
+            1,
+            rng.integers(0, 255, (lanes, 12, 12), dtype=np.uint8),
+            rng.integers(0, 4, lanes).astype(np.int32),
+            rng.normal(size=lanes).astype(np.float32),
+            rng.random(lanes) < 0.05,
+        )
+    assert not mem.shards[0].sampleable and mem.shards[1].sampleable
+    assert mem.sampleable  # the cold shard does not halt the learner
+    s = mem.sample(16, 0.6)
+    assert (s.idx >= mem.shard_capacity).all()  # all rows from the warm shard
+
+
+def test_lease_carries_fence_state(tmp_path):
+    """An actor's staleness-fence state rides in its lease payload, so the
+    learner-side controller can fold it into RunHealth without tailing the
+    actor's local JSONL."""
+    hb = str(tmp_path / "hb")
+    writer = HeartbeatWriter(hb, 4, 0.05, injector=faults.FaultInjector(""),
+                             role="actor", shard=3, epoch=0)
+    writer.payload["fenced"] = True
+    writer.beat()
+    monitor = HeartbeatMonitor(hb, timeout_s=5.0)
+    assert monitor.leases()[4].fenced
+    writer.payload["fenced"] = False
+    writer.beat()
+    assert not monitor.leases()[4].fenced
+
+
+def test_role_supervisor_healthy_uptime_clears_strikes():
+    """The FailureBudget bounds CONSECUTIVE crash loops, not lifetime
+    preemptions: an incarnation that survives healthy_uptime_s clears its
+    role's strikes, so a host preempted occasionally over a long run is
+    never evicted."""
+
+    class P:
+        def __init__(self, rcs):
+            self.rcs = list(rcs)
+
+        def poll(self):
+            return self.rcs.pop(0) if self.rcs else None
+
+        def kill(self):
+            pass
+
+    t = [0.0]
+    sup = RoleSupervisor(
+        faults.RetryPolicy(attempts=3, base_delay_s=0.1, max_delay_s=0.1,
+                           seed=1),
+        budget=faults.FailureBudget(2),
+        clock=lambda: t[0],
+        healthy_uptime_s=10.0,
+    )
+    # each respawned incarnation lives long before dying (a daily preempt)
+    sup.register("host", lambda epoch: P([None] * 3 + [1]), proc=P([1]))
+    for _ in range(40):
+        sup.poll()
+        t[0] += 5.0  # every incarnation runs 15s >> healthy_uptime_s
+    assert sup.state("host") == "running"  # never evicted
+    assert sup.budget.failures("host") <= 1
+    # a genuine crash loop (instant deaths) still exhausts the budget
+    sup2 = RoleSupervisor(
+        faults.RetryPolicy(attempts=3, base_delay_s=0.1, max_delay_s=0.1,
+                           seed=1),
+        budget=faults.FailureBudget(2),
+        clock=lambda: t[0],
+        healthy_uptime_s=10.0,
+    )
+    sup2.register("host", lambda epoch: P([1]), proc=P([1]))
+    for _ in range(10):
+        sup2.poll()
+        t[0] += 1.0  # deaths 1s apart: never healthy long enough
+    assert sup2.state("host") == "evicted"

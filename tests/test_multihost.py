@@ -198,3 +198,73 @@ def test_two_process_r2d2_train_end_to_end(tmp_path):
     assert summary["learn_steps"] > 0
     assert summary["lanes"] == 8
     assert np.isfinite(summary["eval_score_mean"])
+
+
+# ---------------------------------------------------- lease-monitor edges
+# (PR 4 bugfix satellite; fast — no child processes, pure file logic)
+def _stale_write(path, payload, age_s=5.0):
+    import time as _time
+
+    with open(path, "w") as f:
+        json.dump(payload, f)
+    old = _time.time() - age_s
+    os.utime(path, (old, old))
+
+
+def test_monitor_does_not_refire_host_dead_after_file_gap(tmp_path):
+    """Regression: the monitor used to forget a reported host the moment its
+    file became unobservable (eviction cleanup, a torn read racing a
+    rename), so a lingering stale file re-emitted host_dead on every poll
+    after such a gap.  Dead reports must persist until the host is observed
+    ALIVE — once per lease epoch, not once per filesystem glitch."""
+    from rainbow_iqn_apex_tpu.parallel.elastic import HeartbeatMonitor
+
+    hb = tmp_path / "hb"
+    hb.mkdir()
+    path = str(hb / "h1.json")
+    _stale_write(path, {"process_id": 1, "epoch": 0})
+    monitor = HeartbeatMonitor(str(hb), timeout_s=0.5)
+    assert monitor.newly_dead() == [1]
+    assert monitor.newly_dead() == []  # steady stale: edge fired once
+    os.remove(path)  # eviction cleanup: the file vanishes...
+    assert monitor.newly_dead() == []
+    # ...and a lingering stale copy of the SAME epoch reappears (NFS cache,
+    # a laggard flush from the dead incarnation).  The old code refired
+    # host_dead here on every poll cycle.
+    _stale_write(path, {"process_id": 1, "epoch": 0})
+    assert monitor.newly_dead() == []
+    assert monitor.newly_dead() == []
+    # a NEW incarnation that died before ever beating fresh IS a new death
+    _stale_write(path, {"process_id": 1, "epoch": 1})
+    assert monitor.newly_dead() == [1]
+    assert monitor.newly_dead() == []
+
+
+def test_monitor_reports_host_alive_edge_with_lease_payload(tmp_path):
+    """A recovered host is detected, not just a dead one: a fresh beat from
+    a reported-dead host fires host_alive exactly once, carrying the lease
+    payload (role/shard/epoch/weight_version) the readmission path needs."""
+    from rainbow_iqn_apex_tpu.parallel.elastic import (
+        HeartbeatMonitor,
+        HeartbeatWriter,
+    )
+    from rainbow_iqn_apex_tpu.utils import faults
+
+    hb = tmp_path / "hb"
+    hb.mkdir()
+    _stale_write(str(hb / "h2.json"), {"process_id": 2, "epoch": 0})
+    monitor = HeartbeatMonitor(str(hb), timeout_s=0.5)
+    dead, alive = monitor.poll()
+    assert [lease.host for lease in dead] == [2] and alive == []
+    # the respawned incarnation leases back in at epoch 1
+    writer = HeartbeatWriter(str(hb), 2, 0.05,
+                             injector=faults.FaultInjector(""),
+                             role="actor", shard=1, epoch=1)
+    writer.set_weight_version(7)
+    writer.beat()
+    dead, alive = monitor.poll()
+    assert dead == [] and len(alive) == 1
+    lease = alive[0]
+    assert (lease.host, lease.epoch, lease.role, lease.shard,
+            lease.weight_version) == (2, 1, "actor", 1, 7)
+    assert monitor.poll() == ([], [])  # alive edge fired once

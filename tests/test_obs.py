@@ -471,3 +471,98 @@ def test_relay_watch_health_attribution(tmp_path):
     assert attr["last"] == "degraded" and attr["worst"] == "degraded"
     empty = mod.health_attribution(str(tmp_path / "nope" / "*.jsonl"))
     assert empty["rows"] == 0 and empty["worst"] is None
+
+
+# ------------------------------------------------- elasticity rows (PR 4)
+# host_alive / shard_readmit / actor_fenced: the heal half of the fault
+# story — schema'd, health-folded, and lintable like every other kind.
+
+
+def test_elastic_row_kinds_schema_and_lint(tmp_path):
+    """The three elasticity kinds validate with their required keys, reject
+    rows missing them, and pass the strict-JSON linter end to end."""
+    path = str(tmp_path / "elastic.jsonl")
+    logger = MetricsLogger(path, "run0", echo=False, host=0)
+    logger.log("host_alive", alive_host=1, epoch=2, step=10, frames=100)
+    logger.log("shard_readmit", shard=0, epoch=2, step=10, frames=100)
+    logger.log("actor_fenced", action="fence", lag=3, max_lag=2, step=10)
+    logger.log("actor_fenced", action="resume", lag=0, max_lag=2, step=12)
+    logger.close()
+    assert lint_file(path) == []
+    for line in open(path):
+        assert validate_row(json.loads(line)) == []
+    # required keys are enforced, not decorative
+    assert validate_row({"kind": "host_alive", "schema": SCHEMA_VERSION,
+                         "ts": 1.0, "host": 0, "run": "r"}) != []
+    assert validate_row({"kind": "shard_readmit", "schema": SCHEMA_VERSION,
+                         "ts": 1.0, "host": 0, "run": "r", "shard": 1}) != []
+    assert validate_row({"kind": "actor_fenced", "schema": SCHEMA_VERSION,
+                         "ts": 1.0, "host": 0, "run": "r", "lag": 1}) != []
+
+
+def test_health_heals_on_host_alive_and_eviction():
+    """The heal edges close the degradation they opened: host_alive removes
+    the host from the dead set, and a permanent eviction stops holding the
+    run degraded (a deliberately resized fleet is healthy at its new size)
+    while staying on the books as evicted."""
+    h = RunHealth(MetricRegistry(), max_nan_strikes=3)
+    h.observe_row({"kind": "fault", "event": "host_dead", "dead_host": 1})
+    h.observe_row({"kind": "fault", "event": "host_dead", "dead_host": 2})
+    row = h.tick(5)
+    assert row["status"] == "degraded" and row["hosts_dead"] == [1, 2]
+    # host 1 revives; its shard is readmitted
+    h.observe_row({"kind": "host_alive", "alive_host": 1, "epoch": 1})
+    h.observe_row({"kind": "shard_readmit", "shard": 0, "epoch": 1})
+    row = h.tick(10)
+    assert row["hosts_dead"] == [2] and row["readmits"] == 1
+    assert row["status"] == "degraded"  # host 2 still dead
+    # host 2 is permanently evicted: degraded no longer, but visible
+    h.observe_row({"kind": "fault", "event": "actor_evicted", "role_host": 2})
+    assert h.tick(15)["status"] == "degraded"  # the eviction's own window
+    row = h.tick(20)
+    assert row["status"] == "ok"
+    assert row["hosts_dead"] == [] and row["hosts_evicted"] == [2]
+
+
+def test_health_fenced_actor_holds_degraded_until_resume():
+    h = RunHealth(MetricRegistry(), max_nan_strikes=3)
+    h.observe_row({"kind": "actor_fenced", "action": "fence", "host": 3,
+                   "lag": 4, "max_lag": 2})
+    assert h.tick(5)["status"] == "degraded"
+    row = h.tick(10)  # still fenced: no clean window until it resumes
+    assert row["status"] == "degraded" and row["hosts_fenced"] == [3]
+    h.observe_row({"kind": "actor_fenced", "action": "resume", "host": 3,
+                   "lag": 0, "max_lag": 2})
+    h.tick(15)  # the resume edge's window
+    assert h.tick(20)["status"] == "ok"
+
+
+def test_relay_watch_health_attribution_counts_heals(tmp_path):
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location(
+        "relay_watch_for_elastic",
+        os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+                     "scripts", "relay_watch.py"))
+    mod = importlib.util.module_from_spec(spec)
+    saved_argv = sys.argv
+    sys.argv = ["relay_watch.py"]
+    try:
+        spec.loader.exec_module(mod)
+    finally:
+        sys.argv = saved_argv
+    run = tmp_path / "runs" / "r0"
+    run.mkdir(parents=True)
+    with open(run / "metrics.jsonl", "w") as f:
+        f.write(json.dumps({"kind": "health", "status": "degraded"}) + "\n")
+        f.write(json.dumps({"kind": "host_alive", "alive_host": 1,
+                            "epoch": 1}) + "\n")
+        f.write(json.dumps({"kind": "shard_readmit", "shard": 0,
+                            "epoch": 1}) + "\n")
+        f.write(json.dumps({"kind": "actor_fenced", "action": "fence",
+                            "lag": 3, "max_lag": 2}) + "\n")
+        f.write(json.dumps({"kind": "health", "status": "ok"}) + "\n")
+    attr = mod.health_attribution(str(tmp_path / "runs" / "*" / "metrics.jsonl"))
+    assert attr["rows"] == 2 and attr["last"] == "ok"
+    assert attr["heals"] == {"host_alive": 1, "shard_readmit": 1,
+                             "actor_fenced": 1}

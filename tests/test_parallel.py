@@ -342,3 +342,22 @@ def test_apex_end_to_end_short(tmp_path):
     assert summary["learn_steps"] > 0
     assert summary["lanes"] == 8
     assert np.isfinite(summary["eval_score_mean"])
+
+
+def test_weights_version_monotone_across_publish_and_resume(driver):
+    """publish_weights stamps a monotone version, and load_state resumes
+    the counter from checkpoint extra — a restarted learner must publish
+    ABOVE the versions out-of-process actors already hold, or the elastic
+    staleness fence's lag arithmetic fails open in the restart window."""
+    import jax
+
+    v0 = driver.weights_version
+    assert driver.publish_weights() == v0 + 1
+    assert driver.actor_weights_version == v0 + 1
+    # a fresh-process restart restoring a checkpoint stamped far ahead
+    state = jax.tree.map(np.asarray, driver.state)
+    driver.load_state(state, {"weights_version": v0 + 500})
+    assert driver.weights_version == v0 + 501  # resumed, then republished
+    # and a stale/absent stamp never walks the counter backwards
+    driver.load_state(state, {})
+    assert driver.weights_version == v0 + 502

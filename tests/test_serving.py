@@ -413,3 +413,75 @@ def test_serve_mode_validation(state):
     with pytest.raises(ValueError):
         InferenceEngine(CFG, A, state.params, devices=jax.devices()[:1],
                         mode="epsilon")
+
+
+# --------------------------------------------------- weight-version stamping
+# (PR 4 satellites: the serving mirror of the elastic layer's staleness
+# discipline — docs/RESILIENCE.md "heal")
+def test_watcher_refuses_backward_swap(state, tmp_path):
+    """A listing that surfaces an OLDER step (pruned-dir resync, an explicit
+    reload(step=) typo) must not roll live traffic back to stale weights;
+    deliberate rollback needs force=True."""
+    ckpt = Checkpointer(str(tmp_path / "ckpt"))
+    ckpt.save(0, state)
+    ckpt.save(5, state.replace(step=state.step + 5))
+    ckpt.wait()
+    engine = InferenceEngine(CFG, A, state.params, devices=jax.devices()[:1])
+    watcher = CheckpointWatcher(ckpt, params_template(CFG, A),
+                                engine.load_params)
+    assert watcher.reload(step=5)["ok"]
+    v_after_5 = engine.params_version
+    res = watcher.reload(step=0)
+    assert not res["ok"] and res["reason"] == "older_than_loaded"
+    assert res["loaded_step"] == 5
+    assert engine.params_version == v_after_5  # nothing swapped
+    assert watcher.last_step == 5
+    # deliberate rollback is still possible, but only explicitly
+    forced = watcher.reload(step=0, force=True)
+    assert forced["ok"] and forced["step"] == 0
+    assert engine.params_version == v_after_5 + 1
+    ckpt.close()
+
+
+@pytest.mark.serve
+def test_healthz_reports_weights_version_and_age(state):
+    """Serving staleness is externally monitorable: healthz carries the
+    monotone weights_version and how long since the weights changed."""
+    server = PolicyServer(CFG, A, state.params, devices=jax.devices()[:1])
+    h0 = server.healthz()
+    assert h0["weights_version"] == 0
+    assert h0["weights_age_s"] >= 0.0
+    time.sleep(0.05)
+    aged = server.healthz()["weights_age_s"]
+    assert aged >= 0.05
+    v = server.load_params(state.params)
+    h1 = server.healthz()
+    assert h1["weights_version"] == v == 1
+    assert h1["weights_age_s"] < aged  # the swap reset the age clock
+    server.stop()
+
+
+def test_backward_swap_refusal_emits_one_metric_row_per_step(state, tmp_path):
+    """The poll thread retries every poll_interval_s; a lineage restarted
+    from an older checkpoint must produce ONE older_than_loaded swap row,
+    not one per poll."""
+    ckpt = Checkpointer(str(tmp_path / "ckpt"))
+    ckpt.save(0, state)
+    ckpt.save(5, state.replace(step=state.step + 5))
+    ckpt.wait()
+    engine = InferenceEngine(CFG, A, state.params, devices=jax.devices()[:1])
+    sm = ServeMetrics(None)
+    watcher = CheckpointWatcher(ckpt, params_template(CFG, A),
+                                engine.load_params, metrics=sm)
+    assert watcher.reload(step=5)["ok"]
+    swaps_after_load = sm.total_swaps
+    for _ in range(3):  # three polls against the same stale target
+        assert watcher.reload(step=0)["reason"] == "older_than_loaded"
+    assert sm.total_swaps == swaps_after_load + 1
+    # a successful swap closes the episode: a LATER regression to the same
+    # old step is a new incident and emits its own row
+    assert watcher.reload(step=5, force=True)["ok"]
+    swaps_after_reload = sm.total_swaps
+    assert watcher.reload(step=0)["reason"] == "older_than_loaded"
+    assert sm.total_swaps == swaps_after_reload + 1
+    ckpt.close()
