@@ -2,7 +2,7 @@
 
 PY ?= python
 
-.PHONY: test test-fast serve-smoke serve-bench chaos-smoke obs-smoke soak-smoke
+.PHONY: test test-fast serve-smoke serve-bench chaos-smoke obs-smoke soak-smoke perf-smoke
 
 # tier-1: fast unit + integration tests on the virtual 8-device CPU mesh
 test-fast:
@@ -38,6 +38,23 @@ soak-smoke:
 	JAX_PLATFORMS=cpu $(PY) scripts/chaos_soak.py --frames 2000 \
 	  --kill-schedule seeded --out /tmp/ria_soak_smoke
 	$(PY) scripts/lint_jsonl.py /tmp/ria_soak_smoke/results
+
+# perf smoke: the pipelined learner hot path (utils/writeback.py ring,
+# docs/PERFORMANCE.md) must beat the per-step-sync loop on the CPU synthetic
+# apex_loop harness, and the bench rows must lint as strict JSON.  Small
+# watchdog: the toy harness finishes in well under a minute per mode.
+perf-smoke:
+	rm -f /tmp/ria_perf_smoke.jsonl
+	JAX_PLATFORMS=cpu BENCH_APEX_ONLY=1 BENCH_WATCHDOG_SECS=240 \
+	  $(PY) bench.py | tee /tmp/ria_perf_smoke.jsonl
+	$(PY) scripts/lint_jsonl.py /tmp/ria_perf_smoke.jsonl
+	$(PY) -c "import json; rows = [json.loads(l) for l in \
+	  open('/tmp/ria_perf_smoke.jsonl') if l.strip()]; \
+	  r = [x for x in rows if x.get('path') == 'apex_loop'][-1]; \
+	  print('apex_loop: depth=%s %.2f steps/s vs depth0 %.2f (speedup %.3f)' \
+	        % (r['depth'], r['value'], r['depth0_steps_per_sec'], \
+	           r['speedup_vs_depth0'])); \
+	  assert r['speedup_vs_depth0'] >= 1.25, 'pipelined loop under 1.25x'"
 
 # obs smoke: a short anakin run must yield a lintable, reportable run dir —
 # obs_report prints per-role throughput / learn-step percentiles / health,

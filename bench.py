@@ -112,6 +112,14 @@ def measure() -> None:
         signal.alarm(0)  # backend is up; soft-budget checks take over
     print(f"bench child: platform={platform} t_import={time.monotonic()-t_start:.1f}s",
           file=sys.stderr, flush=True)
+
+    # perf-smoke mode (make perf-smoke): only the apex_loop pipeline rows,
+    # at toy size — the full Atari-shape learn step takes minutes/step on CPU
+    if os.environ.get("BENCH_APEX_ONLY") == "1":
+        for row in _measure_apex_loop(lambda: CHILD_BUDGET_SECS
+                                      - (time.monotonic() - t_start)):
+            print(json.dumps(row), flush=True)
+        return
     cfg = Config()  # reference defaults: 84x84x4, N=N'=64, K=32, batch 32
     num_actions = 18  # SABER full action set
     batch_size = cfg.batch_size
@@ -157,22 +165,33 @@ def measure() -> None:
     max_iters = 300 if platform != "cpu" else 8
     chunk = 100 if platform != "cpu" else 2
     batches = [host_batch() for _ in range(8)]
+    # r02/r05 stabilization: the first chunk absorbs allocator/cache warmup
+    # and (on a contended box) scheduler noise — per-chunk rates are kept,
+    # the first is trimmed, and the row reports the CHUNK-MEDIAN rate with
+    # n_iters carried so cross-round comparisons can see the sample size
+    chunk_rates = []
     t0 = time.perf_counter()
+    t_chunk = t0
     n = 0
     while n < max_iters and (n < 1 or left() > CHILD_BUDGET_SECS * 0.5):
         for _ in range(chunk):
             state, info, key = step(state, batches[n % 8], key)
             n += 1
         jax.block_until_ready(info["loss"])
-    dt = time.perf_counter() - t0
+        now = time.perf_counter()
+        chunk_rates.append(chunk / (now - t_chunk))
+        t_chunk = now
 
-    steps_per_sec = n / dt
+    trimmed = chunk_rates[1:] if len(chunk_rates) > 1 else chunk_rates
+    steps_per_sec = sorted(trimmed)[len(trimmed) // 2]  # chunk-median
     host_feed_row = {
         "metric": "iqn_learner_steps_per_sec_atari_shape",
         "value": round(steps_per_sec, 2),
-        "unit": f"learn_steps/s (batch=32, 84x84x4, N=N'=64, {platform})",
+        "unit": f"learn_steps/s (batch=32, 84x84x4, N=N'=64, {platform}; "
+                "chunk-median, first chunk trimmed)",
         "vs_baseline": round(steps_per_sec / 75.0, 3),
         "path": "host_feed",
+        "n_iters": n,
     }
 
     # ---- device-resident replay mode (the headline when it runs) ---------
@@ -186,6 +205,21 @@ def measure() -> None:
     # recovers partial stdout on a watchdog kill, so an emitted host-feed
     # row survives a hang in this phase.  Skipped on CPU (minutes per step).
     if platform == "cpu":
+        # host-feed first (crash-safe: each row is kept the moment it is
+        # printed), then the apex_loop pipeline rows, then host-feed AGAIN so
+        # the headline (last stdout line) stays the cross-round comparable
+        # metric regardless of what the pipeline phase managed to measure
+        print(json.dumps(host_feed_row), flush=True)
+        if left() > 45:
+            try:
+                for row in _measure_apex_loop(left):
+                    print(json.dumps(row), flush=True)
+            except Exception as e:  # noqa: BLE001 — never lose the headline
+                print(f"apex_loop bench failed, host-feed row kept: {e!r}",
+                      file=sys.stderr)
+        else:
+            print(f"bench child: skipping apex_loop phase, {left():.0f}s left",
+                  file=sys.stderr, flush=True)
         print(json.dumps(host_feed_row))
         return
     # print the completed host-feed measurement FIRST (the parent keeps the
@@ -208,6 +242,209 @@ def measure() -> None:
     except Exception as e:  # noqa: BLE001 — never lose the bench row
         print(f"device-replay bench failed, host-feed row kept: {e!r}",
               file=sys.stderr)
+
+
+def _measure_apex_loop(left=None) -> list:
+    """Pipelined-learner-loop bench (ISSUE 5 tentpole): the REAL write-back
+    path — PrioritizedReplay sample via the prefetch thread, jitted learn
+    step, WritebackRing priority write-back — around a toy-shape workload,
+    measured at writeback_depth=0 (the seed's one-blocking-sync-per-step
+    loop) vs the configured depth.  One row is emitted carrying BOTH rates
+    plus their ratio, so a single line proves (or disproves) that the
+    pipelined hot path overlaps host write-back/append work with the device
+    step.  The synthetic actor half appends BENCH_AL_TICKS env ticks per
+    learn step from a pregenerated frame pool — the host duty cycle of the
+    real apex loop without env stepping noise.
+
+    Toy-sized on purpose: the Atari-shape step takes seconds/step on CPU;
+    the pipeline effect is a property of the LOOP, not the workload size."""
+    if left is None:
+        left = lambda: float("inf")  # noqa: E731
+    import jax
+    import numpy as np
+
+    from rainbow_iqn_apex_tpu.agents.agent import FrameStacker
+    from rainbow_iqn_apex_tpu.config import Config
+    from rainbow_iqn_apex_tpu.ops.learn import build_learn_step, init_train_state
+    from rainbow_iqn_apex_tpu.parallel.apex import ActorPriorityEstimator
+    from rainbow_iqn_apex_tpu.replay.buffer import PrioritizedReplay
+    from rainbow_iqn_apex_tpu.utils.prefetch import make_replay_prefetcher
+    from rainbow_iqn_apex_tpu.utils.writeback import WritebackRing
+
+    platform = jax.devices()[0].platform
+    # Sized so the CPU device step lands in single-digit ms — the operating
+    # point of the TPU Atari-shape learner (~0.6ms/step device-resident,
+    # docs/STATUS.md), where the seed's per-step sync was the dominant tax.
+    # The actor half per learn step is `ticks` env ticks of REAL host duty:
+    # FrameStacker shift + replay append + ActorPriorityEstimator n-step TD.
+    h = w = int(os.environ.get("BENCH_AL_FRAME", "44"))
+    lanes = int(os.environ.get("BENCH_AL_LANES", "128"))
+    ticks = int(os.environ.get("BENCH_AL_TICKS", "8"))
+    iters = int(os.environ.get("BENCH_AL_ITERS", "80"))
+    reps = int(os.environ.get("BENCH_AL_REPS", "3"))
+    # per-tick emulated env latency (µs): real vector envs stall the actor
+    # thread on subprocess/ALE IPC each tick (the reference's actors are
+    # separate processes).  The sync loop serializes that stall behind the
+    # per-step device round-trip; the pipelined loop absorbs it while the
+    # in-flight step still executes.  Defaults keep the actor half (numpy
+    # work + stall) just UNDER the device step so the pipelined loop is
+    # device-bound — the Ape-X operating point the ring targets.
+    env_us = int(os.environ.get("BENCH_AL_ENV_US", "500"))
+    num_actions = 6
+    cfg = Config().replace(
+        compute_dtype="float32",
+        frame_height=h,
+        frame_width=w,
+        history_length=2,
+        hidden_size=32,
+        num_cosines=8,
+        num_tau_samples=4,
+        num_tau_prime_samples=4,
+        num_quantile_samples=4,
+        batch_size=16,
+        multi_step=3,
+        prefetch_depth=2,
+    )
+    depth = int(os.environ.get("BENCH_AL_DEPTH", str(cfg.writeback_depth)))
+    # NO buffer donation here: on the CPU backend a donated dispatch runs
+    # SYNCHRONOUSLY (measured: each donated call blocks for its own
+    # computation), which would serialize the loop at every depth and hide
+    # the pipeline effect this row exists to measure.  Accelerator backends
+    # dispatch donated calls asynchronously, so the production learn steps
+    # keep donation (HBM in-place updates); the undonated toy step is the
+    # CPU-side stand-in for that behaviour.
+    learn = jax.jit(build_learn_step(cfg, num_actions))
+
+    # pregenerated synthetic env ticks (frames/actions/rewards/cuts): the
+    # measured host cost is the real pipeline work, not RNG
+    rng = np.random.default_rng(0)
+    pool = [
+        (
+            rng.integers(0, 255, (lanes, h, w), dtype=np.uint8),
+            rng.integers(0, num_actions, lanes).astype(np.int64),
+            rng.normal(size=lanes).astype(np.float32),
+            (rng.random(lanes) < 0.01),
+            rng.normal(size=(lanes, num_actions)).astype(np.float32),  # Q
+        )
+        for _ in range(16)
+    ]
+
+    def run(run_depth: int, run_iters: int) -> float:
+        memory = PrioritizedReplay(
+            1 << 15, (h, w), history=2, n_step=3, gamma=0.99, lanes=lanes,
+            priority_exponent=0.5, seed=0,
+        )
+        stacker = FrameStacker(lanes, (h, w), 2)
+        estimator = ActorPriorityEstimator(lanes, 3, 0.99)
+
+        def actor_tick(t: int) -> None:
+            f, a, r, d, q = pool[t % len(pool)]
+            stacker.push(f)
+            pri = estimator.push(q, a, r, d)
+            memory.append_batch(f, a, r, d, pri)
+            stacker.reset_lanes(d)
+
+        def env_wait() -> None:
+            # the tick loop's emulated env-IPC stalls, consolidated into one
+            # sleep per learn step (sub-ms sleeps land on timer-slack
+            # granularity under load, which would overstate the stall)
+            if env_us:
+                time.sleep(ticks * env_us / 1e6)
+
+        for t in range(4096 // lanes + 8):  # prefill to sampleable
+            actor_tick(t)
+        state = init_train_state(cfg, num_actions, jax.random.PRNGKey(0))
+        key = jax.random.PRNGKey(1)
+        pf = make_replay_prefetcher(memory, cfg, lambda: 0.6)
+        ring = WritebackRing(run_depth)
+        try:
+            for i in range(3):  # compile + warm the pipe
+                idx, batch = pf.get()
+                key, k = jax.random.split(key)
+                state, info = learn(state, batch, k)
+            jax.block_until_ready(info["loss"])
+            n = 0
+            t0 = time.perf_counter()
+            for i in range(run_iters):
+                env_wait()
+                for t in range(ticks):  # the actor half of the loop
+                    actor_tick(i * ticks + t)
+                idx, batch = pf.get()
+                key, k = jax.random.split(key)
+                state, info = learn(state, batch, k)
+                retired = ring.push(i + 1, idx, info)
+                if retired is not None:
+                    memory.update_priorities(retired.idx, retired.priorities)
+                n = i + 1
+                if left() < 15:
+                    break
+            for retired in ring.drain():
+                memory.update_priorities(retired.idx, retired.priorities)
+            jax.block_until_ready(info["loss"])
+            return n / (time.perf_counter() - t0), n
+        finally:
+            pf.close()
+
+    # Interleaved repetitions, best-of per mode (the timeit min-of-repeats
+    # convention: the fastest repetition is the least-contended measurement
+    # of the machine; slower ones measure the shared sandbox, not the loop).
+    # Each repetition runs BOTH modes and alternates which goes first, so a
+    # monotone slowdown penalizes the two modes equally; repetitions are
+    # adaptive — both modes keep sampling, symmetrically, until neither
+    # best-of improves by >2% (the uncontended value has been seen) or the
+    # rep/budget cap is hit.
+    max_reps = int(os.environ.get("BENCH_AL_MAX_REPS", "6"))
+    r0, rk = [], []  # (steps_per_sec, iterations_measured) per repetition
+    rep = 0
+    while rep < max_reps and left() > 25:
+        best_before = (max((s for s, _ in r0), default=0.0),
+                       max((s for s, _ in rk), default=0.0))
+        if depth == 0:
+            # degenerate comparison (writeback_depth=0: the configured depth
+            # IS the seed baseline) — one mode, speedup reported as 1.0
+            r0.append(run(0, iters))
+            rk = r0
+        else:
+            order = (0, depth) if rep % 2 == 0 else (depth, 0)
+            for mode in order:
+                (r0 if mode == 0 else rk).append(run(mode, iters))
+                if left() < 20:
+                    print("bench child: apex_loop budget exhausted "
+                          "mid-repetition", file=sys.stderr, flush=True)
+                    break
+        rep += 1
+        if rep >= reps and r0 and rk:
+            improved = (max(s for s, _ in r0) > best_before[0] * 1.02
+                        or max(s for s, _ in rk) > best_before[1] * 1.02)
+            if not improved:
+                break
+    if not rk:
+        print("bench child: budget exhausted after depth-0 apex_loop run",
+              file=sys.stderr, flush=True)
+        return []
+    sps0 = max(s for s, _ in r0)
+    sps_k = max(s for s, _ in rk)
+    return [{
+        "metric": "apex_loop_steps_per_sec",
+        "value": round(sps_k, 2),
+        "unit": (
+            f"learn_steps/s (apex loop on {platform}: toy {h}x{w}x2 batch="
+            f"{cfg.batch_size}, synthetic replay, {lanes}-lane x {ticks}-"
+            f"tick actor half (stack+append+TD, {env_us}us emulated env "
+            "IPC/tick), real sample + ring write-back; writeback_depth="
+            f"{depth} vs 0)"
+        ),
+        "vs_baseline": None,  # toy shape — not comparable to the 75/s class
+        "path": "apex_loop",
+        "depth": depth,
+        "depth0_steps_per_sec": round(sps0, 2),
+        "speedup_vs_depth0": round(sps_k / max(sps0, 1e-9), 3),
+        # ACTUAL iterations measured (budget breaks can truncate a rep —
+        # downstream must not mistake a truncated sample for a full one)
+        "n_iters": sum(n for _, n in rk),
+        "reps": len(rk),
+        "reps0": len(r0),
+    }]
 
 
 def _measure_device_replay(cfg, num_actions: int, left=None) -> dict | None:
@@ -367,12 +604,19 @@ def main() -> None:
             if isinstance(out, bytes):
                 out = out.decode(errors="replace")
             p = None
-        for line in reversed(out.strip().splitlines()):
+        # relay EVERY parseable row, in order: the child prints secondary
+        # rows (apex_loop) between/before the headline, and downstream keeps
+        # only the LAST stdout line — returning just one line here would
+        # silently drop the others (the headline row must stay last)
+        lines = []
+        for line in out.strip().splitlines():
             try:
                 json.loads(line)
-                return line
+                lines.append(line)
             except ValueError:
                 continue
+        if lines:
+            return lines
         if p is None:
             return None
         # no JSON line: surface the child's failure so the 0.0 row is
@@ -403,9 +647,10 @@ def main() -> None:
                "BENCH_WATCHDOG_SECS": str(cpu_timeout)}
     if "PALLAS_AXON_POOL_IPS" in os.environ:
         cpu_env["PALLAS_AXON_POOL_IPS"] = ""  # empty string disables the relay hook
-    cpu_line = run_child(cpu_env, cpu_timeout)
-    if cpu_line:
-        print(cpu_line, flush=True)
+    cpu_lines = run_child(cpu_env, cpu_timeout)
+    if cpu_lines:
+        for line in cpu_lines:
+            print(line, flush=True)
 
     # Phase 2 — device attempt (axon/TPU env as-is) under the watchdog.
     # Skipped when the environment is pinned to CPU (the device child would
@@ -413,7 +658,8 @@ def main() -> None:
     # above is already on stdout.
     jp = os.environ.get("JAX_PLATFORMS", "")
     device_expected = (
-        jp != "cpu"  # pinned-cpu env: the device child would repeat phase 1
+        os.environ.get("BENCH_APEX_ONLY") != "1"  # perf-smoke: CPU rows only
+        and jp != "cpu"  # pinned-cpu env: the device child would repeat phase 1
         and (
             bool(os.environ.get("PALLAS_AXON_POOL_IPS"))  # sandbox relay hook
             or jp != ""                                    # pinned non-cpu
@@ -422,7 +668,8 @@ def main() -> None:
             or os.environ.get("BENCH_FORCE_DEVICE") == "1"  # explicit override
         )
     )
-    if not device_expected and jp != "cpu":
+    if (not device_expected and jp != "cpu"
+            and os.environ.get("BENCH_APEX_ONLY") != "1"):
         # ADVICE r4: a silently-skipped device phase looks like a CPU-only
         # machine; say why so an unexpected CPU headline is diagnosable
         print(
@@ -431,7 +678,7 @@ def main() -> None:
             "set BENCH_FORCE_DEVICE=1 to attempt it anyway",
             file=sys.stderr,
         )
-    device_line = None
+    device_lines = None
     if device_expected:
         # leave the device child whatever watchdog budget phase 1 didn't use,
         # but never less than a quarter of it (a live relay needs ~60s for
@@ -446,11 +693,12 @@ def main() -> None:
         # (a long fused-segment compile between budget checks).  The grace
         # scales down with small watchdog overrides so they stay meaningful.
         grace = min(120, WATCHDOG_SECS)
-        device_line = run_child({"BENCH_WATCHDOG_SECS": str(remaining)},
-                                remaining + grace)
-    if device_line:
-        print(device_line, flush=True)
-    elif not cpu_line:
+        device_lines = run_child({"BENCH_WATCHDOG_SECS": str(remaining)},
+                                 remaining + grace)
+    if device_lines:
+        for line in device_lines:
+            print(line, flush=True)
+    elif not cpu_lines:
         print(json.dumps({
             "metric": "iqn_learner_steps_per_sec_atari_shape",
             "value": 0.0,

@@ -32,6 +32,7 @@ from rainbow_iqn_apex_tpu.ops.learn import (
     init_train_state,
 )
 from rainbow_iqn_apex_tpu.replay.buffer import SampledBatch
+from rainbow_iqn_apex_tpu.utils import hostsync
 
 
 def put_frames(x: np.ndarray) -> jnp.ndarray:
@@ -90,6 +91,7 @@ class Agent:
         self.num_actions = num_actions
         key, init_key = jax.random.split(key)
         self.key = key
+        self._host_step: Optional[int] = None  # host mirror of state.step
         self.state: TrainState = init_train_state(
             cfg, num_actions, init_key, state_shape=state_shape
         )
@@ -122,13 +124,32 @@ class Agent:
         return self.learn_batch(to_device_batch(sample))
 
     def learn_batch(self, batch: Batch) -> Dict[str, Any]:
-        """One learner step on an already-staged device Batch (prefetch path)."""
-        self.state, info = self._learn(self.state, batch, self._next_key())
+        """One learner step on an already-staged device Batch (prefetch
+        path).  Dispatch-only: ``info`` values stay device arrays (JAX async
+        dispatch) so the caller decides when — if ever per step — to sync."""
+        self._state, info = self._learn(self._state, batch, self._next_key())
+        if self._host_step is not None:
+            self._host_step += 1
         return info
+
+    # `state` invalidates the host step mirror on direct assignment (resume,
+    # tests); learn_batch bypasses the setter and increments the mirror, so
+    # reading `step` in the hot loop never blocks on the device queue.
+    @property
+    def state(self) -> TrainState:
+        return self._state
+
+    @state.setter
+    def state(self, value: TrainState) -> None:
+        self._state = value
+        self._host_step = None
 
     @property
     def step(self) -> int:
-        return int(self.state.step)
+        if self._host_step is None:
+            with hostsync.sanctioned():
+                self._host_step = int(np.asarray(self._state.step))
+        return self._host_step
 
     # ---------------------------------------------------------------- rollback
     def load_snapshot(self, state, key) -> None:

@@ -126,6 +126,14 @@ class Config:
     # ---- prioritized replay (SURVEY §2 rows 5-6) ----------------------------------
     memory_capacity: int = 1_000_000
     prefetch_depth: int = 2  # learner batch pipeline depth; 0 disables
+    writeback_depth: int = 2  # priority write-back ring depth K: step t's
+    # priorities are materialized + written to the replay only while step
+    # t+K executes on device (utils/writeback.py), and the NaN/Inf guard is
+    # checked at the same boundary — the learner hot path issues zero
+    # blocking device->host transfers per step.  Priorities (and the guard)
+    # lag by exactly K steps, the staleness Ape-X already tolerates
+    # (arXiv:1803.00933).  0 = seed behaviour: one blocking sync per step.
+    # docs/PERFORMANCE.md has tuning guidance.
     priority_exponent: float = 0.5  # omega
     priority_weight: float = 0.4  # beta_0, annealed to 1 over training
     priority_eps: float = 1e-6

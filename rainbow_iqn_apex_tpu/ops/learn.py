@@ -184,12 +184,18 @@ def build_learn_step(
             params,
         )
 
+        grad_norm = optax.global_norm(grads)
         info = {
             "loss": loss,
             "priorities": aux["td_abs"],
             "q_mean": aux["q_mean"],
             "target_q_mean": aux["target_q_mean"],
-            "grad_norm": optax.global_norm(grads),
+            "grad_norm": grad_norm,
+            # On-device NaN/Inf guard: the same loss/grad-norm finiteness
+            # check TrainSupervisor.step_ok used to do with a per-step host
+            # sync, folded into the XLA graph so the supervisor can defer
+            # reading it to the write-back ring boundary (utils/writeback.py).
+            "finite": jnp.isfinite(loss) & jnp.isfinite(grad_norm),
         }
         return (
             TrainState(

@@ -269,11 +269,15 @@ def build_r2d2_learn_step(
             state.target_params,
             params,
         )
+        grad_norm = optax.global_norm(grads)
         info = {
             "loss": loss,
             "priorities": aux["priorities"],
             "q_mean": aux["q_mean"],
-            "grad_norm": optax.global_norm(grads),
+            "grad_norm": grad_norm,
+            # on-device NaN/Inf guard flag (same contract as ops/learn.py:
+            # checked host-side at the write-back ring boundary)
+            "finite": jnp.isfinite(loss) & jnp.isfinite(grad_norm),
         }
         return (
             R2D2TrainState(

@@ -41,6 +41,12 @@ from rainbow_iqn_apex_tpu.agents.agent import (
     to_device_batch,
 )
 from rainbow_iqn_apex_tpu.utils.prefetch import BatchPrefetcher, make_replay_prefetcher
+from rainbow_iqn_apex_tpu.utils import hostsync
+from rainbow_iqn_apex_tpu.utils.writeback import (
+    RingCommitter,
+    WritebackRing,
+    pipeline_gauges,
+)
 from rainbow_iqn_apex_tpu.config import Config
 from rainbow_iqn_apex_tpu.envs import make_vector_env
 from rainbow_iqn_apex_tpu.obs import RunObs
@@ -144,6 +150,7 @@ class ApexDriver:
         self.key = jax.random.PRNGKey(cfg.seed)
         self.key, k_init = jax.random.split(self.key)
         state = init_train_state(cfg, num_actions, k_init, state_shape=state_shape)
+        self._host_step: Optional[int] = None  # host mirror of state.step
         self.state: TrainState = jax.device_put(state, rep_l)
 
         # learner step: batch split over dp, state replicated; XLA inserts the
@@ -291,7 +298,11 @@ class ApexDriver:
         return self.learn_batch(to_device_batch(sample))
 
     def learn_batch(self, batch: Batch) -> Dict[str, Any]:
-        self.state, info = self._learn(self.state, batch, self._next_key())
+        """Dispatch one learn step; ``info`` values stay DEVICE arrays (JAX
+        async dispatch) — the write-back ring decides when to sync."""
+        self._state, info = self._learn(self._state, batch, self._next_key())
+        if self._host_step is not None:
+            self._host_step += 1
         return info
 
     # ------------------------------------------------------------- multi-host
@@ -307,8 +318,10 @@ class ApexDriver:
         beta: Optional[float] = None,
     ) -> Dict[str, Any]:
         """Learn step fed from this host's local sub-batch (B/hosts rows).
-        Returns info with ``priorities`` as the LOCAL rows only, in the same
-        order as the input — ready for local shard write-back.
+        Returns info with ``priorities`` as the GLOBAL dp-sharded device
+        array; pass ``multihost.local_rows`` as the write-back ring's
+        ``priorities_to_host`` to get this host's rows (input order) at
+        retirement.
 
         IS weights: each host's replay normalizes weights over its OWN
         sub-batch, which is inconsistent across hosts (each host's max row
@@ -336,9 +349,11 @@ class ApexDriver:
             discount=put(sample.discount, np.float32),
             weight=weight,
         )
-        info = self.learn_batch(batch)
-        pri = _local_rows(info["priorities"])
-        return {**info, "priorities": pri}
+        # priorities stay the GLOBAL device array: the write-back ring
+        # extracts this host's local rows at RETIREMENT (K steps later) via
+        # its priorities_to_host hook, so dispatching a multi-host learn
+        # step blocks on nothing either
+        return self.learn_batch(batch)
 
     def act_local(self, stacked_obs: np.ndarray) -> Tuple[np.ndarray, np.ndarray]:
         """Lane-sharded inference fed from this host's local lanes."""
@@ -346,9 +361,25 @@ class ApexDriver:
         a, q = self._act(self.actor_params, obs, self._next_key())
         return _local_rows(a), _local_rows(q)
 
+    # `state` invalidates the host step mirror on direct assignment
+    # (load_state / load_snapshot / tests); learn_batch bypasses the setter
+    # and increments the mirror, so the hot loop's per-step `driver.step`
+    # reads never block on the device queue.
+    @property
+    def state(self) -> TrainState:
+        return self._state
+
+    @state.setter
+    def state(self, value: TrainState) -> None:
+        self._state = value
+        self._host_step = None
+
     @property
     def step(self) -> int:
-        return int(self.state.step)
+        if self._host_step is None:
+            with hostsync.sanctioned():
+                self._host_step = int(np.asarray(self._state.step))
+        return self._host_step
 
 
 def _eval_learner(cfg: Config, env, driver: "ApexDriver") -> Dict[str, Any]:
@@ -477,6 +508,23 @@ def train_apex(cfg: Config, max_frames: Optional[int] = None) -> Dict[str, Any]:
     returns: collections.deque = collections.deque(maxlen=100)
     prefetcher: Optional[BatchPrefetcher] = None
 
+    # Pipelined priority write-back (utils/writeback.py): step t's priorities
+    # are materialized and written to the replay only while step t+K runs on
+    # device, and the NaN/Inf guard reads the in-graph `finite` flag at the
+    # same boundary — the steady-state learn loop issues ZERO blocking
+    # device->host transfers per step (docs/PERFORMANCE.md).  The commit/
+    # quarantine/drain rollback protocol is the shared RingCommitter.
+    ring = WritebackRing(
+        cfg.writeback_depth,
+        registry=obs_run.registry,
+        priorities_to_host=_local_rows if multihost else None,
+    )
+    committer = RingCommitter(
+        ring, memory.update_priorities, sup, driver.load_snapshot
+    )
+    last_scalars = committer.scalars  # newest RETIRED step's host scalars
+    _commit, _drain = committer.commit, committer.drain
+
     if multihost and cfg.pipelined_actor:
         raise ValueError("pipelined_actor is single-host only (for now)")
     # multi-host learn trigger: DETERMINISTIC and identical on every host
@@ -567,21 +615,30 @@ def train_apex(cfg: Config, max_frames: Optional[int] = None) -> Dict[str, Any]:
                             ),
                             depth=cfg.prefetch_depth,
                             device_put=False,
+                            registry=obs_run.registry,
                         )
                     else:
                         prefetcher = make_replay_prefetcher(
-                            memory, cfg, lambda: priority_beta(cfg, frames)
+                            memory, cfg, lambda: priority_beta(cfg, frames),
+                            registry=obs_run.registry,
                         )
                 steps_due = frames // cfg.replay_ratio - driver.step
                 for _ in range(max(steps_due, 0)):
-                    sup.snapshot_if_due(
-                        driver.step,
-                        lambda: (host_state(driver.state), driver.key),
-                    )
+                    if sup.snapshot_due(driver.step):
+                        # drain BEFORE capturing: the snapshot must never
+                        # contain a step whose finiteness is still in flight
+                        # (it is the rollback target)
+                        if not _drain():
+                            continue
+                        sup.snapshot_if_due(
+                            driver.step,
+                            lambda: (host_state(driver.state), driver.key),
+                        )
                     if multihost:
-                        # local sub-batch in, local priority rows out; the
-                        # global batch assembles across hosts inside, and IS
-                        # weights are re-derived globally
+                        # local sub-batch in; the global batch assembles
+                        # across hosts inside, IS weights are re-derived
+                        # globally, and the ring extracts this host's local
+                        # priority rows at retirement
                         if prefetcher is not None:
                             idx, sample = prefetcher.get()
                         else:
@@ -606,21 +663,23 @@ def train_apex(cfg: Config, max_frames: Optional[int] = None) -> Dict[str, Any]:
                         with obs_run.span("learn_step"):
                             info = driver.learn(sup.poison_maybe(sample))
                     sup.maybe_stall()
-                    if not sup.step_ok(info):
-                        # non-finite step (loss is all-reduced: every host
-                        # sees the same value and rolls back together).
-                        # Quarantine the sampled rows — |TD|=0 drops a
-                        # genuinely poisoned max-priority transition to
-                        # eps^omega so it can't re-sample into a rollback
-                        # livelock — and the guard runs BEFORE publish so
-                        # actors never see poisoned params.
-                        memory.update_priorities(idx, np.zeros(len(idx)))
-                        driver.load_snapshot(*sup.rollback())
+                    # Dispatch-only hot path: info stays on device; the ring
+                    # retires step t-K (write-back + deferred NaN guard)
+                    # while step t executes.  The guard decision is still
+                    # identical on every host — the loss is all-reduced, so
+                    # the in-graph finite flag agrees and rollback stays
+                    # lockstep (no divergent control flow around a
+                    # collective).
+                    if not _commit(ring.push(driver.step, idx, info)):
                         continue
-                    memory.update_priorities(idx, np.asarray(info["priorities"]))
                     step = driver.step
                     obs_run.after_learn_step(step)
                     if step - last_pub >= cfg.weight_publish_interval:
+                        # ring boundary: actors must never adopt params with
+                        # an unverified step in their history, so everything
+                        # in flight retires (and may roll us back) first
+                        if not _drain():
+                            continue
                         with obs_run.span("publish_weights"):
                             version = driver.publish_weights()
                         last_pub = step
@@ -635,13 +694,16 @@ def train_apex(cfg: Config, max_frames: Optional[int] = None) -> Dict[str, Any]:
                             driver.weights_version,
                             step=step,
                         )
+                        # scalars come from the newest RETIRED step (<= K
+                        # behind) — the metric cadence reads host floats the
+                        # ring already materialized, never the device queue
                         metrics.log(
                             "learn",
                             step=step,
                             frames=frames,
                             fps=metrics.fps(frames),
-                            loss=float(info["loss"]),
-                            q_mean=float(info["q_mean"]),
+                            loss=last_scalars.get("loss", float("nan")),
+                            q_mean=last_scalars.get("q_mean", float("nan")),
                             mean_return=float(np.mean(returns)) if returns else float("nan"),
                             staleness=step - last_pub,
                         )
@@ -661,6 +723,7 @@ def train_apex(cfg: Config, max_frames: Optional[int] = None) -> Dict[str, Any]:
                             weight_staleness=step - last_pub,
                             weights_version=driver.weights_version,
                             weight_version_lag=fence.lag,
+                            **pipeline_gauges(ring, obs_run.registry),
                         )
                         if monitor is not None:
                             # a preempted host stops heartbeating; the
@@ -686,11 +749,21 @@ def train_apex(cfg: Config, max_frames: Optional[int] = None) -> Dict[str, Any]:
                                     epoch=lease.epoch, step=step,
                                     frames=frames,
                                 )
-                    if is_main and cfg.eval_interval and step % cfg.eval_interval == 0:
-                        metrics.log(
-                            "eval", step=step, **_eval_learner(cfg, env, driver)
-                        )
+                    if cfg.eval_interval and step % cfg.eval_interval == 0:
+                        # the drain runs on EVERY host (the cadence is a
+                        # function of the lockstep step counter) so a
+                        # rollback here stays lockstep; only the eval
+                        # itself is main-host work
+                        if not _drain():  # evaluate only verified params
+                            continue
+                        if is_main:
+                            metrics.log(
+                                "eval", step=step,
+                                **_eval_learner(cfg, env, driver),
+                            )
                     if cfg.checkpoint_interval and step % cfg.checkpoint_interval == 0:
+                        if not _drain():  # checkpoint only verified params
+                            continue
                         # every host calls save — Orbax treats it as a
                         # collective under jax.distributed (primary host
                         # writes, the rest join its barrier); a p0-only call
@@ -703,7 +776,9 @@ def train_apex(cfg: Config, max_frames: Optional[int] = None) -> Dict[str, Any]:
                              **rng_extra(driver.key)},
                         )
                         sup.save_replay(cfg, memory)  # per-host shard
-
+        # end of run: the still-in-flight tail retires (write-back + guard)
+        # before the final eval/checkpoint read the state
+        _drain()
     finally:
         if prefetcher is not None:
             prefetcher.close()

@@ -56,7 +56,7 @@ from rainbow_iqn_apex_tpu.parallel.multihost import (
 from rainbow_iqn_apex_tpu.parallel.supervisor import TrainSupervisor
 from rainbow_iqn_apex_tpu.replay.sequence import SequenceReplay, SequenceSample
 from rainbow_iqn_apex_tpu.train import priority_beta
-from rainbow_iqn_apex_tpu.utils import faults
+from rainbow_iqn_apex_tpu.utils import faults, hostsync
 from rainbow_iqn_apex_tpu.utils.checkpoint import (
     Checkpointer,
     maybe_restore_replay,
@@ -66,6 +66,11 @@ from rainbow_iqn_apex_tpu.utils.checkpoint import (
 )
 from rainbow_iqn_apex_tpu.utils.logging import MetricsLogger
 from rainbow_iqn_apex_tpu.utils.prefetch import BatchPrefetcher
+from rainbow_iqn_apex_tpu.utils.writeback import (
+    RingCommitter,
+    WritebackRing,
+    pipeline_gauges,
+)
 
 
 class R2D2ApexDriver:
@@ -97,6 +102,7 @@ class R2D2ApexDriver:
 
         self.key = jax.random.PRNGKey(cfg.seed)
         self.key, k_init = jax.random.split(self.key)
+        self._host_step: Optional[int] = None  # host mirror of state.step
         self.state: R2D2TrainState = jax.device_put(
             init_r2d2_state(cfg, num_actions, k_init, frame_shape), rep_l
         )
@@ -268,7 +274,11 @@ class R2D2ApexDriver:
         return np.asarray(a), (pre_c, pre_h)
 
     def learn_batch(self, batch: SequenceBatch) -> Dict[str, Any]:
-        self.state, info = self._learn(self.state, batch, self._next_key())
+        """Dispatch one sequence learn step; ``info`` stays DEVICE arrays
+        (async dispatch) — the write-back ring decides when to sync."""
+        self._state, info = self._learn(self._state, batch, self._next_key())
+        if self._host_step is not None:
+            self._host_step += 1
         return info
 
     def learn_local(
@@ -277,7 +287,9 @@ class R2D2ApexDriver:
         """Sequence learn step fed from this host's local sub-batch; IS
         weights re-derived over the assembled GLOBAL batch exactly as in
         ApexDriver.learn_local (fixed per-host quota => uniform host
-        mixture: q(i) = prob_local(i) / n_hosts)."""
+        mixture: q(i) = prob_local(i) / n_hosts).  ``priorities`` stay the
+        GLOBAL device array — the ring's ``priorities_to_host`` hook
+        (multihost.local_rows) extracts this host's rows at retirement."""
         put = lambda x, dt: jax.make_array_from_process_local_data(  # noqa: E731
             self._batch_sh, np.ascontiguousarray(x, dt)
         )
@@ -293,12 +305,26 @@ class R2D2ApexDriver:
             init_h=put(sample.init_h, np.float32),
             weight=weight,
         )
-        info = self.learn_batch(batch)
-        return {**info, "priorities": _local_rows(info["priorities"])}
+        return self.learn_batch(batch)
+
+    # `state` invalidates the host step mirror on direct assignment;
+    # learn_batch bypasses the setter and increments it (same contract as
+    # ApexDriver) so per-step `driver.step` reads never touch the device.
+    @property
+    def state(self) -> R2D2TrainState:
+        return self._state
+
+    @state.setter
+    def state(self, value: R2D2TrainState) -> None:
+        self._state = value
+        self._host_step = None
 
     @property
     def step(self) -> int:
-        return int(self.state.step)
+        if self._host_step is None:
+            with hostsync.sanctioned():
+                self._host_step = int(np.asarray(self._state.step))
+        return self._host_step
 
 
 def _eval_r2d2_learner(cfg: Config, env, driver: "R2D2ApexDriver") -> Dict[str, Any]:
@@ -405,6 +431,21 @@ def train_apex_r2d2(cfg: Config, max_frames: Optional[int] = None) -> Dict[str, 
     prev_cuts = np.zeros(lanes, bool)
     returns: collections.deque = collections.deque(maxlen=100)
     prefetcher: Optional[BatchPrefetcher] = None
+    # pipelined priority write-back + deferred in-graph NaN guard — the same
+    # zero-sync hot path as train_apex (utils/writeback.py; the two drivers
+    # must not drift on the learner-throughput surface, which is why the
+    # commit/quarantine/drain protocol is the shared RingCommitter)
+    ring = WritebackRing(
+        cfg.writeback_depth,
+        registry=obs_run.registry,
+        priorities_to_host=_local_rows if multihost else None,
+    )
+    committer = RingCommitter(
+        ring, memory.update_priorities, sup, driver.load_snapshot
+    )
+    last_scalars = committer.scalars
+    _commit, _drain = committer.commit, committer.drain
+
     learn_start_seqs = max(cfg.learn_start // seq_total, 8)  # single-host gate
     frames_per_step = cfg.replay_ratio * cfg.r2d2_seq_len
     # multi-host learn trigger: frames-only (lockstep-deterministic), and
@@ -455,6 +496,7 @@ def train_apex_r2d2(cfg: Config, max_frames: Optional[int] = None) -> Dict[str, 
                             ),
                             depth=cfg.prefetch_depth,
                             device_put=False,
+                            registry=obs_run.registry,
                         )
                     else:
                         prefetcher = BatchPrefetcher(
@@ -466,13 +508,19 @@ def train_apex_r2d2(cfg: Config, max_frames: Optional[int] = None) -> Dict[str, 
                             ),
                             depth=cfg.prefetch_depth,
                             device_put=False,
+                            registry=obs_run.registry,
                         )
                 steps_due = frames // frames_per_step - driver.step
                 for _ in range(max(steps_due, 0)):
-                    sup.snapshot_if_due(
-                        driver.step,
-                        lambda: (host_state(driver.state), driver.key),
-                    )
+                    if sup.snapshot_due(driver.step):
+                        # drain first: the rollback target must never hold
+                        # a step whose finiteness is still in flight
+                        if not _drain():
+                            continue
+                        sup.snapshot_if_due(
+                            driver.step,
+                            lambda: (host_state(driver.state), driver.key),
+                        )
                     if multihost:
                         if prefetcher is not None:
                             idx, s = prefetcher.get()
@@ -498,18 +546,18 @@ def train_apex_r2d2(cfg: Config, max_frames: Optional[int] = None) -> Dict[str, 
                         with obs_run.span("learn_step"):
                             info = driver.learn_batch(sup.poison_maybe(batch))
                     sup.maybe_stall()
-                    if not sup.step_ok(info):
-                        # same all-reduced-loss argument as apex.py: every
-                        # host rolls back together; the sampled sequences
-                        # are quarantined (|TD|=0) so a poisoned one can't
-                        # re-sample into a rollback livelock
-                        memory.update_priorities(idx, np.zeros(len(idx)))
-                        driver.load_snapshot(*sup.rollback())
+                    # dispatch-only hot path; the deferred guard decision is
+                    # still lockstep across hosts (all-reduced loss -> same
+                    # in-graph finite flag), same argument as apex.py
+                    if not _commit(ring.push(driver.step, idx, info)):
                         continue
-                    memory.update_priorities(idx, np.asarray(info["priorities"]))
                     step = driver.step
                     obs_run.after_learn_step(step)
                     if step - last_pub >= cfg.weight_publish_interval:
+                        # ring boundary: actors never adopt params with an
+                        # unverified step in their history
+                        if not _drain():
+                            continue
                         with obs_run.span("publish_weights"):
                             version = driver.publish_weights()
                         last_pub = step
@@ -529,8 +577,8 @@ def train_apex_r2d2(cfg: Config, max_frames: Optional[int] = None) -> Dict[str, 
                             step=step,
                             frames=frames,
                             fps=metrics.fps(frames),
-                            loss=float(info["loss"]),
-                            q_mean=float(info["q_mean"]),
+                            loss=last_scalars.get("loss", float("nan")),
+                            q_mean=last_scalars.get("q_mean", float("nan")),
                             mean_return=float(np.mean(returns)) if returns else float("nan"),
                             sequences=len(memory),
                             staleness=step - last_pub,
@@ -545,6 +593,7 @@ def train_apex_r2d2(cfg: Config, max_frames: Optional[int] = None) -> Dict[str, 
                             weight_staleness=step - last_pub,
                             weights_version=driver.weights_version,
                             weight_version_lag=fence.lag,
+                            **pipeline_gauges(ring, obs_run.registry),
                         )
                         if monitor is not None:
                             # same lease-edge reporting as train_apex: one
@@ -562,20 +611,31 @@ def train_apex_r2d2(cfg: Config, max_frames: Optional[int] = None) -> Dict[str, 
                                     epoch=lease.epoch, step=step,
                                     frames=frames,
                                 )
-                    if is_main and cfg.eval_interval and step % cfg.eval_interval == 0:
-                        metrics.log(
-                            "eval", step=step, **_eval_r2d2_learner(cfg, env, driver)
-                        )
+                    if cfg.eval_interval and step % cfg.eval_interval == 0:
+                        # drain on EVERY host (lockstep cadence) so a
+                        # rollback here can't diverge the pod; the eval
+                        # itself stays main-host work
+                        if not _drain():  # evaluate only verified params
+                            continue
+                        if is_main:
+                            metrics.log(
+                                "eval", step=step,
+                                **_eval_r2d2_learner(cfg, env, driver),
+                            )
                     if cfg.checkpoint_interval and step % cfg.checkpoint_interval == 0:
                         # collective under jax.distributed: every host joins,
                         # the primary writes (a p0-only call would hang);
                         # retry decisions are deterministic -> lockstep
+                        if not _drain():  # checkpoint only verified params
+                            continue
                         sup.save_checkpoint(
                             ckpt, step, host_state(driver.state),
                             {"frames": frames, "weights_version": driver.weights_version,
                              **rng_extra(driver.key)},
                         )
                         sup.save_replay(cfg, memory)
+        # end of run: retire the in-flight tail before the final eval/save
+        _drain()
     finally:
         if prefetcher is not None:
             prefetcher.close()

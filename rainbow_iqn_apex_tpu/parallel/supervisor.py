@@ -8,11 +8,17 @@ and a step WILL wedge (device stall, dead collective peer).  Before this
 module, any one of those killed `train_apex` outright.  The supervisor
 turns them into bounded, reported events:
 
-- **NaN/Inf guard**: every learn step's loss/grad-norm is checked (the
-  scalars are already on host — the priority write-back syncs each step, so
-  the check adds no extra device round-trip).  A non-finite step rolls
-  params + optimizer state + RNG back to the last-good in-memory snapshot
-  and skips the poisoned batch's priority write-back; ``max_nan_strikes``
+- **NaN/Inf guard**: every learn step's finiteness is checked.  The hot
+  loops compute the flag IN-GRAPH (``info["finite"]``, ops/learn.py) and
+  defer the host read to the write-back ring boundary
+  (``retire_ok`` — utils/writeback.py), so the guard adds no per-step
+  device round-trip; ``step_ok`` remains the synchronous form for loops
+  that already hold host scalars (anakin's segment results, tests).  A
+  non-finite step rolls params + optimizer state + RNG back to the
+  last-good in-memory snapshot and skips the poisoned batch's priority
+  write-back — with a ring in flight the caller also quarantines every
+  in-flight idx set, and the snapshot is only ever captured at a drain
+  point so it can never contain an unverified step; ``max_nan_strikes``
   consecutive bad steps abort the run (`TrainAborted`) — rollback can mask
   a transient, not a systemically poisoned replay.
 - **Stall watchdog**: a daemon thread that fires when no learn step
@@ -44,7 +50,7 @@ from typing import Any, Callable, Dict, Optional, Tuple
 import jax
 import numpy as np
 
-from rainbow_iqn_apex_tpu.utils import faults
+from rainbow_iqn_apex_tpu.utils import faults, hostsync
 
 
 class TrainAborted(RuntimeError):
@@ -163,14 +169,23 @@ class TrainSupervisor:
         return self.watchdog.stalls if self.watchdog is not None else 0
 
     # ------------------------------------------------------------- snapshots
+    def snapshot_due(self, step: int) -> bool:
+        """True when ``snapshot_if_due(step, ...)`` would capture.  Pipelined
+        loops check this FIRST and drain their write-back ring before
+        capturing, so the snapshot can never contain an unverified step."""
+        return self._snap is None or step - self._snap[0] >= self.snapshot_interval
+
     def snapshot_if_due(self, step: int, capture: Callable[[], Tuple[Any, Any]]) -> bool:
         """Refresh the last-good (state, key) host copy every
         ``guard_snapshot_interval`` learner steps.  ``capture`` must return
-        host-materialisable values (the caller passes ``host_state(...)``)."""
-        if self._snap is not None and step - self._snap[0] < self.snapshot_interval:
+        host-materialisable values (the caller passes ``host_state(...)``);
+        the materialization is a sanctioned sync (snapshot cadence, not the
+        per-step hot path)."""
+        if not self.snapshot_due(step):
             return False
-        state, key = capture()
-        self._snap = (step, jax.tree.map(np.asarray, state), np.asarray(key))
+        with hostsync.sanctioned():
+            state, key = capture()
+            self._snap = (step, jax.tree.map(np.asarray, state), np.asarray(key))
         return True
 
     def rollback(self) -> Tuple[Any, Any]:
@@ -196,12 +211,31 @@ class TrainSupervisor:
     # ------------------------------------------------------------ step guard
     def step_ok(self, info: Dict[str, Any]) -> bool:
         """True when the step's loss/grad-norm are finite.  Ticks the stall
-        watchdog (a completed step IS the liveness signal)."""
+        watchdog (a completed step IS the liveness signal).  Synchronous
+        form: floats the scalars here (one device->host sync when they are
+        still device arrays) — the pipelined loops use ``retire_ok``."""
         if self.watchdog is not None:
             self.watchdog.tick()
-        loss = float(info["loss"])
-        grad = float(info["grad_norm"]) if "grad_norm" in info else 0.0
-        if math.isfinite(loss) and math.isfinite(grad):
+        with hostsync.sanctioned():
+            loss = float(info["loss"])
+            grad = float(info["grad_norm"]) if "grad_norm" in info else 0.0
+        return self._finite_ok(loss, grad, math.isfinite(loss) and math.isfinite(grad))
+
+    def retire_ok(self, retired) -> bool:
+        """Deferred step guard for the write-back ring (utils/writeback.py):
+        the finiteness flag was computed in-graph K steps ago and
+        materialized at the ring boundary, so this touches no device value.
+        On False the caller must quarantine EVERY in-flight idx set (the
+        retired entry's and the ring's flush()) before rolling back."""
+        if self.watchdog is not None:
+            self.watchdog.tick()
+        loss = retired.scalars.get("loss", float("nan"))
+        grad = retired.scalars.get("grad_norm", 0.0)
+        return self._finite_ok(loss, grad, bool(retired.finite), step=retired.step,
+                               lag=retired.lag)
+
+    def _finite_ok(self, loss: float, grad: float, finite: bool, **extra) -> bool:
+        if finite:
             self.strikes = 0
             return True
         self.strikes += 1
@@ -210,6 +244,7 @@ class TrainSupervisor:
             loss=loss if math.isfinite(loss) else str(loss),
             grad_norm=grad if math.isfinite(grad) else str(grad),
             strikes=self.strikes,
+            **extra,
         )
         return False
 

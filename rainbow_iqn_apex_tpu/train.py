@@ -18,6 +18,11 @@ import numpy as np
 
 from rainbow_iqn_apex_tpu.agents.agent import Agent, FrameStacker
 from rainbow_iqn_apex_tpu.utils.prefetch import BatchPrefetcher, make_replay_prefetcher
+from rainbow_iqn_apex_tpu.utils.writeback import (
+    RingCommitter,
+    WritebackRing,
+    pipeline_gauges,
+)
 from rainbow_iqn_apex_tpu.config import Config
 from rainbow_iqn_apex_tpu.envs import make_vector_env
 from rainbow_iqn_apex_tpu.eval import evaluate
@@ -92,6 +97,19 @@ def train(cfg: Config, max_frames: Optional[int] = None) -> Dict[str, Any]:
     last_eval: Dict[str, Any] = {}
     prefetcher: Optional[BatchPrefetcher] = None
 
+    # pipelined priority write-back + deferred in-graph NaN guard
+    # (utils/writeback.py; docs/PERFORMANCE.md): zero blocking device->host
+    # transfers per learn step — syncs happen only at ring boundaries
+    # (snapshot/eval/checkpoint cadence) and on retirement of K-old steps;
+    # the commit/quarantine/drain rollback protocol is the shared
+    # RingCommitter
+    ring = WritebackRing(cfg.writeback_depth, registry=obs_run.registry)
+    committer = RingCommitter(
+        ring, memory.update_priorities, sup, agent.load_snapshot
+    )
+    last_scalars = committer.scalars
+    _commit, _drain = committer.commit, committer.drain
+
     try:
         while frames < total_frames:
             stacked = stacker.push(obs)
@@ -115,13 +133,19 @@ def train(cfg: Config, max_frames: Optional[int] = None) -> Dict[str, Any]:
                     # background sampler overlaps batch assembly + transfer
                     # with the device step (beta_fn reads live `frames`)
                     prefetcher = make_replay_prefetcher(
-                        memory, cfg, lambda: priority_beta(cfg, frames)
+                        memory, cfg, lambda: priority_beta(cfg, frames),
+                        registry=obs_run.registry,
                     )
                 steps_due = frames // cfg.replay_ratio - agent.step
                 for _ in range(max(steps_due, 0)):
-                    sup.snapshot_if_due(
-                        agent.step, lambda: (agent.state, agent.key)
-                    )
+                    if sup.snapshot_due(agent.step):
+                        # drain first: the rollback target must never hold a
+                        # step whose finiteness is still in flight
+                        if not _drain():
+                            continue
+                        sup.snapshot_if_due(
+                            agent.step, lambda: (agent.state, agent.key)
+                        )
                     if prefetcher is not None:
                         idx, batch = prefetcher.get()
                         with obs_run.span("learn_step"):
@@ -135,16 +159,11 @@ def train(cfg: Config, max_frames: Optional[int] = None) -> Dict[str, Any]:
                         with obs_run.span("learn_step"):
                             info = agent.learn(sup.poison_maybe(sample))
                     sup.maybe_stall()
-                    if not sup.step_ok(info):
-                        # non-finite step: quarantine the sampled rows
-                        # (|TD|=0 -> eps^omega priority, so a genuinely
-                        # poisoned transition at max_priority can't be
-                        # re-sampled into a rollback livelock), then roll
-                        # params/opt/RNG back to last-good
-                        memory.update_priorities(idx, np.zeros(len(idx)))
-                        agent.load_snapshot(*sup.rollback())
+                    # dispatch-only: info stays on device; step t-K retires
+                    # (priority write-back + deferred NaN guard) while step
+                    # t executes
+                    if not _commit(ring.push(agent.step, idx, info)):
                         continue
-                    memory.update_priorities(idx, np.asarray(info["priorities"]))
 
                     step = agent.step
                     obs_run.after_learn_step(step)
@@ -154,9 +173,9 @@ def train(cfg: Config, max_frames: Optional[int] = None) -> Dict[str, Any]:
                             step=step,
                             frames=frames,
                             fps=metrics.fps(frames),
-                            loss=float(info["loss"]),
-                            q_mean=float(info["q_mean"]),
-                            grad_norm=float(info["grad_norm"]),
+                            loss=last_scalars.get("loss", float("nan")),
+                            q_mean=last_scalars.get("q_mean", float("nan")),
+                            grad_norm=last_scalars.get("grad_norm", float("nan")),
                             mean_return=float(np.mean(returns)) if returns else float("nan"),
                         )
                         obs_run.periodic(
@@ -166,16 +185,23 @@ def train(cfg: Config, max_frames: Optional[int] = None) -> Dict[str, Any]:
                             replay_occupancy=round(
                                 len(memory) / max(cfg.memory_capacity, 1), 4
                             ),
+                            **pipeline_gauges(ring, obs_run.registry),
                         )
                     if cfg.eval_interval and step % cfg.eval_interval == 0:
+                        if not _drain():  # evaluate only verified params
+                            continue
                         last_eval = evaluate(cfg, agent, seed=cfg.seed + 977)
                         metrics.log("eval", step=step, **last_eval)
                     if cfg.checkpoint_interval and step % cfg.checkpoint_interval == 0:
+                        if not _drain():  # checkpoint only verified params
+                            continue
                         sup.save_checkpoint(
                             ckpt, step, agent.state,
                             {"frames": frames, **rng_extra(agent.key)},
                         )
                         sup.save_replay(cfg, memory)
+        # end of run: retire the in-flight tail before the final eval/save
+        _drain()
     finally:
         if prefetcher is not None:
             prefetcher.close()

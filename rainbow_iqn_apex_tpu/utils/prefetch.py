@@ -9,13 +9,17 @@ host-side sampling, so the accelerator step time is the loop's floor.
 
 Priority write-back consequently lags by the pipeline depth — exactly the
 staleness semantics the distributed reference already has (the learner's
-priority updates race later samples through Redis).
+priority updates race later samples through Redis).  The write-back side of
+that overlap is the depth-K ring in utils/writeback.py: together they make
+the steady-state learn loop issue zero blocking host<->device transfers per
+step (docs/PERFORMANCE.md has the sync-point inventory).
 """
 
 from __future__ import annotations
 
 import queue
 import threading
+import time
 from typing import Any, Callable, Optional
 
 import jax
@@ -28,6 +32,15 @@ class BatchPrefetcher:
     single-writer discipline (appends happen on the main thread between
     get() calls; NumPy ops release the GIL only inside C loops that don't
     observe partial Python-level state).
+
+    When an obs MetricRegistry is attached, the pipeline exports its own
+    health onto it (role "prefetch"), so obs_report can tell learner
+    STARVATION (sampler too slow: queue depth pinned at 0, empty-wait count
+    climbing) from device-bound steps (queue full, no empty waits):
+
+      prefetch_queue_depth       gauge: staged batches ready to consume
+      prefetch_empty_wait_total  counter: get() calls that found it empty
+      prefetch_empty_wait_s     histogram: how long those gets blocked
     """
 
     def __init__(
@@ -35,6 +48,8 @@ class BatchPrefetcher:
         sample_fn: Callable[[], Any],
         depth: int = 2,
         device_put: bool = True,
+        registry=None,
+        role: str = "prefetch",
     ):
         self.sample_fn = sample_fn
         self.depth = depth
@@ -42,6 +57,11 @@ class BatchPrefetcher:
         self._q: queue.Queue = queue.Queue(maxsize=depth)
         self._stop = threading.Event()
         self._exc: Optional[BaseException] = None
+        self._g_depth = self._c_empty = self._h_wait = None
+        if registry is not None:
+            self._g_depth = registry.gauge("prefetch_queue_depth", role)
+            self._c_empty = registry.counter("prefetch_empty_wait_total", role)
+            self._h_wait = registry.histogram("prefetch_empty_wait_s", role)
         self._thread = threading.Thread(target=self._worker, daemon=True)
         self._thread.start()
 
@@ -59,6 +79,8 @@ class BatchPrefetcher:
             while not self._stop.is_set():
                 try:
                     self._q.put(batch, timeout=0.1)
+                    if self._g_depth is not None:
+                        self._g_depth.set(self._q.qsize())
                     break
                 except queue.Full:
                     continue
@@ -67,6 +89,10 @@ class BatchPrefetcher:
         if self._exc is not None and self._q.empty():
             # repeated get() after a surfaced failure: fail fast, don't hang
             raise RuntimeError("prefetch worker failed") from self._exc
+        empty_at_get = self._q.empty()
+        if empty_at_get and self._c_empty is not None:
+            self._c_empty.inc()  # starvation signal: consumer outran sampler
+            t0 = time.monotonic()
         try:
             item = self._q.get(timeout=timeout)
         except queue.Empty:
@@ -74,6 +100,10 @@ class BatchPrefetcher:
                 f"prefetch worker produced nothing for {timeout}s "
                 "(replay sampler stalled or device transfer wedged)"
             ) from None
+        if self._g_depth is not None:
+            self._g_depth.set(self._q.qsize())
+            if empty_at_get:
+                self._h_wait.observe(time.monotonic() - t0)
         if item is None and self._exc is not None:
             raise RuntimeError("prefetch worker failed") from self._exc
         return item
@@ -88,7 +118,9 @@ class BatchPrefetcher:
         self._thread.join(timeout=5)
 
 
-def make_replay_prefetcher(memory, cfg, beta_fn: Callable[[], float]) -> "BatchPrefetcher":
+def make_replay_prefetcher(
+    memory, cfg, beta_fn: Callable[[], float], registry=None
+) -> "BatchPrefetcher":
     """The train-loop wiring, shared by the single-process and apex loops:
     sample -> (idx, device-staged Batch); jnp.asarray inside to_device_batch
     already performs the (async) host->device transfer, so device_put=False.
@@ -99,4 +131,6 @@ def make_replay_prefetcher(memory, cfg, beta_fn: Callable[[], float]) -> "BatchP
         s = memory.sample(cfg.batch_size, beta_fn())
         return s.idx, to_device_batch(s)
 
-    return BatchPrefetcher(_sample, depth=cfg.prefetch_depth, device_put=False)
+    return BatchPrefetcher(
+        _sample, depth=cfg.prefetch_depth, device_put=False, registry=registry
+    )

@@ -1,0 +1,217 @@
+"""Pipelined priority write-back: the depth-K in-flight ring that makes the
+learner hot path issue zero blocking host<->device transfers per step.
+
+The seed loop dispatched one jitted learn step and then immediately blocked
+on ``np.asarray(info["priorities"])`` plus the supervisor's ``float(loss)``
+NaN guard — so the prefetcher's documented overlap never happened and the
+accelerator idled between steps.  Ape-X's own semantics say that is
+unnecessary: priority updates may be stale by the pipeline depth (Horgan et
+al., arXiv:1803.00933 — the reference's updates race later samples through
+Redis anyway), and async learner architectures (IMPACT, arXiv:1912.00167)
+put the loop floor at device step time, not dispatch+sync time.
+
+Mechanics: the loop pushes each dispatched step's ``(step, idx, info)`` with
+``info`` still DEVICE arrays — including the on-device ``finite`` flag the
+learn step now computes in-graph (ops/learn.py) so the NaN/Inf guard costs
+no host round-trip.  Once more than ``depth`` entries are in flight, the
+oldest retires: its priorities/scalars are materialized (a sanctioned sync —
+by then the device has K newer steps queued, so the value is ready and the
+copy overlaps their execution) and handed back for replay write-back and the
+deferred ``TrainSupervisor.retire_ok`` check.
+
+Rollback contract (parallel/apex.py): when a retired entry is non-finite the
+caller must quarantine the retired idx AND every idx still in the ring —
+``flush()`` hands those back without touching their (poisoned) device infos
+— then roll back to a snapshot taken at a drain point, which is by
+construction >= K steps behind the poisoned step.
+
+depth=0 degenerates to the seed behaviour: push retires immediately, one
+sync per step, bitwise-identical trajectories (tests/test_writeback.py).
+"""
+
+from __future__ import annotations
+
+import collections
+import dataclasses
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from rainbow_iqn_apex_tpu.utils import hostsync
+
+
+@dataclasses.dataclass
+class RetiredStep:
+    """One learn step, materialized on host at ring retirement."""
+
+    step: int
+    idx: np.ndarray
+    priorities: np.ndarray
+    finite: bool
+    scalars: Dict[str, float]  # loss, grad_norm, q_mean, ... (host floats)
+    lag: int  # newest dispatched step - this step, at retirement
+
+
+class WritebackRing:
+    """Depth-K ring of in-flight ``(step, idx, device info)`` learn steps.
+
+    ``priorities_to_host`` customizes the priority materialization (the
+    multi-host loops pass ``multihost.local_rows`` so each host extracts its
+    local rows of the global dp-sharded array at retirement instead of at
+    dispatch).  Gauges (in-flight depth, write-back lag) land on the shared
+    obs registry when one is attached.
+    """
+
+    def __init__(
+        self,
+        depth: int,
+        registry=None,
+        role: str = "learner",
+        priorities_to_host: Optional[Callable[[Any], np.ndarray]] = None,
+    ):
+        self.depth = max(int(depth), 0)
+        self._q: collections.deque = collections.deque()
+        self._to_host = priorities_to_host
+        self._last_pushed = 0
+        self._retired_total = 0
+        self.last_lag = 0  # dispatch-to-retire lag of the newest retirement
+        self._g_depth = self._g_lag = None
+        if registry is not None:
+            self._g_depth = registry.gauge("writeback_inflight", role)
+            self._g_lag = registry.gauge("writeback_lag_steps", role)
+
+    def __len__(self) -> int:
+        return len(self._q)
+
+    @property
+    def retired_total(self) -> int:
+        return self._retired_total
+
+    def push(
+        self, step: int, idx: np.ndarray, info: Dict[str, Any]
+    ) -> Optional[RetiredStep]:
+        """Enqueue a dispatched step; returns the retired oldest entry when
+        the ring was already holding ``depth`` steps (None otherwise)."""
+        self._q.append((int(step), idx, info))
+        self._last_pushed = int(step)
+        retired = self.retire_one() if len(self._q) > self.depth else None
+        if self._g_depth is not None:
+            self._g_depth.set(len(self._q))
+        return retired
+
+    def retire_one(self) -> RetiredStep:
+        """Materialize and pop the OLDEST in-flight step (sanctioned sync)."""
+        step, idx, info = self._q.popleft()
+        with hostsync.sanctioned():
+            finite = bool(info["finite"]) if "finite" in info else True
+            pri = info["priorities"]
+            pri = np.asarray(
+                self._to_host(pri) if self._to_host is not None else pri
+            )
+            scalars = {
+                k: float(v)
+                for k, v in info.items()
+                if k not in ("priorities", "finite") and np.ndim(v) == 0
+            }
+        lag = self._last_pushed - step
+        self.last_lag = lag
+        self._retired_total += 1
+        if self._g_depth is not None:
+            self._g_depth.set(len(self._q))
+            self._g_lag.set(lag)
+        return RetiredStep(
+            step=step, idx=idx, priorities=pri, finite=finite,
+            scalars=scalars, lag=lag,
+        )
+
+    def drain(self) -> List[RetiredStep]:
+        """Retire everything in flight, oldest first (ring-boundary sync:
+        snapshot capture, weight publish, checkpoint, end of run).  Callers
+        that can roll back should prefer retiring one at a time via
+        ``retire_one`` so entries behind a tripped flag stay quarantinable."""
+        return [self.retire_one() for _ in range(len(self._q))]
+
+    def flush(self) -> List[Tuple[int, np.ndarray]]:
+        """Drop every in-flight entry WITHOUT materializing its device info
+        (it may be poisoned); returns ``[(step, idx), ...]`` oldest-first for
+        quarantine write-back."""
+        out = [(step, idx) for step, idx, _ in self._q]
+        self._q.clear()
+        if self._g_depth is not None:
+            self._g_depth.set(0)
+        return out
+
+
+def pipeline_gauges(ring: WritebackRing, registry) -> Dict[str, float]:
+    """The pipeline-health gauges every loop feeds to ``obs_run.periodic``
+    (and obs_report keys on as the ``pipeline:`` line) — one definition so
+    the three loops can't drift on the surface (docs/PERFORMANCE.md)."""
+    return {
+        "writeback_inflight": len(ring),
+        "writeback_lag_steps": ring.last_lag,
+        "prefetch_queue_depth": registry.gauge(
+            "prefetch_queue_depth", "prefetch"
+        ).get(),
+        "prefetch_empty_waits": registry.counter(
+            "prefetch_empty_wait_total", "prefetch"
+        ).get(),
+    }
+
+
+class RingCommitter:
+    """The commit/quarantine/drain protocol around a WritebackRing — ONE
+    implementation shared by the three pipelined train loops (train.py,
+    parallel/apex.py, parallel/apex_r2d2.py), which must not drift on the
+    rollback contract.
+
+    ``commit(retired)``: the deferred guard.  A finite step writes its
+    priorities back and keeps its host scalars readable via ``scalars`` (the
+    metric cadence reads these instead of syncing on the device queue).  A
+    non-finite step quarantines EVERY in-flight idx set — the tripped
+    entry's AND everything still in the ring (they were sampled/learned from
+    states downstream of the poison; |TD|=0 drops them to the eps^omega
+    priority floor so none can re-sample into a rollback livelock) — then
+    rolls back via ``load_snapshot(*supervisor.rollback())`` to the last
+    drained-and-verified snapshot, which is by construction >= the ring
+    depth behind the poison.
+
+    Multi-host note: the in-graph finite flag derives from the all-reduced
+    loss, so every host makes the SAME commit/rollback decision — provided
+    the loops call ``drain()`` at host-invariant points only (snapshot /
+    publish / eval / checkpoint cadences, which are functions of the
+    lockstep step counter).
+    """
+
+    def __init__(self, ring: WritebackRing, update_priorities, supervisor,
+                 load_snapshot):
+        self.ring = ring
+        self._update = update_priorities
+        self._sup = supervisor
+        self._load_snapshot = load_snapshot
+        self.scalars: Dict[str, float] = {}  # newest retired step's scalars
+
+    def _quarantine_and_rollback(self, bad: RetiredStep) -> None:
+        self._update(bad.idx, np.zeros(len(bad.idx)))
+        for _step_no, idx in self.ring.flush():
+            self._update(idx, np.zeros(len(idx)))
+        self._load_snapshot(*self._sup.rollback())
+
+    def commit(self, retired: Optional[RetiredStep]) -> bool:
+        """True when the step (or None) is fine; False after a quarantine +
+        rollback — the loop should ``continue``."""
+        if retired is None:
+            return True
+        if not self._sup.retire_ok(retired):
+            self._quarantine_and_rollback(retired)
+            return False
+        self._update(retired.idx, retired.priorities)
+        self.scalars.update(retired.scalars)
+        return True
+
+    def drain(self) -> bool:
+        """Ring boundary: retire everything in flight; False when one
+        tripped and we rolled back."""
+        while len(self.ring):
+            if not self.commit(self.ring.retire_one()):
+                return False
+        return True

@@ -179,6 +179,21 @@ def aggregate(rows: List[Dict[str, Any]]) -> Dict[str, Any]:
             "respawns": fault_counts.get("actor_respawn", 0),
             "evictions": fault_counts.get("actor_evicted", 0),
         },
+        # learner pipeline (docs/PERFORMANCE.md): write-back ring depth/lag
+        # plus prefetch starvation signals — lag == configured depth with an
+        # empty-wait count near zero means the hot path is device-bound (the
+        # goal); a climbing empty-wait count means the SAMPLER is the
+        # bottleneck and deeper write-back will not help
+        "pipeline": {
+            "writeback_inflight": _last_with(rows, "health", "writeback_inflight")
+            .get("writeback_inflight"),
+            "writeback_lag_steps": _last_with(rows, "health", "writeback_lag_steps")
+            .get("writeback_lag_steps"),
+            "prefetch_queue_depth": _last_with(rows, "health", "prefetch_queue_depth")
+            .get("prefetch_queue_depth"),
+            "prefetch_empty_waits": _last_with(rows, "health", "prefetch_empty_waits")
+            .get("prefetch_empty_waits"),
+        },
         "shed_total": shed_total,
         "final_eval": {
             k: v for k, v in last_eval.items()
@@ -220,6 +235,14 @@ def render(report: Dict[str, Any]) -> str:
     for name, snap in sorted((report["spans"] or {}).items()):
         lines.append(f"span {name}: {snap}")
     lines.append(f"faults: {report['faults'] or 'none'}")
+    p = report["pipeline"]
+    if any(v is not None for v in p.values()):
+        lines.append(
+            f"pipeline: writeback_inflight={p['writeback_inflight']} "
+            f"lag={p['writeback_lag_steps']} "
+            f"prefetch_depth={p['prefetch_queue_depth']} "
+            f"empty_waits={p['prefetch_empty_waits']}"
+        )
     e = report["elastic"]
     if any(e.values()):
         lines.append(

@@ -50,6 +50,8 @@ def main():
     )
     from rainbow_iqn_apex_tpu.config import Config
 
+    from rainbow_iqn_apex_tpu.parallel.multihost import local_rows
+
     if mode == "learn":
         from rainbow_iqn_apex_tpu.parallel.apex import ApexDriver
 
@@ -68,7 +70,10 @@ def main():
         for _ in range(3):
             info = driver.learn_local(local, global_size=100, beta=0.6)
             losses.append(float(info["loss"]))
-            pris = np.asarray(info["priorities"])
+            # learn_local now returns the GLOBAL dp-sharded priorities (the
+            # write-back ring extracts local rows at retirement); do the
+            # same extraction here
+            pris = local_rows(info["priorities"])
         checksum = float(
             sum(float(np.abs(np.asarray(p)).sum())
                 for p in jax.tree.leaves(driver.state.params))
@@ -112,7 +117,7 @@ def main():
         for _ in range(3):
             info = driver.learn_local(local, global_size=50, beta=0.6)
             losses.append(float(info["loss"]))
-            pris = np.asarray(info["priorities"])
+            pris = local_rows(info["priorities"])  # global -> local rows
         checksum = float(
             sum(float(np.abs(np.asarray(p)).sum())
                 for p in _jax.tree.leaves(driver.state.params))
