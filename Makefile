@@ -41,20 +41,28 @@ soak-smoke:
 
 # perf smoke: the pipelined learner hot path (utils/writeback.py ring,
 # docs/PERFORMANCE.md) must beat the per-step-sync loop on the CPU synthetic
-# apex_loop harness, and the bench rows must lint as strict JSON.  Small
-# watchdog: the toy harness finishes in well under a minute per mode.
+# apex_loop harness, the device sample frontier (replay/frontier.py) must
+# beat the host sum-tree sample path by >= 1.5x on the sample_path micro
+# row, and the bench rows must lint as strict JSON.  Small watchdog: the
+# toy harnesses finish in well under a minute per mode.
 perf-smoke:
 	rm -f /tmp/ria_perf_smoke.jsonl
-	JAX_PLATFORMS=cpu BENCH_APEX_ONLY=1 BENCH_WATCHDOG_SECS=240 \
+	JAX_PLATFORMS=cpu BENCH_APEX_ONLY=1 BENCH_WATCHDOG_SECS=300 \
 	  $(PY) bench.py | tee /tmp/ria_perf_smoke.jsonl
 	$(PY) scripts/lint_jsonl.py /tmp/ria_perf_smoke.jsonl
 	$(PY) -c "import json; rows = [json.loads(l) for l in \
 	  open('/tmp/ria_perf_smoke.jsonl') if l.strip()]; \
 	  r = [x for x in rows if x.get('path') == 'apex_loop'][-1]; \
+	  assert r.get('status') is None, 'apex_loop row: %s' % r['status']; \
 	  print('apex_loop: depth=%s %.2f steps/s vs depth0 %.2f (speedup %.3f)' \
 	        % (r['depth'], r['value'], r['depth0_steps_per_sec'], \
 	           r['speedup_vs_depth0'])); \
-	  assert r['speedup_vs_depth0'] >= 1.25, 'pipelined loop under 1.25x'"
+	  assert r['speedup_vs_depth0'] >= 1.25, 'pipelined loop under 1.25x'; \
+	  s = [x for x in rows if x.get('path') == 'sample_path'][-1]; \
+	  assert s.get('status') is None, 'sample_path row: %s' % s['status']; \
+	  print('sample_path: frontier %.1f batches/s vs host %.1f (speedup %.3f)' \
+	        % (s['value'], s['host_batches_per_sec'], s['speedup_vs_host'])); \
+	  assert s['speedup_vs_host'] >= 1.5, 'device sample path under 1.5x'"
 
 # obs smoke: a short anakin run must yield a lintable, reportable run dir —
 # obs_report prints per-role throughput / learn-step percentiles / health,

@@ -34,6 +34,14 @@ child that is truly hung (i.e. the relay was already dead), and each finished
 row is flushed immediately so a late hang can never discard an earlier
 measurement.
 
+Row budgets (round-6): every micro row (apex_loop, sample_path) runs under
+its OWN slice of the child's remaining soft budget via _run_row_budgeted —
+an overrunning row emits a labelled {"status": "timeout"} row and the rows
+behind it still run (the r05 failure dropped every row after one hang).
+The sample_path row measures the device sample frontier
+(replay/frontier.py) against the host sum-tree sample path and carries
+speedup_vs_host; `make perf-smoke` gates on >= 1.5x.
+
 Ordering (round-4 restructure): the parent FIRST runs an env-stripped
 ``JAX_PLATFORMS=cpu`` child to produce the labelled CPU fallback row — that
 child is immune to the relay's state, so a dead relay costs ~1 minute of
@@ -113,11 +121,21 @@ def measure() -> None:
     print(f"bench child: platform={platform} t_import={time.monotonic()-t_start:.1f}s",
           file=sys.stderr, flush=True)
 
-    # perf-smoke mode (make perf-smoke): only the apex_loop pipeline rows,
-    # at toy size — the full Atari-shape learn step takes minutes/step on CPU
+    # perf-smoke mode (make perf-smoke): only the pipeline micro rows
+    # (apex_loop at toy size + the sample_path micro-path) — the full
+    # Atari-shape learn step takes minutes/step on CPU.  Each row gets its
+    # OWN budget slice (r05 regression: one overrunning row must not eat
+    # the rows behind it).
     if os.environ.get("BENCH_APEX_ONLY") == "1":
-        for row in _measure_apex_loop(lambda: CHILD_BUDGET_SECS
-                                      - (time.monotonic() - t_start)):
+        for row in _run_row_budgeted(
+            "apex_loop", "apex_loop_steps_per_sec",
+            _measure_apex_loop, left, share=0.5,
+        ):
+            print(json.dumps(row), flush=True)
+        for row in _run_row_budgeted(
+            "sample_path", "replay_sample_path_batches_per_sec",
+            _measure_sample_path, left, share=0.9,
+        ):
             print(json.dumps(row), flush=True)
         return
     cfg = Config()  # reference defaults: 84x84x4, N=N'=64, K=32, batch 32
@@ -206,19 +224,25 @@ def measure() -> None:
     # row survives a hang in this phase.  Skipped on CPU (minutes per step).
     if platform == "cpu":
         # host-feed first (crash-safe: each row is kept the moment it is
-        # printed), then the apex_loop pipeline rows, then host-feed AGAIN so
-        # the headline (last stdout line) stays the cross-round comparable
-        # metric regardless of what the pipeline phase managed to measure
+        # printed), then the pipeline micro rows EACH under their own budget
+        # slice (r05 regression: one overrunning row emitted a timeout row's
+        # worth of silence and dropped every row behind it), then host-feed
+        # AGAIN so the headline (last stdout line) stays the cross-round
+        # comparable metric regardless of what the micro phases measured
         print(json.dumps(host_feed_row), flush=True)
         if left() > 45:
-            try:
-                for row in _measure_apex_loop(left):
-                    print(json.dumps(row), flush=True)
-            except Exception as e:  # noqa: BLE001 — never lose the headline
-                print(f"apex_loop bench failed, host-feed row kept: {e!r}",
-                      file=sys.stderr)
+            for row in _run_row_budgeted(
+                "apex_loop", "apex_loop_steps_per_sec",
+                _measure_apex_loop, left, share=0.45,
+            ):
+                print(json.dumps(row), flush=True)
+            for row in _run_row_budgeted(
+                "sample_path", "replay_sample_path_batches_per_sec",
+                _measure_sample_path, left, share=0.7,
+            ):
+                print(json.dumps(row), flush=True)
         else:
-            print(f"bench child: skipping apex_loop phase, {left():.0f}s left",
+            print(f"bench child: skipping micro phases, {left():.0f}s left",
                   file=sys.stderr, flush=True)
         print(json.dumps(host_feed_row))
         return
@@ -242,6 +266,170 @@ def measure() -> None:
     except Exception as e:  # noqa: BLE001 — never lose the bench row
         print(f"device-replay bench failed, host-feed row kept: {e!r}",
               file=sys.stderr)
+
+
+def _run_row_budgeted(path_name, metric, fn, left, share) -> list:
+    """Per-row time budgets (ISSUE 6 satellite; the r05 regression): each
+    bench row gets its OWN slice of the child's remaining soft budget, and a
+    row that overruns (or dies) emits a labelled ``"status": "timeout"`` /
+    ``"error"`` row instead of silently dropping itself AND every row queued
+    behind it.  ``share`` is the fraction of the remaining budget this row
+    may spend; the row's ``left`` callable is clamped to both its slice and
+    the child's global budget."""
+    t0 = time.monotonic()
+    budget = max(left() * share, 0.0)
+
+    def row_left() -> float:
+        return min(budget - (time.monotonic() - t0), left())
+
+    rows = []
+    try:
+        rows = fn(row_left) or []
+    except Exception as e:  # noqa: BLE001 — a dead row must not kill the run
+        print(f"bench: {path_name} row failed: {e!r}", file=sys.stderr)
+    if rows:
+        return rows
+    status = "timeout" if row_left() <= 0 else "error"
+    print(f"bench: {path_name} row gave up (status={status}, "
+          f"{row_left():.0f}s of its {budget:.0f}s slice left)",
+          file=sys.stderr, flush=True)
+    return [{
+        "metric": metric,
+        "value": 0.0,
+        "unit": f"{path_name} row produced no measurement",
+        "vs_baseline": None,
+        "path": path_name,
+        "status": status,
+    }]
+
+
+def _measure_sample_path(left=None) -> list:
+    """Sample-path micro bench (ISSUE 6): host sum-tree sample+assemble vs
+    device-frontier sample+gather at the Atari frame shape, one row with
+    both rates and ``speedup_vs_host`` — the >=1.5x gate in `make
+    perf-smoke` rides on this row.
+
+    Why the frontier side wins even on the CPU backend: the draw (cumsum +
+    searchsorted + IS weights over the mirrored priority vector,
+    ``draw_block`` stratified batches per fused dispatch) executes on the
+    XLA device queue and overlaps the host gather of the PREVIOUS block, so
+    the steady-state per-batch host cost is just the index-driven frame
+    gather; the host path pays tree descent + multinomial shard split +
+    per-shard assembly + concatenation + IS-weight math serially on the
+    sampling thread.  Same interleaved best-of-reps discipline as the
+    apex_loop row (the shared sandbox is contended; the fastest repetition
+    is the least-contended measurement of each mode)."""
+    if left is None:
+        left = lambda: float("inf")  # noqa: E731
+    import collections
+
+    import numpy as np
+
+    from rainbow_iqn_apex_tpu.parallel.sharded_replay import ShardedReplay
+    from rainbow_iqn_apex_tpu.replay.frontier import DeviceSampleFrontier
+
+    shards = int(os.environ.get("BENCH_SP_SHARDS", "4"))
+    cap = int(os.environ.get("BENCH_SP_CAP", str(1 << 14)))
+    lanes = int(os.environ.get("BENCH_SP_LANES", "16"))
+    iters = int(os.environ.get("BENCH_SP_ITERS", "200"))
+    reps = int(os.environ.get("BENCH_SP_REPS", "3"))
+    max_reps = int(os.environ.get("BENCH_SP_MAX_REPS", "6"))
+    block = int(os.environ.get("BENCH_SP_BLOCK", "16"))
+    B, beta = 32, 0.4
+
+    memory = ShardedReplay.build(
+        shards, cap, lanes, frame_shape=(84, 84), history=4, n_step=3, seed=0,
+    )
+    rng = np.random.default_rng(0)
+    pool = [rng.integers(0, 255, (lanes, 84, 84), dtype=np.uint8)
+            for _ in range(8)]
+    for t in range(cap // lanes):
+        if left() < 30:
+            print("bench child: sample_path budget exhausted during fill",
+                  file=sys.stderr, flush=True)
+            return []
+        memory.append_batch(
+            pool[t % 8],
+            rng.integers(0, 18, lanes),
+            rng.normal(size=lanes).astype(np.float32),
+            rng.random(lanes) < 0.01,
+            priorities=rng.random(lanes) + 0.05,
+        )
+    frontier = DeviceSampleFrontier.from_sharded(
+        memory, seed=0, draw_block=block
+    )
+
+    def run_host(n: int) -> float:
+        t0 = time.perf_counter()
+        for _ in range(n):
+            memory.sample(B, beta)
+        return n / (time.perf_counter() - t0)
+
+    def run_frontier(n: int) -> float:
+        inflight: collections.deque = collections.deque()
+        pending: collections.deque = collections.deque()
+
+        def push():
+            inflight.append(frontier.draw(B, beta, len(memory)))
+
+        for _ in range(2):
+            push()
+        done = 0
+        t0 = time.perf_counter()
+        while done < n:
+            if not pending:
+                blk = inflight.popleft()
+                push()
+                idx = np.asarray(blk.idx)
+                w = np.asarray(blk.weight)
+                for g in range(blk.groups):
+                    pending.append((idx[g], w[g]))
+            i_b, w_b = pending.popleft()
+            memory.assemble_global(i_b, w_b)
+            done += 1
+        return done / (time.perf_counter() - t0)
+
+    run_frontier(block)  # compile the draw kernel
+    run_host(4)  # touch the host path caches
+    if left() < 25:
+        print("bench child: sample_path budget exhausted after warmup",
+              file=sys.stderr, flush=True)
+        return []
+
+    best_h = best_f = 0.0
+    rep = 0
+    while rep < max_reps and left() > 15:
+        prev = (best_h, best_f)
+        order = ("host", "frontier") if rep % 2 == 0 else ("frontier", "host")
+        for mode in order:
+            if mode == "host":
+                best_h = max(best_h, run_host(iters))
+            else:
+                best_f = max(best_f, run_frontier(iters))
+            if left() < 12:
+                break
+        rep += 1
+        if rep >= reps and best_h and best_f:
+            if best_h <= prev[0] * 1.02 and best_f <= prev[1] * 1.02:
+                break  # neither best-of still improving: converged
+    if not (best_h and best_f):
+        return []
+    return [{
+        "metric": "replay_sample_path_batches_per_sec",
+        "value": round(best_f, 2),
+        "unit": (
+            f"sample+assemble batches/s (batch={B}, 84x84x4 Atari shape, "
+            f"{shards}-shard replay, {cap} slots; device-frontier "
+            f"draw_block={block} + index-driven gather vs host sum-tree "
+            f"sample path; best-of-{rep} interleaved reps x {iters} iters)"
+        ),
+        "vs_baseline": None,  # micro-path — not a learn-steps/s number
+        "path": "sample_path",
+        "host_batches_per_sec": round(best_h, 2),
+        "speedup_vs_host": round(best_f / max(best_h, 1e-9), 3),
+        "n_iters": iters,
+        "reps": rep,
+    }]
 
 
 def _measure_apex_loop(left=None) -> list:
@@ -593,13 +781,18 @@ def main() -> None:
             # keep any measurement the child completed before the watchdog
             # fired (the child prints each finished row immediately); the
             # child self-budgets and exits cleanly, so reaching this point
-            # means it was truly hung (relay dead) — surface its progress log
+            # means it was truly hung (relay dead).  Relay ONE clean line —
+            # the last non-empty stderr line is where it hung; a multi-line
+            # tail dump interleaves confusingly with the driver's own log.
             err = te.stderr or b""
             if isinstance(err, bytes):
                 err = err.decode(errors="replace")
-            tail = "\n".join(err.strip().splitlines()[-10:])
-            print(f"bench child timed out; child stderr tail:\n{tail}",
-                  file=sys.stderr)
+            last = next(
+                (ln.strip() for ln in reversed(err.strip().splitlines())
+                 if ln.strip()), "<no stderr>",
+            )
+            print(f"bench: child timed out after {timeout:.0f}s; "
+                  f"last stderr: {last}", file=sys.stderr)
             out = te.stdout or b""
             if isinstance(out, bytes):
                 out = out.decode(errors="replace")

@@ -134,6 +134,18 @@ class Config:
     # lag by exactly K steps, the staleness Ape-X already tolerates
     # (arXiv:1803.00933).  0 = seed behaviour: one blocking sync per step.
     # docs/PERFORMANCE.md has tuning guidance.
+    device_sampling: bool = False  # device-resident sample frontier
+    # (replay/frontier.py): mirror every replay shard's tree-space priority
+    # vector into HBM, draw stratified index batches + IS weights with one
+    # fused XLA kernel, assemble frames host-side at those indices via the
+    # sample-ahead pusher, and retire priority write-backs directly into the
+    # mirror (host sum-trees become the cold path, reconciled at ring
+    # drains).  Off (default) keeps the PR-5 host sampling path bitwise
+    # intact.  Single-host apex/apex_r2d2 loops only (multi-host falls back
+    # to host sampling with a logged notice).  docs/PERFORMANCE.md.
+    sample_ahead_depth: int = 2  # ready batches the sample-ahead pusher
+    # stages ahead of the learner (its bounded queue depth); 0 disables the
+    # frontier exactly like device_sampling=false
     priority_exponent: float = 0.5  # omega
     priority_weight: float = 0.4  # beta_0, annealed to 1 over training
     priority_eps: float = 1e-6

@@ -268,7 +268,11 @@ class ApexDriver:
 
     def act(self, stacked_obs: np.ndarray) -> Tuple[np.ndarray, np.ndarray]:
         a, q = self.act_async(stacked_obs)
-        return np.asarray(a), np.asarray(q)
+        # the actor->env hand-off is an OBLIGATORY host materialization (the
+        # vector env lives on host) — a sanctioned sync on the actor half,
+        # not a learner-hot-path regression (docs/PERFORMANCE.md inventory)
+        with hostsync.sanctioned():
+            return np.asarray(a), np.asarray(q)
 
     def act_frames(
         self, frames: np.ndarray, prev_cuts: np.ndarray
@@ -290,9 +294,10 @@ class ApexDriver:
             keep,
             self._next_key(),
         )
-        if jax.process_count() > 1:
-            return _local_rows(a), _local_rows(q)
-        return np.asarray(a), np.asarray(q)
+        with hostsync.sanctioned():  # obligatory actor->env hand-off
+            if jax.process_count() > 1:
+                return _local_rows(a), _local_rows(q)
+            return np.asarray(a), np.asarray(q)
 
     def learn(self, sample) -> Dict[str, Any]:
         return self.learn_batch(to_device_batch(sample))
@@ -359,7 +364,8 @@ class ApexDriver:
         """Lane-sharded inference fed from this host's local lanes."""
         obs = self._put_lanes(stacked_obs)
         a, q = self._act(self.actor_params, obs, self._next_key())
-        return _local_rows(a), _local_rows(q)
+        with hostsync.sanctioned():  # obligatory actor->env hand-off
+            return _local_rows(a), _local_rows(q)
 
     # `state` invalidates the host step mirror on direct assignment
     # (load_state / load_snapshot / tests); learn_batch bypasses the setter
@@ -488,6 +494,28 @@ def train_apex(cfg: Config, max_frames: Optional[int] = None) -> Dict[str, Any]:
         cfg.max_weight_lag, metrics=metrics, registry=obs_run.registry
     )
 
+    # device-resident sample frontier (replay/frontier.py): mirror the shard
+    # priority vectors into HBM, draw index batches + IS weights on device,
+    # and let the sample-ahead pusher assemble/push — the learner thread
+    # never walks a host sum-tree.  Off (or depth 0, or multi-host) keeps
+    # the host sampling path bitwise intact.
+    frontier = None
+    if cfg.device_sampling and cfg.sample_ahead_depth > 0:
+        if multihost:
+            # per-host mirrors of a dp-sharded global draw are a follow-up;
+            # an SPMD pod must not diverge on a per-host capability, so every
+            # host falls back together (the cfg is identical on all hosts)
+            metrics.log("notice", event="device_sampling_fallback",
+                        reason="multihost: host sampling path retained")
+        else:
+            from rainbow_iqn_apex_tpu.replay.frontier import (
+                DeviceSampleFrontier,
+            )
+
+            frontier = DeviceSampleFrontier.from_sharded(
+                memory, registry=obs_run.registry, seed=cfg.seed + 31
+            )
+
     frames = 0
     last_pub = 0
     restored = maybe_resume(cfg, ckpt, driver.state)
@@ -518,9 +546,17 @@ def train_apex(cfg: Config, max_frames: Optional[int] = None) -> Dict[str, Any]:
         cfg.writeback_depth,
         registry=obs_run.registry,
         priorities_to_host=_local_rows if multihost else None,
+        # mirror mode: retirement hands the still-on-device |TD| array to
+        # frontier.update (a jitted scatter) — the priority vector never
+        # crosses to host per step; reconcile() syncs the cold path at drains
+        materialize_priorities=frontier is None,
     )
     committer = RingCommitter(
-        ring, memory.update_priorities, sup, driver.load_snapshot
+        ring,
+        frontier.update if frontier is not None else memory.update_priorities,
+        sup,
+        driver.load_snapshot,
+        on_drain=frontier.reconcile if frontier is not None else None,
     )
     last_scalars = committer.scalars  # newest RETIRED step's host scalars
     _commit, _drain = committer.commit, committer.drain
@@ -601,7 +637,30 @@ def train_apex(cfg: Config, max_frames: Optional[int] = None) -> Dict[str, Any]:
                 else len(memory) >= learn_start and memory.sampleable
             )
             if warm:
-                if cfg.prefetch_depth > 0 and prefetcher is None:
+                if frontier is not None and prefetcher is None:
+                    # sample-ahead pusher: device-drawn index blocks,
+                    # host-DRAM frame gather, staged device batches PUSHED
+                    # into the bounded queue — the learner only pops
+                    from rainbow_iqn_apex_tpu.replay.frontier import (
+                        make_batch_assembler,
+                    )
+                    from rainbow_iqn_apex_tpu.utils.prefetch import (
+                        SampleAheadPusher,
+                    )
+
+                    prefetcher = SampleAheadPusher(
+                        frontier,
+                        make_batch_assembler(
+                            memory, to_device_batch,
+                            registry=obs_run.registry,
+                        ),
+                        cfg.batch_size,
+                        lambda: priority_beta(cfg, frames),
+                        lambda: len(memory),
+                        depth=cfg.sample_ahead_depth,
+                        registry=obs_run.registry,
+                    )
+                elif cfg.prefetch_depth > 0 and prefetcher is None:
                     if multihost:
                         # overlap the host-side local sample/assembly with
                         # the device step; the collective-bearing
@@ -723,7 +782,7 @@ def train_apex(cfg: Config, max_frames: Optional[int] = None) -> Dict[str, Any]:
                             weight_staleness=step - last_pub,
                             weights_version=driver.weights_version,
                             weight_version_lag=fence.lag,
-                            **pipeline_gauges(ring, obs_run.registry),
+                            **pipeline_gauges(ring, obs_run.registry, frontier),
                         )
                         if monitor is not None:
                             # a preempted host stops heartbeating; the
@@ -794,6 +853,10 @@ def train_apex(cfg: Config, max_frames: Optional[int] = None) -> Dict[str, Any]:
         {"frames": frames, "weights_version": driver.weights_version,
                              **rng_extra(driver.key)}, critical=True,
     )
+    if frontier is not None:
+        # the final drain may have been skipped by a rollback: catch the
+        # cold-path trees up before they are persisted
+        frontier.reconcile()
     sup.save_replay(cfg, memory, critical=True)
     ckpt.wait()
     metrics.close()

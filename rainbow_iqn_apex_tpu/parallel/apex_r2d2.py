@@ -221,21 +221,29 @@ class R2D2ApexDriver:
         Multi-host: this host feeds/reads only its local lane rows; the
         carried LSTM state stays device-resident and lane-sharded over the
         global actor mesh."""
+        # the actor->env hand-off (actions) and the stored-state snapshot
+        # the sequence replay requires are OBLIGATORY host materializations
+        # on the actor half — sanctioned syncs, not learner-hot-path
+        # regressions (docs/PERFORMANCE.md inventory)
         if self._multihost:
-            pre_c = _local_rows(self.lstm_state[0])
-            pre_h = _local_rows(self.lstm_state[1])
+            with hostsync.sanctioned():
+                pre_c = _local_rows(self.lstm_state[0])
+                pre_h = _local_rows(self.lstm_state[1])
             x = self._put_lanes(as_actor_input(obs, self.cfg.history_length))
             a, _q, self.lstm_state = self._act(
                 self.actor_params, x, self.lstm_state, self._next_key()
             )
-            return _local_rows(a), (pre_c, pre_h)
-        pre_c = np.asarray(self.lstm_state[0])
-        pre_h = np.asarray(self.lstm_state[1])
+            with hostsync.sanctioned():
+                return _local_rows(a), (pre_c, pre_h)
+        with hostsync.sanctioned():
+            pre_c = np.asarray(self.lstm_state[0])
+            pre_h = np.asarray(self.lstm_state[1])
         x = as_actor_input(obs, self.cfg.history_length)
         a, _q, self.lstm_state = self._act(
             self.actor_params, x, self.lstm_state, self._next_key()
         )
-        return np.asarray(a), (pre_c, pre_h)
+        with hostsync.sanctioned():
+            return np.asarray(a), (pre_c, pre_h)
 
     def reset_lanes(self, cuts: np.ndarray) -> None:
         keep = self._put_lanes(1.0 - cuts.astype(np.float32))
@@ -249,12 +257,13 @@ class R2D2ApexDriver:
         (zeroing lanes cut LAST tick) and act; returns (actions, pre-step
         LSTM state snapshot) exactly like act().  The LSTM state itself is
         reset separately via reset_lanes (the loop's existing contract)."""
-        if self._multihost:
-            pre_c = _local_rows(self.lstm_state[0])
-            pre_h = _local_rows(self.lstm_state[1])
-        else:
-            pre_c = np.asarray(self.lstm_state[0])
-            pre_h = np.asarray(self.lstm_state[1])
+        with hostsync.sanctioned():  # stored-state snapshot (actor half)
+            if self._multihost:
+                pre_c = _local_rows(self.lstm_state[0])
+                pre_h = _local_rows(self.lstm_state[1])
+            else:
+                pre_c = np.asarray(self.lstm_state[0])
+                pre_h = np.asarray(self.lstm_state[1])
         if self.actor_stack is None:
             h, w = frames.shape[1], frames.shape[2]
             self.actor_stack = self._put_lanes(
@@ -269,9 +278,10 @@ class R2D2ApexDriver:
             self.lstm_state,
             self._next_key(),
         )
-        if self._multihost:
-            return _local_rows(a), (pre_c, pre_h)
-        return np.asarray(a), (pre_c, pre_h)
+        with hostsync.sanctioned():  # obligatory actor->env hand-off
+            if self._multihost:
+                return _local_rows(a), (pre_c, pre_h)
+            return np.asarray(a), (pre_c, pre_h)
 
     def learn_batch(self, batch: SequenceBatch) -> Dict[str, Any]:
         """Dispatch one sequence learn step; ``info`` stays DEVICE arrays
@@ -409,6 +419,24 @@ def train_apex_r2d2(cfg: Config, max_frames: Optional[int] = None) -> Dict[str, 
         cfg.max_weight_lag, metrics=metrics, registry=obs_run.registry
     )
 
+    # device-resident sample frontier over the sequence tree (same contract
+    # as train_apex — the two drivers must not drift on the sampling
+    # surface): draws + IS weights in HBM, host gather via the pusher,
+    # write-back retiring into the mirror, cold-path reconcile at drains
+    frontier = None
+    if cfg.device_sampling and cfg.sample_ahead_depth > 0:
+        if multihost:
+            metrics.log("notice", event="device_sampling_fallback",
+                        reason="multihost: host sampling path retained")
+        else:
+            from rainbow_iqn_apex_tpu.replay.frontier import (
+                DeviceSampleFrontier,
+            )
+
+            frontier = DeviceSampleFrontier.from_sequence(
+                memory, registry=obs_run.registry, seed=cfg.seed + 31
+            )
+
     frames = 0
     last_pub = 0
     restored = maybe_resume(cfg, ckpt, driver.state)
@@ -439,9 +467,14 @@ def train_apex_r2d2(cfg: Config, max_frames: Optional[int] = None) -> Dict[str, 
         cfg.writeback_depth,
         registry=obs_run.registry,
         priorities_to_host=_local_rows if multihost else None,
+        materialize_priorities=frontier is None,
     )
     committer = RingCommitter(
-        ring, memory.update_priorities, sup, driver.load_snapshot
+        ring,
+        frontier.update if frontier is not None else memory.update_priorities,
+        sup,
+        driver.load_snapshot,
+        on_drain=frontier.reconcile if frontier is not None else None,
     )
     last_scalars = committer.scalars
     _commit, _drain = committer.commit, committer.drain
@@ -483,7 +516,24 @@ def train_apex_r2d2(cfg: Config, max_frames: Optional[int] = None) -> Dict[str, 
                 else len(memory) >= learn_start_seqs
             )
             if warm:
-                if cfg.prefetch_depth > 0 and prefetcher is None:
+                if frontier is not None and prefetcher is None:
+                    from rainbow_iqn_apex_tpu.utils.prefetch import (
+                        SampleAheadPusher,
+                    )
+
+                    prefetcher = SampleAheadPusher(
+                        frontier,
+                        lambda idx, w: (
+                            idx,
+                            to_device_seq_batch(memory.assemble_idx(idx, w)),
+                        ),
+                        cfg.batch_size,
+                        lambda: priority_beta(cfg, frames),
+                        lambda: len(memory),
+                        depth=cfg.sample_ahead_depth,
+                        registry=obs_run.registry,
+                    )
+                elif cfg.prefetch_depth > 0 and prefetcher is None:
                     if multihost:
                         # host-side local sample only; the collective-bearing
                         # learn_local stays on the main thread
@@ -593,7 +643,7 @@ def train_apex_r2d2(cfg: Config, max_frames: Optional[int] = None) -> Dict[str, 
                             weight_staleness=step - last_pub,
                             weights_version=driver.weights_version,
                             weight_version_lag=fence.lag,
-                            **pipeline_gauges(ring, obs_run.registry),
+                            **pipeline_gauges(ring, obs_run.registry, frontier),
                         )
                         if monitor is not None:
                             # same lease-edge reporting as train_apex: one
@@ -652,6 +702,10 @@ def train_apex_r2d2(cfg: Config, max_frames: Optional[int] = None) -> Dict[str, 
         {"frames": frames, "weights_version": driver.weights_version,
                              **rng_extra(driver.key)}, critical=True,
     )
+    if frontier is not None:
+        # the final drain may have been skipped by a rollback: catch the
+        # cold-path tree up before it is persisted
+        frontier.reconcile()
     sup.save_replay(cfg, memory, critical=True)
     ckpt.wait()
     metrics.close()

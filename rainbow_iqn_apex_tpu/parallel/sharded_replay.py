@@ -18,7 +18,7 @@ from typing import List, Optional, Sequence, Tuple
 import numpy as np
 
 from rainbow_iqn_apex_tpu.replay.buffer import PrioritizedReplay, SampledBatch
-from rainbow_iqn_apex_tpu.utils import faults
+from rainbow_iqn_apex_tpu.utils import faults, hostsync
 
 
 class ShardedReplay:
@@ -52,6 +52,7 @@ class ShardedReplay:
         self._epoch: List[int] = [0] * len(self.shards)
         self._fenced_writes = 0
         self._reg = None  # obs registry (attach_registry); None = untracked
+        self._frontier = None  # device sample frontier (attach_frontier)
 
     def attach_registry(self, registry, role: str = "replay") -> None:
         """obs/ wiring: appended/sampled row counters + occupancy and
@@ -59,6 +60,32 @@ class ShardedReplay:
         self._reg = registry
         self._role = role
         registry.gauge("replay_shards", role).set(len(self.shards))
+
+    def attach_frontier(self, frontier) -> None:
+        """Device-sampling wiring (replay/frontier.py): subsequent appends
+        stage their tree leaf deltas to the HBM priority mirror, and shard
+        drop/readmit fence the mirror alongside the host epoch."""
+        self._frontier = frontier
+
+    def _stage_frontier_delta(self, k: int, shard: PrioritizedReplay,
+                              pos_before: int) -> None:
+        """Mirror one append tick's three disjoint leaf updates (fresh slot,
+        cursor dead zone, ready slot — see buffer._append_locked) by reading
+        the freshly written tree values back: works identically for the
+        NumPy and native-core append paths, and re-staging an unchanged
+        ready value is harmless."""
+        seg = shard.seg
+        new_pos = (pos_before + 1) % seg
+        cols = np.concatenate([
+            np.asarray(
+                [pos_before, (pos_before - shard.n_step) % seg], np.int64
+            ),
+            (new_pos + np.arange(shard.history, dtype=np.int64)) % seg,
+        ])
+        slots = (shard._lane_base[:, None] + cols[None, :]).ravel()
+        self._frontier.stage(
+            k * self.shard_capacity + slots, shard.tree.get(slots)
+        )
 
     def _observe(self) -> None:
         if self._reg is None:
@@ -106,6 +133,7 @@ class ShardedReplay:
             if k in self._dead:
                 continue
             sl = slice(k * lps, (k + 1) * lps)
+            pos_before = shard.pos
             shard.append_batch(
                 frames[sl],
                 actions[sl],
@@ -114,6 +142,8 @@ class ShardedReplay:
                 None if priorities is None else priorities[sl],
                 None if truncations is None else truncations[sl],
             )
+            if self._frontier is not None:
+                self._stage_frontier_delta(k, shard, pos_before)
             if self._reg is not None:
                 self._reg.counter("replay_appended_rows", self._role).inc(lps)
         self._observe()
@@ -143,7 +173,12 @@ class ShardedReplay:
             raise ValueError(f"no shard {k} (have {len(self.shards)})")
         if len(self._dead) >= len(self.shards) - 1 and k not in self._dead:
             raise RuntimeError("cannot drop the last surviving replay shard")
+        already = k in self._dead
         self._dead.add(k)
+        if self._frontier is not None and not already:
+            # fence the HBM mirror too: zero the slice so device draws
+            # renormalise over survivors exactly like the host sample
+            self._frontier.on_drop(k)
         self._observe()
 
     @property
@@ -199,6 +234,12 @@ class ShardedReplay:
                 )
         self._dead.discard(k)
         self._epoch[k] = new_epoch
+        if self._frontier is not None:
+            # the mirror re-reads the readmitted shard's host tree (the cold
+            # source of truth the rejoining host restored) under a fresh
+            # frontier epoch, so sample-ahead batches drawn pre-readmission
+            # are countable as stale
+            self._frontier.on_readmit(k)
         if self._reg is not None:
             self._reg.counter("replay_shard_readmits", self._role).inc()
         self._observe()
@@ -234,9 +275,12 @@ class ShardedReplay:
             raise ValueError(f"no shard {k} (have {len(self.shards)})")
         if not self._fence(k, epoch):
             return False
+        pos_before = self.shards[k].pos
         self.shards[k].append_batch(
             frames, actions, rewards, terminals, priorities, truncations
         )
+        if self._frontier is not None:
+            self._stage_frontier_delta(k, self.shards[k], pos_before)
         if self._reg is not None:
             self._reg.counter("replay_appended_rows", self._role).inc(
                 len(actions)
@@ -262,6 +306,7 @@ class ShardedReplay:
     def sample(self, batch_size: int, beta: float) -> SampledBatch:
         """Proportional global sample: shard k contributes ~ its share of the
         total priority mass (multinomial split), then samples locally."""
+        hostsync.check_host_work("replay_sample")
         totals = np.asarray(
             [
                 0.0 if k in self._dead else s.tree.total
@@ -313,6 +358,89 @@ class ShardedReplay:
             discount=cat("discount"),
             weight=weight,
             prob=prob,
+        )
+
+    def eligible_mask(self, idx: np.ndarray) -> np.ndarray:
+        """True where global slot ``idx`` is CURRENTLY eligible (host-tree
+        leaf > 0 on an alive shard).  The append path maintains the
+        invariant that every slot whose history/n-step window would cross
+        the write cursor carries zero priority, so a sample-ahead batch can
+        re-check its device-drawn indices at GATHER time: rows invalidated
+        by cursor movement since the draw read as False (their assembly
+        would mix frames from two ring laps) and get their IS weight zeroed
+        instead of training on straddled transitions."""
+        idx = np.asarray(idx, np.int64).ravel()
+        shard_of = idx // self.shard_capacity
+        local = idx % self.shard_capacity
+        ok = np.zeros(idx.shape[0], bool)
+        in_range = (idx >= 0) & (idx < len(self.shards) * self.shard_capacity)
+        for k, shard in enumerate(self.shards):
+            if k in self._dead:
+                continue
+            m = (shard_of == k) & in_range
+            if m.any():
+                ok[m] = shard.tree.get(local[m]) > 0
+        return ok
+
+    def assemble_global(
+        self,
+        idx: np.ndarray,
+        weight: np.ndarray,
+        prob: Optional[np.ndarray] = None,
+    ) -> SampledBatch:
+        """Index-driven batch assembly at already-drawn global slot ids (the
+        device-sampling hot path: the frontier drew ``idx`` and computed
+        ``weight`` in HBM; the host's remaining job is this frame gather).
+
+        Rows come back sorted by slot id.  PER batches are exchangeable —
+        per-row weights/probs travel with their rows — and the frontier's
+        stratified draw emits slot-sorted indices already, so sorting is
+        usually a no-op; it makes every shard's rows one CONTIGUOUS slice
+        of the output, which the native core fills IN PLACE (zero extra
+        copies — the host sample path's per-shard concatenate pays one
+        full batch copy here)."""
+        idx = np.asarray(idx, np.int64).ravel()
+        weight = np.asarray(weight, np.float32).ravel()
+        B = idx.shape[0]
+        n_slots = len(self.shards) * self.shard_capacity
+        if B and (idx.min() < 0 or idx.max() >= n_slots):
+            # match PrioritizedReplay.assemble: silent np.empty rows for
+            # out-of-range ids would train on garbage
+            raise IndexError(f"assemble_global idx out of range [0, {n_slots})")
+        if np.any(idx[1:] < idx[:-1]):  # host callers may pass unsorted
+            order = np.argsort(idx, kind="stable")
+            idx, weight = idx[order], weight[order]
+            if prob is not None:
+                prob = np.asarray(prob).ravel()[order]
+        shard_of = idx // self.shard_capacity
+        local = idx % self.shard_capacity
+        s0 = self.shards[0]
+        h, w = s0.frames.shape[1], s0.frames.shape[2]
+        obs = np.empty((B, h, w, s0.history), np.uint8)
+        next_obs = np.empty_like(obs)
+        action = np.empty(B, np.int32)
+        reward = np.empty(B, np.float32)
+        discount = np.empty(B, np.float32)
+        bounds = np.searchsorted(shard_of, np.arange(len(self.shards) + 1))
+        for k, shard in enumerate(self.shards):
+            lo, hi = int(bounds[k]), int(bounds[k + 1])
+            if lo == hi:
+                continue
+            sl = slice(lo, hi)
+            shard.assemble(local[sl], out=(
+                obs[sl], next_obs[sl], action[sl], reward[sl], discount[sl],
+            ))
+        if self._reg is not None:
+            self._reg.counter("replay_sampled_rows", self._role).inc(B)
+        return SampledBatch(
+            idx=idx,
+            obs=obs,
+            action=action,
+            reward=reward,
+            next_obs=next_obs,
+            discount=discount,
+            weight=weight,
+            prob=None if prob is None else np.asarray(prob).ravel(),
         )
 
     # -------------------------------------------------------------- snapshot
@@ -368,6 +496,8 @@ class ShardedReplay:
                     meta["dead_shards"], np.int64)}
         except snapshot_io.MISSING:
             pass
+        if self._frontier is not None:
+            self._frontier.refresh_from_host(dead=self._dead)
 
     # -------------------------------------------------------------- priorities
     def update_priorities(self, idx: np.ndarray, td_abs: np.ndarray) -> None:

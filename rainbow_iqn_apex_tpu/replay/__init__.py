@@ -1,4 +1,5 @@
 from rainbow_iqn_apex_tpu.replay.buffer import PrioritizedReplay, SampledBatch
+from rainbow_iqn_apex_tpu.replay.frontier import DeviceSampleFrontier
 from rainbow_iqn_apex_tpu.replay.native import NativeSumTree, native_available
 from rainbow_iqn_apex_tpu.replay.sumtree import SumTree
 
@@ -8,4 +9,5 @@ __all__ = [
     "SumTree",
     "NativeSumTree",
     "native_available",
+    "DeviceSampleFrontier",
 ]

@@ -29,6 +29,7 @@ from typing import Optional, Tuple
 import numpy as np
 
 from rainbow_iqn_apex_tpu.replay.sumtree import SumTree
+from rainbow_iqn_apex_tpu.utils import hostsync
 
 
 @dataclasses.dataclass
@@ -256,30 +257,49 @@ class PrioritizedReplay:
 
     def sample(self, batch_size: int, beta: float) -> SampledBatch:
         """Stratified proportional sample + n-step assembly + IS weights."""
+        hostsync.check_host_work("replay_sample")
         with self._lock:
             return self._sample_locked(batch_size, beta)
 
     def _sample_locked(self, batch_size: int, beta: float) -> SampledBatch:
         idx, prob = self.tree.sample_stratified(batch_size, self.rng)
         prob = np.maximum(prob, 1e-12)  # fp edge-fall can land on a zero leaf
+        obs, next_obs, action, reward, discount = self._assemble_locked(idx)
+        n = len(self)
+        weights = (n * prob) ** (-beta)
+        weights = (weights / weights.max()).astype(np.float32)
+        return SampledBatch(
+            idx=idx,
+            obs=obs,
+            action=action,
+            reward=reward,
+            next_obs=next_obs,
+            discount=discount,
+            weight=weights,
+            prob=prob,
+        )
+
+    def assemble(self, idx: np.ndarray, out=None):
+        """n-step assembly + stack gathers at already-drawn slot ids (the
+        device-sampling gather path: the frontier drew ``idx`` on device;
+        the host's job is this index-driven gather).  Returns
+        ``(obs, next_obs, action, reward, discount)`` in ``idx`` order.
+        ``out``, when given, receives the rows in place (contiguous row
+        slices of a larger batch — zero-copy on the native core)."""
+        idx = np.ascontiguousarray(np.asarray(idx, np.int64).ravel())
+        if idx.size and (idx.min() < 0 or idx.max() >= self.capacity):
+            # the native core would read out of bounds — fail loudly instead
+            raise IndexError(
+                f"assemble idx out of range [0, {self.capacity})"
+            )
+        with self._lock:
+            return self._assemble_locked(idx, out)
+
+    def _assemble_locked(self, idx: np.ndarray, out=None):
+        batch_size = idx.shape[0]
         if self._core is not None:
             # v2: n-step scan + both stack gathers in one native call
-            obs, next_obs, action, reward, discount = self._core.assemble(
-                idx, batch_size
-            )
-            n = len(self)
-            weights = (n * prob) ** (-beta)
-            weights = (weights / weights.max()).astype(np.float32)
-            return SampledBatch(
-                idx=idx,
-                obs=obs,
-                action=action,
-                reward=reward,
-                next_obs=next_obs,
-                discount=discount,
-                weight=weights,
-                prob=prob,
-            )
+            return self._core.assemble(idx, batch_size, out=out)
         lane = idx // self.seg
         off = idx % self.seg
 
@@ -300,22 +320,16 @@ class PrioritizedReplay:
 
         obs = self._gather_stacks(lane, off)
         next_obs = self._gather_stacks(lane, (off + self.n_step) % self.seg)
-
-        # --- IS weights ---------------------------------------------------
-        n = len(self)
-        weights = (n * prob) ** (-beta)
-        weights = (weights / weights.max()).astype(np.float32)
-
-        return SampledBatch(
-            idx=idx,
-            obs=obs,
-            action=self.actions[lane * self.seg + off],
-            reward=reward.astype(np.float32),
-            next_obs=next_obs,
-            discount=discount,
-            weight=weights,
-            prob=prob,
-        )
+        action = self.actions[lane * self.seg + off]
+        reward = reward.astype(np.float32)
+        if out is not None:  # NumPy fallback: one copy into the caller rows
+            out[0][:] = obs
+            out[1][:] = next_obs
+            out[2][:] = action
+            out[3][:] = reward
+            out[4][:] = discount
+            return out
+        return (obs, next_obs, action, reward, discount)
 
     # -------------------------------------------------------------- snapshot
     def snapshot(self, path: str) -> None:

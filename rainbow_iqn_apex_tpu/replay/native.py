@@ -203,14 +203,22 @@ class ReplayCore:
         )
         return mp.value
 
-    def assemble(self, idx: np.ndarray, batch_size: int):
+    def assemble(self, idx: np.ndarray, batch_size: int, out=None):
+        """``out`` (obs, next_obs, action, reward, discount), when given,
+        receives the rows in place — C-contiguous row slices of a caller's
+        batch buffers are accepted, so a shard-sorted gather (the device
+        sample frontier's draw returns slot-sorted indices) fills the final
+        batch with ZERO extra copies."""
         b = self._b
         h, w = b.frames.shape[1], b.frames.shape[2]
-        obs = np.empty((batch_size, h, w, b.history), np.uint8)
-        next_obs = np.empty_like(obs)
-        action = np.empty(batch_size, np.int32)
-        reward = np.empty(batch_size, np.float32)
-        discount = np.empty(batch_size, np.float32)
+        if out is None:
+            obs = np.empty((batch_size, h, w, b.history), np.uint8)
+            next_obs = np.empty_like(obs)
+            action = np.empty(batch_size, np.int32)
+            reward = np.empty(batch_size, np.float32)
+            discount = np.empty(batch_size, np.float32)
+        else:
+            obs, next_obs, action, reward, discount = out
         self._lib.rb_assemble(
             b.frames.reshape(b.frames.shape[0], -1),
             b.actions, b.rewards,

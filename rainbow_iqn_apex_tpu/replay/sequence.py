@@ -34,6 +34,7 @@ from typing import Optional, Tuple
 import numpy as np
 
 from rainbow_iqn_apex_tpu.replay.sumtree import SumTree
+from rainbow_iqn_apex_tpu.utils import hostsync
 
 
 @dataclasses.dataclass
@@ -92,6 +93,7 @@ class SequenceReplay:
         # same single-writer discipline as PrioritizedReplay: serialise
         # append/sample/update so a prefetch thread never sees partial state
         self._lock = threading.Lock()
+        self._frontier = None  # device sample frontier (attach_frontier)
 
         # ---- per-lane builders: step data + the actor LSTM state BEFORE
         # each buffered step (so any window start has its exact state) ------
@@ -174,6 +176,10 @@ class SequenceReplay:
         self.init_c[slot] = self._buf_c[lane, 0]
         self.init_h[slot] = self._buf_h[lane, 0]
         self.tree.set(np.asarray([slot]), np.asarray([self.max_priority]))
+        if self._frontier is not None:
+            self._frontier.stage(
+                np.asarray([slot]), np.asarray([self.max_priority])
+            )
         self.pos = (self.pos + 1) % self.capacity
         self.filled = min(self.filled + 1, self.capacity)
 
@@ -201,10 +207,40 @@ class SequenceReplay:
     def sampleable(self) -> bool:
         return self.tree.total > 0
 
+    def attach_frontier(self, frontier) -> None:
+        """Device-sampling wiring (replay/frontier.py): emitted sequences
+        stage their slot priority to the HBM mirror."""
+        self._frontier = frontier
+
     # -------------------------------------------------------------- sampling
     def sample(self, batch_size: int, beta: float) -> SequenceSample:
+        hostsync.check_host_work("replay_sample")
         with self._lock:
             return self._sample_locked(batch_size, beta)
+
+    def assemble_idx(
+        self, idx: np.ndarray, weight: np.ndarray,
+        prob: Optional[np.ndarray] = None,
+    ) -> SequenceSample:
+        """Index-driven sequence gather at already-drawn slot ids (the
+        device-sampling path: the frontier drew ``idx`` and computed
+        ``weight`` in HBM)."""
+        idx = np.asarray(idx, np.int64).ravel()
+        if idx.size and (idx.min() < 0 or idx.max() >= self.capacity):
+            raise IndexError(f"assemble idx out of range [0, {self.capacity})")
+        with self._lock:
+            return SequenceSample(
+                idx=idx,
+                obs=self.frames[idx][..., None],
+                action=self.actions[idx],
+                reward=self.rewards[idx],
+                done=self.dones[idx],
+                valid=self.valids[idx],
+                init_c=self.init_c[idx],
+                init_h=self.init_h[idx],
+                weight=np.asarray(weight, np.float32).ravel(),
+                prob=None if prob is None else np.asarray(prob).ravel(),
+            )
 
     def _sample_locked(self, batch_size: int, beta: float) -> SequenceSample:
         idx, prob = self.tree.sample_stratified(batch_size, self.rng)
@@ -283,3 +319,5 @@ class SequenceReplay:
             self.pos = int(z["pos"])
             self.filled = int(z["filled"])
             self.max_priority = float(z["max_priority"])
+        if self._frontier is not None:
+            self._frontier.refresh_from_host()

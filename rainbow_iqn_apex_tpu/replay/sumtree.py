@@ -16,7 +16,7 @@ Layout: classic implicit binary heap over a power-of-two leaf span.
 
 from __future__ import annotations
 
-from typing import Tuple
+from typing import Optional, Tuple
 
 import numpy as np
 
@@ -36,9 +36,22 @@ class SumTree:
     def total(self) -> float:
         return float(self.tree[1])
 
-    def max_leaf(self) -> float:
+    def max_leaf(self, filled: Optional[int] = None, lanes: int = 1) -> float:
+        """Max leaf priority, clamped to WRITTEN slots when the caller's
+        ring geometry is given: ``filled`` is the per-lane written count and
+        ``lanes`` the lane count of a multi-lane ring (lane ``l`` owns the
+        contiguous leaf block ``[l*seg, l*seg + seg)``, written prefix
+        ``filled``).  Without the clamp the scan covers never-written slots
+        too — a restored/partially rebuilt tree whose unwritten span carries
+        residue would leak it into the fresh-item default priority
+        (``max_priority`` re-seeding after restore/readmission)."""
         leaves = self.tree[self.span : self.span + self.capacity]
-        return float(leaves.max()) if self.capacity else 0.0
+        if filled is not None:
+            seg = self.capacity // max(int(lanes), 1)
+            filled = min(int(filled), seg)
+            mask = (np.arange(self.capacity) % max(seg, 1)) < filled
+            leaves = leaves[mask]
+        return float(leaves.max()) if leaves.size else 0.0
 
     def min_leaf_nonzero(self) -> float:
         leaves = self.tree[self.span : self.span + self.capacity]

@@ -12,6 +12,9 @@ makes that failure loud:
 - ``to_host(x)`` / ``scalar(x)``: the sanctioned materialization calls
   (WritebackRing retirement, supervisor snapshots, cadence reads).  Inside a
   ``forbid_host_sync()`` region they only work under ``sanctioned()``.
+- ``check_host_work(tag)``: the same fence for tagged host-side hot-path
+  WORK rather than transfers — host replay sampling joined the forbidden
+  set when the device sample frontier landed (replay/frontier.py).
 - ``forbid_host_sync()``: the tier-1 guard context.  It layers two fences:
   (1) ``jax.transfer_guard_device_to_host("disallow")`` — catches real
   device->host copies on accelerator backends; vacuous on the CPU platform
@@ -76,6 +79,24 @@ def to_host(x: Any) -> np.ndarray:
         )
     with sanctioned():
         return np.asarray(x)
+
+
+def check_host_work(tag: str) -> None:
+    """Forbidden-set membership check for tagged host-side hot-path WORK —
+    not a transfer, but work the zero-sync learner thread must delegate.
+    Replay SAMPLING joined the set with the device sample frontier
+    (replay/frontier.py): ``PrioritizedReplay.sample`` /
+    ``ShardedReplay.sample`` / ``SequenceReplay.sample`` call this, so a
+    learner thread inside ``forbid_host_sync()`` that walks a host sum-tree
+    per step (instead of consuming the sample-ahead pusher's device-drawn
+    batches) fails tier-1 loudly.  Worker threads (prefetcher, pusher) are
+    unaffected — the flags are thread-local."""
+    if _forbidden():
+        raise HostSyncError(
+            f"host-side '{tag}' on a thread inside a forbid_host_sync() "
+            "region (delegate it to a worker, or wrap a cold-path call in "
+            "sanctioned())"
+        )
 
 
 def scalar(x: Any) -> float:

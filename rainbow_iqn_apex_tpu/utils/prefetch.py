@@ -17,6 +17,7 @@ step (docs/PERFORMANCE.md has the sync-point inventory).
 
 from __future__ import annotations
 
+import collections
 import queue
 import threading
 import time
@@ -116,6 +117,92 @@ class BatchPrefetcher:
         except queue.Empty:
             pass
         self._thread.join(timeout=5)
+
+
+class SampleAheadPusher(BatchPrefetcher):
+    """Sample-ahead PUSH pipeline over the device sample frontier
+    (replay/frontier.py): the worker consumes device-drawn index blocks,
+    assembles frames from host DRAM at those indices, stages them to the
+    device, and pushes ready ``(idx, batch)`` pairs into the learner's
+    bounded queue — the learner never initiates sampling, it only pops.
+
+    Mechanics per worker turn: keep ``draw_ahead`` index BLOCKS (each
+    ``draw_block`` stratified batches in one fused dispatch — the dispatch
+    overhead amortisation the sample_path bench row measures) in flight on
+    device; materialize the oldest block on THIS thread (the guard flags
+    are thread-local, so the learner's ``forbid_host_sync()`` region is
+    untouched); then gather one batch per turn through ``assemble_fn``.
+
+    Extra gauges on the shared registry (role ``prefetch``; surfaced in
+    obs_report's ``pipeline:`` line):
+
+      sample_ahead_queue_depth          staged batches ready to pop
+      sample_ahead_stale_indices_total  rows served across a shard
+                                        drop/readmit epoch flip (the
+                                        accepted sample-ahead staleness,
+                                        made visible)
+
+    ``prefetch_queue_depth`` / ``prefetch_empty_wait_*`` stay live through
+    the base class, so existing starvation triage keeps working.
+    """
+
+    def __init__(
+        self,
+        frontier,
+        assemble_fn: Callable[[Any, Any], Any],  # (idx, weight) -> item
+        batch_size: int,
+        beta_fn: Callable[[], float],
+        n_items_fn: Callable[[], int],
+        depth: int = 2,
+        draw_ahead: int = 2,
+        registry=None,
+        role: str = "prefetch",
+    ):
+        self.frontier = frontier
+        self._assemble = assemble_fn
+        self._B = int(batch_size)
+        self._beta_fn = beta_fn
+        self._n_items_fn = n_items_fn
+        self._draw_ahead = max(int(draw_ahead), 1)
+        self._blocks: collections.deque = collections.deque()
+        self._batches: collections.deque = collections.deque()
+        self._g_sa_depth = self._c_stale = None
+        if registry is not None:
+            self._g_sa_depth = registry.gauge("sample_ahead_queue_depth", role)
+            self._c_stale = registry.counter(
+                "sample_ahead_stale_indices_total", role
+            )
+        super().__init__(
+            self._produce, depth=max(int(depth), 1), device_put=False,
+            registry=registry, role=role,
+        )
+
+    def _produce(self):
+        while len(self._blocks) < self._draw_ahead:
+            self._blocks.append(self.frontier.draw(
+                self._B, self._beta_fn(), self._n_items_fn()
+            ))
+        if not self._batches:
+            import numpy as np
+
+            block = self._blocks.popleft()
+            # worker-thread sync: by now draw_ahead-1 newer blocks are queued
+            # behind it on device, so the values are (nearly always) ready
+            idx = np.asarray(block.idx)
+            weight = np.asarray(block.weight)
+            stale = self.frontier.stale_rows(idx, block.stamp)
+            if stale and self._c_stale is not None:
+                self._c_stale.inc(stale)
+            for g in range(block.groups):
+                self._batches.append((idx[g].astype(np.int64), weight[g]))
+        idx_b, w_b = self._batches.popleft()
+        return self._assemble(idx_b, w_b)
+
+    def get(self, timeout: float = 60.0):
+        item = super().get(timeout=timeout)
+        if self._g_sa_depth is not None:
+            self._g_sa_depth.set(self._q.qsize())
+        return item
 
 
 def make_replay_prefetcher(

@@ -42,11 +42,16 @@ from rainbow_iqn_apex_tpu.utils import hostsync
 
 @dataclasses.dataclass
 class RetiredStep:
-    """One learn step, materialized on host at ring retirement."""
+    """One learn step, materialized on host at ring retirement.
+
+    With ``materialize_priorities=False`` (device sampling: the write-back
+    target is the HBM priority mirror, replay/frontier.py) ``priorities``
+    stays the DEVICE |TD| array — only the finite flag and the scalar
+    metrics come to host."""
 
     step: int
     idx: np.ndarray
-    priorities: np.ndarray
+    priorities: Any  # np.ndarray, or a device array in mirror mode
     finite: bool
     scalars: Dict[str, float]  # loss, grad_norm, q_mean, ... (host floats)
     lag: int  # newest dispatched step - this step, at retirement
@@ -68,10 +73,15 @@ class WritebackRing:
         registry=None,
         role: str = "learner",
         priorities_to_host: Optional[Callable[[Any], np.ndarray]] = None,
+        materialize_priorities: bool = True,
     ):
         self.depth = max(int(depth), 0)
         self._q: collections.deque = collections.deque()
         self._to_host = priorities_to_host
+        # False when the write-back target consumes DEVICE arrays (the HBM
+        # priority mirror): retirement then syncs only the finite flag +
+        # scalars, and the |TD| vector never crosses to host in the hot path
+        self._materialize = bool(materialize_priorities)
         self._last_pushed = 0
         self._retired_total = 0
         self.last_lag = 0  # dispatch-to-retire lag of the newest retirement
@@ -105,9 +115,10 @@ class WritebackRing:
         with hostsync.sanctioned():
             finite = bool(info["finite"]) if "finite" in info else True
             pri = info["priorities"]
-            pri = np.asarray(
-                self._to_host(pri) if self._to_host is not None else pri
-            )
+            if self._to_host is not None:
+                pri = self._to_host(pri)
+            if self._materialize:
+                pri = np.asarray(pri)
             scalars = {
                 k: float(v)
                 for k, v in info.items()
@@ -142,11 +153,12 @@ class WritebackRing:
         return out
 
 
-def pipeline_gauges(ring: WritebackRing, registry) -> Dict[str, float]:
+def pipeline_gauges(ring: WritebackRing, registry,
+                    frontier=None) -> Dict[str, float]:
     """The pipeline-health gauges every loop feeds to ``obs_run.periodic``
     (and obs_report keys on as the ``pipeline:`` line) — one definition so
     the three loops can't drift on the surface (docs/PERFORMANCE.md)."""
-    return {
+    out = {
         "writeback_inflight": len(ring),
         "writeback_lag_steps": ring.last_lag,
         "prefetch_queue_depth": registry.gauge(
@@ -156,6 +168,26 @@ def pipeline_gauges(ring: WritebackRing, registry) -> Dict[str, float]:
             "prefetch_empty_wait_total", "prefetch"
         ).get(),
     }
+    if frontier is not None:
+        # device-sampling pipeline (replay/frontier.py) — present on health
+        # rows ONLY when the frontier is live, so obs_report can tell a
+        # device-sampling run from a host-sampling one.  empty_waits
+        # climbing + a pinned-zero sample_ahead_queue_depth says the PUSHER
+        # can't keep up; mirror_reconcile_s vs the stale-indices counter
+        # then splits sampler-starved (draws slow) from gather-starved
+        # (host assembly slow) — docs/PERFORMANCE.md.
+        out.update({
+            "sample_ahead_queue_depth": registry.gauge(
+                "sample_ahead_queue_depth", "prefetch"
+            ).get(),
+            "sample_ahead_stale_indices": registry.counter(
+                "sample_ahead_stale_indices_total", "prefetch"
+            ).get(),
+            "mirror_reconcile_s": registry.gauge(
+                "mirror_reconcile_s", "frontier"
+            ).get(),
+        })
+    return out
 
 
 class RingCommitter:
@@ -183,11 +215,15 @@ class RingCommitter:
     """
 
     def __init__(self, ring: WritebackRing, update_priorities, supervisor,
-                 load_snapshot):
+                 load_snapshot, on_drain: Optional[Callable[[], Any]] = None):
         self.ring = ring
         self._update = update_priorities
         self._sup = supervisor
         self._load_snapshot = load_snapshot
+        # drain-boundary hook: device sampling reconciles the HBM priority
+        # mirror back into the host sum-trees here (replay/frontier.py), so
+        # snapshot/publish/checkpoint always read a caught-up cold path
+        self._on_drain = on_drain
         self.scalars: Dict[str, float] = {}  # newest retired step's scalars
 
     def _quarantine_and_rollback(self, bad: RetiredStep) -> None:
@@ -210,8 +246,11 @@ class RingCommitter:
 
     def drain(self) -> bool:
         """Ring boundary: retire everything in flight; False when one
-        tripped and we rolled back."""
+        tripped and we rolled back (the ``on_drain`` reconcile is skipped —
+        the next clean drain catches the cold path up)."""
         while len(self.ring):
             if not self.commit(self.ring.retire_one()):
                 return False
+        if self._on_drain is not None:
+            self._on_drain()
         return True

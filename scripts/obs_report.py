@@ -183,7 +183,13 @@ def aggregate(rows: List[Dict[str, Any]]) -> Dict[str, Any]:
         # plus prefetch starvation signals — lag == configured depth with an
         # empty-wait count near zero means the hot path is device-bound (the
         # goal); a climbing empty-wait count means the SAMPLER is the
-        # bottleneck and deeper write-back will not help
+        # bottleneck and deeper write-back will not help.  With device
+        # sampling on, the sample_ahead_* / mirror gauges split that further:
+        # empty waits with sample_ahead_queue_depth pinned at 0 means the
+        # PUSHER can't keep up — a growing stale-indices counter or a fat
+        # mirror_reconcile_s points at the frontier (sampler-starved), an
+        # otherwise idle frontier points at the host frame gather
+        # (gather-starved).
         "pipeline": {
             "writeback_inflight": _last_with(rows, "health", "writeback_inflight")
             .get("writeback_inflight"),
@@ -193,6 +199,14 @@ def aggregate(rows: List[Dict[str, Any]]) -> Dict[str, Any]:
             .get("prefetch_queue_depth"),
             "prefetch_empty_waits": _last_with(rows, "health", "prefetch_empty_waits")
             .get("prefetch_empty_waits"),
+            "sample_ahead_queue_depth": _last_with(
+                rows, "health", "sample_ahead_queue_depth")
+            .get("sample_ahead_queue_depth"),
+            "sample_ahead_stale_indices": _last_with(
+                rows, "health", "sample_ahead_stale_indices")
+            .get("sample_ahead_stale_indices"),
+            "mirror_reconcile_s": _last_with(rows, "health", "mirror_reconcile_s")
+            .get("mirror_reconcile_s"),
         },
         "shed_total": shed_total,
         "final_eval": {
@@ -237,12 +251,19 @@ def render(report: Dict[str, Any]) -> str:
     lines.append(f"faults: {report['faults'] or 'none'}")
     p = report["pipeline"]
     if any(v is not None for v in p.values()):
-        lines.append(
+        line = (
             f"pipeline: writeback_inflight={p['writeback_inflight']} "
             f"lag={p['writeback_lag_steps']} "
             f"prefetch_depth={p['prefetch_queue_depth']} "
             f"empty_waits={p['prefetch_empty_waits']}"
         )
+        if p.get("mirror_reconcile_s") is not None:  # device sampling on
+            line += (
+                f" sample_ahead_depth={p['sample_ahead_queue_depth']} "
+                f"stale_indices={p['sample_ahead_stale_indices']} "
+                f"mirror_reconcile_s={p['mirror_reconcile_s']}"
+            )
+        lines.append(line)
     e = report["elastic"]
     if any(e.values()):
         lines.append(

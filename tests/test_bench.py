@@ -89,3 +89,31 @@ def test_bench_child_hard_exits_despite_hung_teardown():
     assert rows, p.stdout
     assert rows[-1]["value"] > 0
     assert "learn_steps/s" in rows[-1]["unit"]
+
+
+def test_run_row_budgeted_emits_timeout_row_instead_of_dying():
+    """ISSUE 6 satellite (the r05 regression): a row that exhausts its
+    budget slice — or raises — must yield a labelled status row so the rows
+    queued behind it still run and downstream sees WHY a value is 0.0."""
+    import time
+
+    import bench
+
+    def overrunning(left):
+        while left() > 0:
+            time.sleep(0.005)
+        return []
+
+    rows = bench._run_row_budgeted(
+        "sample_path", "m", overrunning, lambda: 1.0, share=0.05)
+    assert rows[0]["status"] == "timeout"
+    assert rows[0]["path"] == "sample_path" and rows[0]["value"] == 0.0
+
+    rows = bench._run_row_budgeted(
+        "apex_loop", "m", lambda left: 1 / 0, lambda: 100.0, share=0.5)
+    assert rows[0]["status"] == "error"
+
+    healthy = [{"metric": "m", "value": 1.0}]
+    rows = bench._run_row_budgeted(
+        "x", "m", lambda left: list(healthy), lambda: 100.0, share=0.5)
+    assert rows == healthy

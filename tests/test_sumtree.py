@@ -117,3 +117,29 @@ def test_random_fuzz_against_naive():
     cum = np.cumsum(ref)
     naive = np.searchsorted(cum, masses, side="right")
     np.testing.assert_array_equal(t.find_prefix(masses), naive)
+
+
+def test_max_leaf_clamped_to_filled():
+    """Regression (ISSUE 6 satellite): max_leaf scanned the FULL leaf span,
+    so residue in never-written slots (e.g. a tree array rebuilt/restored
+    around a smaller `filled`) leaked into the fresh-item default priority.
+    The `filled`/`lanes` clamp restricts the scan to written slots."""
+    t = SumTree(8)
+    t.set(np.arange(8), np.array([1.0, 2.0, 0.5, 9.0, 0.0, 0.0, 0.0, 0.0]))
+    # simulate restore-time residue beyond the written prefix (filled=3)
+    assert t.max_leaf() == pytest.approx(9.0)  # unclamped scan sees it
+    assert t.max_leaf(filled=3) == pytest.approx(2.0)  # clamped scan does not
+    assert t.max_leaf(filled=8) == pytest.approx(9.0)
+    assert t.max_leaf(filled=0) == 0.0
+
+
+def test_max_leaf_clamp_multi_lane_layout():
+    """Multi-lane rings write lane-strided prefixes: lane l owns leaves
+    [l*seg, l*seg+seg) with written prefix `filled` — the clamp must mask
+    per lane, not globally."""
+    t = SumTree(8)  # 2 lanes x seg 4
+    # lane 0 wrote slots 0-1 (values 1, 2); lane 1 wrote slots 4-5 (3, 7);
+    # slots 2-3 and 6-7 carry residue that a filled=2 scan must ignore
+    t.set(np.arange(8), np.array([1.0, 2.0, 50.0, 60.0, 3.0, 7.0, 80.0, 90.0]))
+    assert t.max_leaf(filled=2, lanes=2) == pytest.approx(7.0)
+    assert t.max_leaf(filled=4, lanes=2) == pytest.approx(90.0)
