@@ -2,7 +2,7 @@
 
 PY ?= python
 
-.PHONY: test test-fast serve-smoke serve-bench chaos-smoke obs-smoke soak-smoke perf-smoke
+.PHONY: test test-fast serve-smoke serve-bench chaos-smoke obs-smoke soak-smoke perf-smoke fleet-smoke
 
 # tier-1: fast unit + integration tests on the virtual 8-device CPU mesh
 test-fast:
@@ -19,6 +19,20 @@ serve-smoke:
 # load-generator bench (acceptance: occupancy > 4, zero sheds, swap mid-run)
 serve-bench:
 	JAX_PLATFORMS=cpu $(PY) scripts/bench_serve.py --clients 64 --requests 2000
+
+# fleet smoke (docs/SERVING.md "fleet"): the `serve`-marked fleet tests
+# (router invariants on real engines) plus the heavy-traffic soak — a
+# 2-engine in-process fleet under bursty open-loop arrivals with a slow-
+# client cohort, one engine killed cold mid-load (re-route, zero lost
+# accepted requests), two weight rollouts (one deliberately backward =
+# refused), enforced p99/shed gates — and the run dir must lint as strict
+# schema-versioned JSONL (route/scale/rollout rows included)
+fleet-smoke:
+	JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_fleet.py -q -m serve
+	rm -rf /tmp/ria_fleet_smoke
+	JAX_PLATFORMS=cpu $(PY) scripts/bench_serve.py --fleet-soak \
+	  --engines 2 --duration 8 --out /tmp/ria_fleet_smoke
+	$(PY) scripts/lint_jsonl.py /tmp/ria_fleet_smoke
 
 # chaos smoke: every named fault-injection point exercised end to end
 # (NaN rollback, corrupt-checkpoint fallback, torn-snapshot CRC, retried

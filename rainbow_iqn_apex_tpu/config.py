@@ -192,6 +192,28 @@ class Config:
     serve_mode: str = "greedy"  # "greedy" (noise off) | "noisy" (eval_noisy-style)
     serve_metrics_interval_s: float = 5.0  # seconds between 'serve' JSONL rows
 
+    # ---- serving fleet (serving/fleet/; docs/SERVING.md "fleet") ------------------
+    fleet_min_engines: int = 1  # autoscaler floor
+    fleet_max_engines: int = 4  # autoscaler ceiling
+    fleet_max_inflight: int = 512  # router global inflight bound (admission
+    # backstop; per-class caps are shares of this)
+    fleet_qos_classes: str = "gold:50:0.5,std:200:0.35,batch:1000:0.15"
+    # priority-ordered deadline tiers, name:deadline_ms:inflight_share —
+    # a class is capped at its share of fleet_max_inflight AND lower classes
+    # cannot consume headroom still reserved by higher ones, so the shed
+    # order under global pressure is strictly lowest-class-first
+    fleet_default_class: str = "std"  # tenants with no explicit class
+    fleet_tenant_rate: float = 0.0  # per-tenant token-bucket refill
+    # (requests/s); 0 = unlimited — rate isolation off
+    fleet_tenant_burst: int = 64  # per-tenant token-bucket capacity
+    fleet_lease_interval_s: float = 0.5  # engine lease renewal cadence
+    fleet_lease_timeout_s: float = 3.0  # lease older than this = dead engine
+    fleet_scale_up_depth: float = 0.75  # mean engine queue fill -> scale OUT
+    fleet_scale_down_depth: float = 0.2  # ... -> scale IN
+    fleet_scale_p99_ms: float = 0.0  # p99 latency scale-out trigger; 0 = off
+    fleet_scale_patience: int = 3  # consecutive breaches before acting
+    fleet_scale_cooldown_s: float = 10.0  # hold after any scale action
+
     # ---- evaluation (SURVEY §2 row 9) ---------------------------------------------
     eval_episodes: int = 10
     eval_interval: int = 50_000  # learner steps between in-training evals; 0 = off

@@ -113,6 +113,36 @@ class RunHealth:
                     self.fault_counts["actor_fenced"] += 1
                     self._win_faults["actor_fenced"] += 1
             self.registry.counter("actor_fenced_total", "health").inc()
+        elif kind == "route":
+            # router sheds degrade exactly like serve sheds; a LOST accepted
+            # request (engine died, nowhere to re-route) is a fault — the
+            # fleet broke its zero-loss invariant
+            shed = row.get("shed") or 0
+            lost = row.get("lost") or 0
+            with self._lock:
+                if shed:
+                    self.total_shed += shed
+                    self._win_shed += shed
+                if lost:
+                    self.fault_counts["route_lost"] += lost
+                    self._win_faults["route_lost"] += lost
+            if shed:
+                self.registry.counter("shed_total", "router").inc(shed)
+        elif kind == "scale":
+            # a scale action is a sizing decision, not a degradation; count
+            # it and track the fleet size for the health row's gauges
+            self.registry.counter("scale_events_total", "health").inc()
+            engines = row.get("engines")
+            if engines is not None:
+                self.registry.gauge("fleet_size", "health").set(int(engines))
+        elif kind == "rollout":
+            self.registry.counter("rollout_events_total", "health").inc()
+            if row.get("event") == "refused_backward":
+                # the guard WORKED, but something tried to move the fleet
+                # backwards — a human should know this window was degraded
+                with self._lock:
+                    self.fault_counts["rollout_refused"] += 1
+                    self._win_faults["rollout_refused"] += 1
 
     def note_fault(self, event: str, row: Optional[Dict[str, Any]] = None) -> None:
         with self._lock:

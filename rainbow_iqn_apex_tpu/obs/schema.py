@@ -49,6 +49,13 @@ REQUIRED_KEYS: Dict[str, frozenset] = {
     "shard_readmit": frozenset({"shard", "epoch"}),  # drop_shard reversed
     "actor_fenced": frozenset({"lag", "max_lag"}),  # staleness fence edge
     # (``action`` is "fence" or "resume"; frames shed ride in the gauges)
+    # serving-fleet rows (serving/fleet/; docs/SERVING.md "fleet"):
+    "route": frozenset({"accepted", "shed"}),  # router admission window
+    # (carries per-tenant accept/shed, shed_by_reason, per-engine
+    # depth/version snapshot, rerouted/lost counts)
+    "scale": frozenset({"action", "engines"}),  # one autoscaler decision
+    "rollout": frozenset({"event", "version"}),  # fleet weight rollout
+    # (event: publish/sync/converged/refused_backward)
 }
 
 HEALTH_STATUSES = ("ok", "degraded", "failing")

@@ -95,7 +95,8 @@ class HeartbeatWriter:
     def __init__(self, directory: str, process_id: int, interval_s: float,
                  injector: Optional[faults.FaultInjector] = None,
                  role: str = "host", shard: Optional[int] = None,
-                 epoch: int = 0):
+                 epoch: int = 0,
+                 payload_fn: Optional[Callable[[], Dict]] = None):
         self.directory = directory
         self.process_id = int(process_id)
         self.interval_s = float(interval_s)
@@ -104,15 +105,30 @@ class HeartbeatWriter:
         self.payload: Dict = {"role": role, "epoch": int(epoch)}
         if shard is not None:
             self.payload["shard"] = int(shard)
+        # dynamic lease payload (serving fleet): merged into every renewal so
+        # fast-moving fields (queue_depth, weights_version) ride the lease
+        # without the owner calling update_payload on its own hot path
+        self.payload_fn = payload_fn
         self.beats = 0
         self.suppressed = 0
+        # payload writers (adopt/rollout threads) race the beat thread's
+        # read; an unguarded dict resize mid-unpack would raise past the
+        # loop's OSError net and silently kill the heartbeat — a healthy
+        # engine would then be evicted on a phantom lease expiry
+        self._payload_lock = threading.Lock()
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
 
     def set_weight_version(self, version: int) -> None:
         """Stamp the weight version this host currently acts with; rides in
         every subsequent lease renewal (external staleness monitoring)."""
-        self.payload["weight_version"] = int(version)
+        with self._payload_lock:
+            self.payload["weight_version"] = int(version)
+
+    def update_payload(self, **fields: Any) -> None:
+        """Merge static fields (lanes, buckets, ...) into every renewal."""
+        with self._payload_lock:
+            self.payload.update(fields)
 
     def beat(self) -> None:
         """One lease renewal (also usable inline, without the thread)."""
@@ -123,11 +139,20 @@ class HeartbeatWriter:
                 self.suppressed += 1
                 return
         os.makedirs(self.directory, exist_ok=True)
+        dynamic: Dict = {}
+        if self.payload_fn is not None:
+            try:
+                dynamic = dict(self.payload_fn())
+            except Exception:
+                pass  # a flaky gauge read must not suppress the renewal itself
+        with self._payload_lock:
+            static = dict(self.payload)
         row = {
             "process_id": self.process_id,
             "t_mono": time.monotonic(),
             "t_wall": time.time(),
-            **self.payload,
+            **static,
+            **dynamic,
         }
         tmp = self.path + ".tmp"
         with open(tmp, "w") as f:
@@ -172,6 +197,12 @@ class Lease:
     weight_version: int = -1
     fenced: bool = False  # the host's staleness fence is currently closed
     payload_ok: bool = True  # False: mtime was readable, the JSON was not
+    # serving-fleet payload (role "engine", serving/fleet/registry.py): the
+    # router discovers capacity and load through the SAME lease machinery
+    # that heals training hosts — no second discovery protocol
+    lanes: int = 0  # engine mesh width (dispatch weight denominator)
+    buckets: Tuple[int, ...] = ()  # padded batch sizes the engine compiled
+    queue_depth: int = -1  # engine request-queue depth at the last renewal
 
 
 # ---------------------------------------------------------- lease monitoring
@@ -237,6 +268,9 @@ class HeartbeatMonitor:
                 weight_version=int(payload.get("weight_version", -1)),
                 fenced=bool(payload.get("fenced", False)),
                 payload_ok=payload_ok,
+                lanes=int(payload.get("lanes", 0) or 0),
+                buckets=tuple(int(b) for b in payload.get("buckets") or ()),
+                queue_depth=int(payload.get("queue_depth", -1)),
             )
         return out
 
@@ -512,6 +546,15 @@ class RoleSupervisor:
                 events.append(ev)
         self._observe()
         return events
+
+    def release(self, role_id: str) -> None:
+        """Deliberate decommission (autoscaler scale-in): stop tracking the
+        role WITHOUT an eviction event — a shrunk fleet is a sizing decision,
+        not a failure.  The caller stops the process itself; releasing first
+        means the exit can never race a poll() into a spurious actor_dead."""
+        self._roles.pop(role_id, None)
+        self.budget.clear(role_id)
+        self._observe()
 
     # ------------------------------------------------------------- inspection
     def state(self, role_id: str) -> str:

@@ -1,40 +1,69 @@
 """Batched low-latency policy inference serving (Ape-X's actor fleet turned
 client-facing): dynamic micro-batching over bucketed XLA shapes, lane-sharded
-inference on the actor mesh, checkpoint-driven weight hot-swap, and a JSONL
-metrics surface.  See docs/SERVING.md."""
+inference on the actor mesh, checkpoint-driven weight hot-swap, a JSONL
+metrics surface, and (serving/fleet/) a front router + autoscaled engine
+fleet.  See docs/SERVING.md.
 
-from rainbow_iqn_apex_tpu.serving.batcher import (
-    MicroBatcher,
-    ServeFuture,
-    ServerClosed,
-    ServerOverloaded,
-    pick_bucket,
-)
-from rainbow_iqn_apex_tpu.serving.engine import (
-    InferenceEngine,
-    fit_buckets,
-    parse_buckets,
-)
-from rainbow_iqn_apex_tpu.serving.metrics import ServeMetrics
-from rainbow_iqn_apex_tpu.serving.server import PolicyServer
-from rainbow_iqn_apex_tpu.serving.swap import (
-    CheckpointWatcher,
-    params_template,
-    restore_params,
-)
+Exports resolve lazily (PEP 562, the parallel/ pattern): engine/server/swap
+pull in jax at import time, but batcher/metrics and the whole fleet layer
+(router, registry, autoscaler, rollout) are deliberately jax-free so a
+router front-end process — which owns no device — can import them without
+paying the device-runtime import tax.
+"""
 
-__all__ = [
-    "CheckpointWatcher",
-    "InferenceEngine",
-    "MicroBatcher",
-    "PolicyServer",
-    "ServeFuture",
-    "ServeMetrics",
-    "ServerClosed",
-    "ServerOverloaded",
-    "fit_buckets",
-    "params_template",
-    "parse_buckets",
-    "pick_bucket",
-    "restore_params",
-]
+from typing import TYPE_CHECKING
+
+_EXPORTS = {
+    "MicroBatcher": "rainbow_iqn_apex_tpu.serving.batcher",
+    "RequestCancelled": "rainbow_iqn_apex_tpu.serving.batcher",
+    "ServeFuture": "rainbow_iqn_apex_tpu.serving.batcher",
+    "ServerClosed": "rainbow_iqn_apex_tpu.serving.batcher",
+    "ServerOverloaded": "rainbow_iqn_apex_tpu.serving.batcher",
+    "pick_bucket": "rainbow_iqn_apex_tpu.serving.batcher",
+    "InferenceEngine": "rainbow_iqn_apex_tpu.serving.engine",
+    "fit_buckets": "rainbow_iqn_apex_tpu.serving.engine",
+    "parse_buckets": "rainbow_iqn_apex_tpu.serving.engine",
+    "ServeMetrics": "rainbow_iqn_apex_tpu.serving.metrics",
+    "PolicyServer": "rainbow_iqn_apex_tpu.serving.server",
+    "CheckpointWatcher": "rainbow_iqn_apex_tpu.serving.swap",
+    "params_template": "rainbow_iqn_apex_tpu.serving.swap",
+    "restore_params": "rainbow_iqn_apex_tpu.serving.swap",
+}
+
+__all__ = sorted(_EXPORTS)
+
+
+def __getattr__(name: str):
+    module = _EXPORTS.get(name)
+    if module is None:
+        raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
+    import importlib
+
+    return getattr(importlib.import_module(module), name)
+
+
+def __dir__():
+    return __all__
+
+
+if TYPE_CHECKING:  # static analyzers see the eager imports
+    from rainbow_iqn_apex_tpu.serving.batcher import (  # noqa: F401
+        MicroBatcher,
+        RequestCancelled,
+        ServeFuture,
+        ServerClosed,
+        ServerOverloaded,
+        pick_bucket,
+    )
+    from rainbow_iqn_apex_tpu.serving.engine import (  # noqa: F401
+        InferenceEngine,
+        fit_buckets,
+        parse_buckets,
+    )
+    from rainbow_iqn_apex_tpu.serving.metrics import ServeMetrics  # noqa: F401
+    from rainbow_iqn_apex_tpu.serving.server import PolicyServer  # noqa: F401
+    from rainbow_iqn_apex_tpu.serving.swap import (  # noqa: F401
+        CheckpointWatcher,
+        params_template,
+        restore_params,
+    )

@@ -37,28 +37,92 @@ class ServerClosed(RuntimeError):
     """Raised to the submitting client when the server is shut down."""
 
 
+class RequestCancelled(RuntimeError):
+    """Raised from ``result()`` after the future was cancelled."""
+
+
 class ServeFuture:
     """One in-flight request: the client blocks on ``result()``; the worker
-    fulfils with ``set_result``/``set_error``."""
+    fulfils with ``set_result``/``set_error``.
 
-    __slots__ = ("obs", "t_enqueue", "_event", "_action", "_q", "_error")
+    A client that gives up (``result()`` timeout, disconnect) should call
+    ``cancel()``: a cancelled future is skipped by the batcher instead of
+    padding, dispatching and fulfilling a dead slot — under a slow-client
+    cohort the abandoned requests would otherwise silently burn batch
+    capacity the live clients need."""
+
+    __slots__ = ("obs", "t_enqueue", "_lock", "_event", "_action", "_q",
+                 "_error", "_cancelled", "_callbacks")
 
     def __init__(self, obs: np.ndarray):
         self.obs = obs
         self.t_enqueue = time.monotonic()
+        # the lock serialises settle-vs-cancel and callback registration:
+        # exactly one of {result, error, cancelled} wins, and a callback
+        # added after settling still fires exactly once
+        self._lock = threading.Lock()
         self._event = threading.Event()
         self._action: Optional[int] = None
         self._q: Optional[np.ndarray] = None
         self._error: Optional[BaseException] = None
+        self._cancelled = False
+        self._callbacks: List = []
+
+    def _settle(self) -> Optional[List]:
+        """Mark settled; returns the callbacks to run (None if already set)."""
+        if self._event.is_set():
+            return None
+        self._event.set()
+        cbs, self._callbacks = self._callbacks, []
+        return cbs
+
+    def _run_callbacks(self, cbs: Optional[List]) -> None:
+        for cb in cbs or ():
+            try:
+                cb(self)
+            except Exception:
+                pass  # an observer bug must never poison the worker loop
 
     def set_result(self, action: int, q: np.ndarray) -> None:
-        self._action = action
-        self._q = q
-        self._event.set()
+        with self._lock:
+            self._action = action
+            self._q = q
+            cbs = self._settle()
+        self._run_callbacks(cbs)
 
     def set_error(self, err: BaseException) -> None:
-        self._error = err
-        self._event.set()
+        with self._lock:
+            if not self._event.is_set():
+                self._error = err
+            cbs = self._settle()
+        self._run_callbacks(cbs)
+
+    def cancel(self) -> bool:
+        """Abandon the request.  True when the cancel won (the future was not
+        yet fulfilled): the batcher will drop it instead of dispatching, and
+        ``result()`` raises RequestCancelled.  False when a result/error
+        already landed — the outcome stands and nothing changes."""
+        with self._lock:
+            if self._event.is_set():
+                return False
+            self._cancelled = True
+            self._error = RequestCancelled("request cancelled by client")
+            cbs = self._settle()
+        self._run_callbacks(cbs)
+        return True
+
+    def cancelled(self) -> bool:
+        return self._cancelled
+
+    def add_done_callback(self, fn) -> None:
+        """Run ``fn(self)`` once the future settles (result, error or
+        cancel); runs immediately when already settled.  The router uses
+        this for inflight accounting and dead-engine re-dispatch."""
+        with self._lock:
+            if not self._event.is_set():
+                self._callbacks.append(fn)
+                return
+        self._run_callbacks([fn])
 
     def done(self) -> bool:
         return self._event.is_set()
@@ -115,16 +179,28 @@ class MicroBatcher:
 
     # ---------------------------------------------------------- client side
     def submit(self, obs: np.ndarray) -> ServeFuture:
+        fut = self.try_submit(obs)
+        if fut is None:
+            if self.metrics is not None:
+                self.metrics.record_shed()
+            raise ServerOverloaded(
+                f"request queue full ({self.queue_bound}); shedding"
+            )
+        return fut
+
+    def try_submit(self, obs: np.ndarray) -> Optional[ServeFuture]:
+        """submit() minus the shed accounting: returns None when the queue
+        is full instead of recording a shed and raising.  For probing
+        callers that own their own shed story (the fleet router tries
+        several engines per request — a probe that lands elsewhere is not
+        an engine shed, and counting it would flip health to degraded on
+        phantom pressure).  Still raises ServerClosed after close()."""
         fut = ServeFuture(obs)
         with self._lock:
             if self._closed:
                 raise ServerClosed("server is shut down")
             if len(self._queue) >= self.queue_bound:
-                if self.metrics is not None:
-                    self.metrics.record_shed()
-                raise ServerOverloaded(
-                    f"request queue full ({self.queue_bound}); shedding"
-                )
+                return None
             self._queue.append(fut)
             self._nonempty.notify()
         return fut
@@ -147,8 +223,16 @@ class MicroBatcher:
         AND drained — the worker's signal to exit.
         """
         t_start = time.monotonic()
+        cancelled = 0
         with self._lock:
             while True:
+                # drop cancelled heads eagerly: an abandoned request must not
+                # hold the deadline clock (its enqueue time is the oldest) or
+                # a batch slot — the slow-client cohort would otherwise burn
+                # capacity live clients need
+                while self._queue and self._queue[0].cancelled():
+                    self._queue.popleft()
+                    cancelled += 1
                 if self._queue:
                     deadline = self._queue[0].t_enqueue + self.deadline_s
                     if len(self._queue) >= self.max_batch or self._closed:
@@ -159,18 +243,31 @@ class MicroBatcher:
                     self._nonempty.wait(timeout=min(remaining, poll_s))
                 else:
                     if self._closed:
+                        if cancelled and self.metrics is not None:
+                            self.metrics.record_cancelled(cancelled)
                         return None
                     if (idle_timeout_s is not None
                             and time.monotonic() - t_start >= idle_timeout_s):
+                        if cancelled and self.metrics is not None:
+                            self.metrics.record_cancelled(cancelled)
                         return []
                     self._nonempty.wait(timeout=poll_s)
-            n = min(len(self._queue), self.max_batch)
-            batch = [self._queue.popleft() for _ in range(n)]
+            batch: List[ServeFuture] = []
+            while self._queue and len(batch) < self.max_batch:
+                fut = self._queue.popleft()
+                if fut.cancelled():
+                    cancelled += 1
+                    continue
+                batch.append(fut)
+            n = len(batch)
             depth_after = len(self._queue)
         if self.metrics is not None:
-            self.metrics.record_batch(
-                n, pick_bucket(self.buckets, n), depth_after
-            )
+            if cancelled:
+                self.metrics.record_cancelled(cancelled)
+            if n:
+                self.metrics.record_batch(
+                    n, pick_bucket(self.buckets, n), depth_after
+                )
         return batch
 
     def close(self) -> None:

@@ -1,0 +1,43 @@
+"""serving/fleet: front router + autoscaled engine fleet (docs/SERVING.md).
+
+The horizontal layer over PR 1's single `PolicyServer`: a shared-nothing
+`FrontRouter` (admission control, per-tenant QoS, least-depth dispatch,
+weight-lag fencing), an `EngineRegistry` where engines self-register through
+the PR-4 lease machinery, an `Autoscaler` with hysteresis + supervised
+respawn, and a `FleetRollout` that publishes weights fleet-wide with
+monotone versions.  Import-time jax-free: a router front-end never pays the
+device-runtime import tax.
+"""
+
+from rainbow_iqn_apex_tpu.serving.fleet.autoscale import Autoscaler, ScalePolicy
+from rainbow_iqn_apex_tpu.serving.fleet.registry import (
+    EngineDead,
+    EngineHandle,
+    EngineRegistry,
+    FleetEngine,
+    ServerTransport,
+)
+from rainbow_iqn_apex_tpu.serving.fleet.rollout import FleetRollout
+from rainbow_iqn_apex_tpu.serving.fleet.router import (
+    FrontRouter,
+    QoSClass,
+    RoutedFuture,
+    TokenBucket,
+    parse_qos_classes,
+)
+
+__all__ = [
+    "Autoscaler",
+    "EngineDead",
+    "EngineHandle",
+    "EngineRegistry",
+    "FleetEngine",
+    "FleetRollout",
+    "FrontRouter",
+    "QoSClass",
+    "RoutedFuture",
+    "ScalePolicy",
+    "ServerTransport",
+    "TokenBucket",
+    "parse_qos_classes",
+]

@@ -1,0 +1,198 @@
+"""Autoscaler: grow/shrink the engine fleet on load, without flapping.
+
+Inputs are the gauges the serving tier already publishes — mean per-engine
+queue fill (the router's lease view) and completion p99 — not a new metrics
+path.  The control law is deliberately boring:
+
+- **hysteresis**: a scale decision needs ``patience`` CONSECUTIVE breached
+  evaluations, and the out/in thresholds are separated (up at 75% fill,
+  down at 20% by default), so load oscillating around one threshold cannot
+  flap the fleet (tier-1 asserted in tests/test_fleet.py);
+- **cooldown**: after any action the scaler holds for ``cooldown_s`` — an
+  engine that just spawned needs a warmup's worth of wall clock before its
+  effect on depth is measurable, and judging mid-warmup double-scales;
+- **bounds**: the engine count stays in [min_engines, max_engines].
+
+Engine processes live under the PR-4 `RoleSupervisor`: a CRASHED engine is
+respawned with the shared backoff schedule (and eventually evicted on budget
+exhaustion) exactly like a dead actor host, while a deliberately
+decommissioned one is ``release``d first so its exit can never read as a
+failure.  Every decision is emitted as a ``scale`` row.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from typing import Any, Callable, Dict, List, Optional
+
+from rainbow_iqn_apex_tpu.parallel.elastic import RoleSupervisor
+
+
+@dataclasses.dataclass(frozen=True)
+class ScalePolicy:
+    """The autoscaler's knobs (Config.fleet_scale_* fields)."""
+
+    min_engines: int = 1
+    max_engines: int = 4
+    up_depth: float = 0.75  # mean queue fill fraction that argues scale-OUT
+    down_depth: float = 0.2  # ... and scale-IN
+    p99_ms: float = 0.0  # p99 latency scale-out trigger; 0 = depth only
+    patience: int = 3  # consecutive breached evaluations before acting
+    cooldown_s: float = 10.0  # hold after any action
+
+    @classmethod
+    def from_config(cls, cfg) -> "ScalePolicy":
+        return cls(
+            min_engines=cfg.fleet_min_engines,
+            max_engines=cfg.fleet_max_engines,
+            up_depth=cfg.fleet_scale_up_depth,
+            down_depth=cfg.fleet_scale_down_depth,
+            p99_ms=cfg.fleet_scale_p99_ms,
+            patience=cfg.fleet_scale_patience,
+            cooldown_s=cfg.fleet_scale_cooldown_s,
+        )
+
+
+class Autoscaler:
+    """Hysteretic engine-count controller.
+
+    ``spawn_engine(engine_id, epoch)`` must start a new engine and return a
+    process-like object (``poll()`` -> rc or None, ``kill()``) the
+    supervisor can watch; ``stop_engine(engine_id)`` decommissions one
+    (graceful: lease first, then drain).  ``load_fn()`` returns
+    ``{"engines": n_routable, "depth_frac": mean fill 0..1, "p99_ms": x|None}``
+    — `FrontRouter.mean_depth_fraction`/`p99_ms` in the real wiring, a
+    scripted sequence in the hysteresis tests.
+    """
+
+    def __init__(
+        self,
+        policy: ScalePolicy,
+        spawn_engine: Callable[[int, int], Any],
+        stop_engine: Callable[[int], None],
+        load_fn: Callable[[], Dict[str, Any]],
+        supervisor: Optional[RoleSupervisor] = None,
+        logger=None,
+        obs_registry=None,
+        clock: Callable[[], float] = time.monotonic,
+    ):
+        self.policy = policy
+        self.spawn_engine = spawn_engine
+        self.stop_engine = stop_engine
+        self.load_fn = load_fn
+        self.supervisor = supervisor
+        self.logger = logger
+        self.obs_registry = obs_registry
+        self.clock = clock
+        self._engine_ids: List[int] = []
+        self._next_id = 0
+        self._breach_up = 0
+        self._breach_down = 0
+        self._t_last_action = -float("inf")
+        self.actions: List[Dict[str, Any]] = []  # lifetime decision log
+
+    # ------------------------------------------------------------- membership
+    def adopt_engine(self, engine_id: int, proc: Any = None) -> None:
+        """Track an engine the harness already started (the initial fleet);
+        registered with the supervisor so a crash respawns it like any
+        scaled-out engine."""
+        self._engine_ids.append(int(engine_id))
+        self._next_id = max(self._next_id, int(engine_id) + 1)
+        if self.supervisor is not None:
+            self.supervisor.register(
+                f"engine{engine_id}",
+                lambda epoch, eid=int(engine_id): self.spawn_engine(eid, epoch),
+                proc=proc if proc is not None else _AliveProc(),
+                meta={"engine": int(engine_id)},
+            )
+
+    def engines(self) -> List[int]:
+        return list(self._engine_ids)
+
+    # --------------------------------------------------------------- decision
+    def _emit(self, action: str, reason: str, load: Dict[str, Any],
+              engine_id: int) -> Dict[str, Any]:
+        row = {
+            "action": action,
+            "engines": len(self._engine_ids),
+            "engine": engine_id,
+            "reason": reason,
+            "depth_frac": round(float(load.get("depth_frac", 0.0)), 4),
+            "p99_ms": load.get("p99_ms"),
+        }
+        self.actions.append(row)
+        if self.logger is not None:
+            self.logger.log("scale", **row)
+        if self.obs_registry is not None:
+            self.obs_registry.counter(f"scale_{action}_total", "autoscale").inc()
+            self.obs_registry.gauge("fleet_size", "autoscale").set(
+                len(self._engine_ids))
+        return row
+
+    def evaluate(self, step: int = 0) -> Optional[Dict[str, Any]]:
+        """One control sweep: supervise (respawn crashed engines), then at
+        most ONE scale action.  Returns the scale row, or None."""
+        if self.supervisor is not None:
+            self.supervisor.poll(step=step)
+        load = self.load_fn()
+        depth = float(load.get("depth_frac", 0.0))
+        p99 = load.get("p99_ms")
+        hot = depth >= self.policy.up_depth or (
+            self.policy.p99_ms > 0 and p99 is not None
+            and p99 >= self.policy.p99_ms)
+        cold = depth <= self.policy.down_depth and not hot
+        if self.clock() - self._t_last_action < self.policy.cooldown_s:
+            # breaches observed DURING cooldown don't count toward patience:
+            # they mostly measure the fleet mid-warmup, and banking them
+            # would let the first post-cooldown evaluate act instantly —
+            # the double-scale the cooldown exists to prevent.  The clock
+            # restarts clean when the window closes.
+            self._breach_up = 0
+            self._breach_down = 0
+            return None
+        self._breach_up = self._breach_up + 1 if hot else 0
+        self._breach_down = self._breach_down + 1 if cold else 0
+        if (self._breach_up >= self.policy.patience
+                and len(self._engine_ids) < self.policy.max_engines):
+            engine_id = self._next_id
+            self._next_id += 1
+            if self.supervisor is not None:
+                self.supervisor.register(
+                    f"engine{engine_id}",
+                    lambda epoch, eid=engine_id: self.spawn_engine(eid, epoch),
+                    meta={"engine": engine_id},
+                )
+            else:
+                self.spawn_engine(engine_id, 0)
+            self._engine_ids.append(engine_id)
+            self._breach_up = 0
+            self._t_last_action = self.clock()
+            return self._emit("out", "depth" if depth >= self.policy.up_depth
+                              else "p99", load, engine_id)
+        if (self._breach_down >= self.policy.patience
+                and len(self._engine_ids) > self.policy.min_engines):
+            # shrink the newest engine: the oldest have the warmest caches
+            # and the longest-observed health record
+            engine_id = self._engine_ids.pop()
+            if self.supervisor is not None:
+                # release BEFORE stopping: the deliberate exit must never
+                # race a poll() into a spurious actor_dead/respawn
+                self.supervisor.release(f"engine{engine_id}")
+            self.stop_engine(engine_id)
+            self._breach_down = 0
+            self._t_last_action = self.clock()
+            return self._emit("in", "idle", load, engine_id)
+        return None
+
+
+class _AliveProc:
+    """Proc-like for an engine the harness runs in-process and has not
+    killed: the supervisor sees it running until the harness swaps in a
+    real liveness probe."""
+
+    def poll(self) -> Optional[int]:
+        return None
+
+    def kill(self) -> None:
+        pass

@@ -1,0 +1,373 @@
+"""Engine registry: the fleet's membership layer, built on role leases.
+
+PR 4 already solved discovery/eviction for training hosts — lease files
+renewed by `HeartbeatWriter`, edges reported once per epoch by
+`HeartbeatMonitor` (parallel/elastic.py).  Serving engines reuse exactly that
+machinery instead of growing a second membership protocol: every engine runs
+a lease with ``role="engine"`` whose payload carries what the router needs to
+dispatch — ``{lanes, buckets, weights_version, queue_depth}`` — refreshed on
+every renewal via the writer's ``payload_fn`` hook.  The router discovers a
+new engine the moment its lease appears fresh and stops routing to it the
+moment the lease expires, through the same timeout that declares a training
+host dead.
+
+Two halves:
+
+- **`FleetEngine`** (engine side): one `PolicyServer` plus its lease writer.
+  ``adopt(params, version)`` is the rollout's entry point — it refuses
+  backward versions locally (defence in depth under the fleet controller's
+  own monotonicity check) and stamps the adopted version into the lease.
+  ``kill()`` is the in-process analog of SIGKILL: heartbeats stop, queued
+  requests fail immediately, nothing drains — the shape the soak's mid-load
+  engine kill exercises.
+- **`EngineRegistry`** (router side): lease scan -> `EngineHandle` map.
+  A handle is *routable* only when its lease is fresh AND a transport is
+  attached (in-process: the server object itself; a socket adapter slots in
+  at the same seam).  A lease without a transport is visible-but-unroutable:
+  the obs surface shows the engine exists even before the router can reach
+  it.
+
+Deliberately jax-free: the registry/router side of a fleet must be importable
+by a front-end process that never touches a device runtime.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+from rainbow_iqn_apex_tpu.parallel.elastic import (
+    HeartbeatMonitor,
+    HeartbeatWriter,
+    Lease,
+)
+from rainbow_iqn_apex_tpu.serving.batcher import ServerOverloaded
+
+
+class EngineDead(RuntimeError):
+    """Raised by a transport whose engine is gone (lease expired / killed)."""
+
+
+class ServerTransport:
+    """In-process transport protocol over a `PolicyServer`.
+
+    The router speaks only this surface — ``submit``/``depth``/``alive``/
+    ``version``/``lanes`` — so unit tests drive it with fakes and a network
+    front-end implements the same five members over a socket.
+    ``version()`` is the FLEET weights version (rollout-assigned, monotone),
+    not the engine's internal params_version (which also bumps on direct
+    load_params pushes outside any rollout).
+    """
+
+    def __init__(self, server: Any, lanes: Optional[int] = None):
+        self.server = server
+        self.lanes = int(lanes if lanes is not None
+                         else getattr(server.engine, "n_devices", 1))
+        self.buckets: Tuple[int, ...] = tuple(
+            getattr(server.engine, "buckets", ()) or ())
+        self._fleet_version = 0
+
+    def submit(self, obs) -> Any:
+        # try_submit, not submit: a router probe that finds this engine full
+        # moves on to the next engine — it is the ROUTER's shed to count
+        # (and only if every engine refuses), not this engine's
+        fut = self.server.try_submit(obs)
+        if fut is None:
+            raise ServerOverloaded(
+                f"engine queue full ({self.server.cfg.serve_queue_bound})")
+        return fut
+
+    def depth(self) -> int:
+        return self.server.batcher.depth()
+
+    def alive(self) -> bool:
+        worker = getattr(self.server, "_worker", None)
+        return worker is not None and worker.is_alive()
+
+    def version(self) -> int:
+        return self._fleet_version
+
+    def set_version(self, version: int) -> None:
+        self._fleet_version = int(version)
+
+
+@dataclasses.dataclass
+class EngineHandle:
+    """One engine as the registry currently sees it."""
+
+    engine_id: int
+    transport: Optional[Any] = None  # ServerTransport-protocol object
+    lease: Optional[Lease] = None
+    alive: bool = True
+    # mark_dead() wall-clock stamp: a dispatch OBSERVED this engine dead,
+    # which outranks a lease file that merely has not expired yet — only a
+    # beat WRITTEN after the observation clears the suspicion (a killed
+    # engine's final lease stays fresh for up to the timeout, and an aborted
+    # queue reads depth 0, so a resurrected corpse would rank FIRST)
+    suspect_since: Optional[float] = None
+
+    @property
+    def routable(self) -> bool:
+        return self.alive and self.transport is not None
+
+    @property
+    def lanes(self) -> int:
+        if self.transport is not None:
+            return max(int(self.transport.lanes), 1)
+        return max(int(self.lease.lanes), 1) if self.lease else 1
+
+    def depth(self) -> int:
+        """Queue depth: live from the transport when attached, else the
+        lease's last renewal (stale by at most one lease interval)."""
+        if self.transport is not None:
+            try:
+                return int(self.transport.depth())
+            except Exception:
+                return 1 << 30  # an unreadable depth routes LAST, not first
+        return max(int(self.lease.queue_depth), 0) if self.lease else 0
+
+    def version(self) -> int:
+        if self.transport is not None:
+            return int(self.transport.version())
+        return int(self.lease.weight_version) if self.lease else -1
+
+
+class FleetEngine:
+    """Engine-side composition: PolicyServer + self-registering lease.
+
+    ``engine_id`` doubles as the lease file's host id, so one heartbeat
+    directory holds training hosts and serving engines side by side,
+    distinguished by the lease's ``role`` field.
+    """
+
+    def __init__(self, server: Any, engine_id: int, heartbeat_dir: str,
+                 interval_s: float = 0.5, epoch: int = 0,
+                 lanes: Optional[int] = None):
+        self.server = server
+        self.engine_id = int(engine_id)
+        self.transport = ServerTransport(server, lanes=lanes)
+        self.writer = HeartbeatWriter(
+            heartbeat_dir, engine_id, interval_s, role="engine", epoch=epoch,
+            payload_fn=self._lease_payload,
+        )
+        self.writer.update_payload(
+            lanes=self.transport.lanes, buckets=list(self.transport.buckets))
+
+    def _lease_payload(self) -> Dict[str, Any]:
+        return {
+            "weight_version": self.transport.version(),
+            "queue_depth": self.transport.depth(),
+        }
+
+    # -------------------------------------------------------------- lifecycle
+    def start(self, warmup: bool = True) -> "FleetEngine":
+        self.server.start(warmup=warmup)
+        self.writer.start()
+        return self
+
+    def stop(self) -> None:
+        """Graceful decommission: lease first (the router stops routing new
+        requests at the next expiry), then drain what's queued."""
+        self.writer.stop()
+        self.server.stop(drain=True)
+
+    def kill(self) -> None:
+        """The in-process SIGKILL: heartbeats stop cold and every queued
+        request fails NOW — the lease then expires on the monitor's clock,
+        exactly like a real dead process.  What the soak's mid-load engine
+        kill and the re-route invariant test exercise."""
+        self.writer.stop()
+        self.server.stop(drain=False)
+
+    def proc(self) -> "_EngineProc":
+        """Process-like view for `RoleSupervisor`/`Autoscaler` supervision:
+        ``poll()`` reports this in-process engine dead once its serve worker
+        is gone, ``kill()`` is the hard stop."""
+        return _EngineProc(self)
+
+    # ---------------------------------------------------------------- rollout
+    def adopt(self, params: Any, version: int) -> int:
+        """Adopt rollout ``version``; refuses backward versions (the engine-
+        local mirror of CheckpointWatcher's older_than_loaded refusal, so a
+        confused controller cannot regress THIS engine even if the fleet
+        check is bypassed)."""
+        version = int(version)
+        if version <= self.transport.version() and self.transport.version() > 0:
+            raise ValueError(
+                f"engine {self.engine_id}: refusing backward/duplicate weight "
+                f"rollout {version} (serving {self.transport.version()})"
+            )
+        self.server.load_params(params)
+        self.transport.set_version(version)
+        self.writer.set_weight_version(version)
+        return version
+
+
+class _EngineProc:
+    """Adapter making an in-process `FleetEngine` look like a subprocess to
+    the supervision layer (poll() -> rc or None, kill())."""
+
+    def __init__(self, engine: FleetEngine):
+        self.engine = engine
+
+    def poll(self) -> Optional[int]:
+        return None if self.engine.transport.alive() else 1
+
+    def kill(self) -> None:
+        self.engine.kill()
+
+
+class EngineRegistry:
+    """Lease-driven engine membership for the router.
+
+    ``poll()`` refreshes the lease view and returns the edge events since the
+    last call (``engine_alive`` / ``engine_dead``, once per lease epoch —
+    `HeartbeatMonitor.poll` semantics).  Without a heartbeat directory
+    (pure in-process fleets, unit tests) liveness falls back to the
+    transport's own ``alive()``.
+    """
+
+    def __init__(self, heartbeat_dir: Optional[str] = None,
+                 lease_timeout_s: float = 3.0,
+                 logger=None, obs_registry=None):
+        self.monitor = (
+            HeartbeatMonitor(heartbeat_dir, timeout_s=lease_timeout_s)
+            if heartbeat_dir else None
+        )
+        self.logger = logger
+        self.obs_registry = obs_registry
+        self._lock = threading.Lock()
+        self._handles: Dict[int, EngineHandle] = {}
+
+    # ------------------------------------------------------------ membership
+    def attach(self, engine_id: int, transport: Any) -> EngineHandle:
+        """Register a dispatchable transport for ``engine_id`` (in-process:
+        pass a `ServerTransport` or a `FleetEngine.transport`)."""
+        with self._lock:
+            handle = self._handles.get(int(engine_id))
+            if handle is None:
+                handle = EngineHandle(engine_id=int(engine_id))
+                self._handles[int(engine_id)] = handle
+            handle.transport = transport
+            handle.alive = True
+            handle.suspect_since = None  # a fresh transport is a new start
+        self._observe()
+        return handle
+
+    def detach(self, engine_id: int) -> None:
+        with self._lock:
+            self._handles.pop(int(engine_id), None)
+        self._observe()
+
+    def handles(self) -> List[EngineHandle]:
+        with self._lock:
+            return list(self._handles.values())
+
+    def get(self, engine_id: int) -> Optional[EngineHandle]:
+        with self._lock:
+            return self._handles.get(int(engine_id))
+
+    def routable(self) -> List[EngineHandle]:
+        with self._lock:
+            return [h for h in self._handles.values() if h.routable]
+
+    # ------------------------------------------------------------------ poll
+    def poll(self) -> List[Dict[str, Any]]:
+        """One membership sweep; returns the edge events it emitted."""
+        events: List[Dict[str, Any]] = []
+        if self.monitor is not None:
+            newly_dead, newly_alive = self.monitor.poll()
+            leases = self.monitor.leases()
+            now = time.time()
+            with self._lock:
+                for hid, lease in leases.items():
+                    if lease.role != "engine":
+                        continue  # training hosts share the directory
+                    handle = self._handles.get(hid)
+                    if handle is None:
+                        # discovered via lease only: visible, unroutable
+                        # until a transport attaches (the socket seam).
+                        # The monitor only edges on REVIVALS, so first
+                        # discovery of a fresh lease is the registry's own
+                        # alive edge to report.
+                        handle = EngineHandle(engine_id=hid, transport=None)
+                        self._handles[hid] = handle
+                        if lease.fresh:
+                            events.append({"event": "engine_alive",
+                                           "engine": hid,
+                                           "epoch": lease.epoch})
+                    handle.lease = lease
+                    if handle.suspect_since is not None:
+                        # only a beat WRITTEN after the mark_dead observation
+                        # rehabilitates the engine — the stale-but-fresh
+                        # final lease of a killed process does not
+                        if now - lease.age_s > handle.suspect_since:
+                            handle.suspect_since = None
+                    handle.alive = (lease.fresh
+                                    and handle.suspect_since is None)
+                for lease in newly_dead:
+                    if lease.role == "engine":
+                        events.append({"event": "engine_dead",
+                                       "engine": lease.host,
+                                       "epoch": lease.epoch})
+                for lease in newly_alive:
+                    if lease.role == "engine":
+                        events.append({"event": "engine_alive",
+                                       "engine": lease.host,
+                                       "epoch": lease.epoch})
+        else:
+            with self._lock:
+                for handle in self._handles.values():
+                    was = handle.alive
+                    now = (handle.transport is not None
+                           and handle.transport.alive())
+                    handle.alive = now
+                    if was and not now:
+                        events.append({"event": "engine_dead",
+                                       "engine": handle.engine_id})
+                    elif now and not was:
+                        events.append({"event": "engine_alive",
+                                       "engine": handle.engine_id})
+        if self.logger is not None:
+            for ev in events:
+                self.logger.log("fault", **ev)
+        self._observe()
+        return events
+
+    def mark_dead(self, engine_id: int) -> None:
+        """Immediate eviction (a dispatch observed the engine dead) — faster
+        than waiting out the lease timeout.  Sticky against the engine's
+        LAST lease file (which stays fresh up to the timeout): only a beat
+        written after this observation, or a new transport attach, revives
+        the engine."""
+        with self._lock:
+            handle = self._handles.get(int(engine_id))
+            if handle is not None:
+                handle.alive = False
+                handle.suspect_since = time.time()
+        self._observe()
+
+    # ----------------------------------------------------------------- stats
+    def _observe(self) -> None:
+        if self.obs_registry is None:
+            return
+        with self._lock:
+            handles = list(self._handles.values())
+        self.obs_registry.gauge("fleet_engines", "router").set(len(handles))
+        self.obs_registry.gauge("fleet_engines_routable", "router").set(
+            sum(1 for h in handles if h.routable))
+
+    def snapshot(self) -> Dict[str, Dict[str, Any]]:
+        """Per-engine {depth, version, alive, lanes} — the route row's
+        ``engines`` field and obs_report's depth/version spread."""
+        out: Dict[str, Dict[str, Any]] = {}
+        for h in self.handles():
+            out[str(h.engine_id)] = {
+                "depth": h.depth() if h.routable else None,
+                "version": h.version(),
+                "alive": bool(h.alive),
+                "lanes": h.lanes,
+            }
+        return out

@@ -1,0 +1,594 @@
+"""Front router: admission control, per-tenant QoS, least-depth dispatch.
+
+The IMPACT lesson (arXiv:1912.00167) applied to inference: decouple producers
+(clients) from consumers (engines) behind explicit bounds with explicit
+staleness control.  The router is shared-nothing — all state is local
+(token buckets, inflight counters, the lease view); N router processes in
+front of the same engine fleet coordinate only through the lease files, so
+the front tier scales horizontally by just running more of them.
+
+Admission (all BEFORE any queueing — a shed request costs one exception, not
+queue latency):
+
+1. **per-tenant token bucket** — a flooding tenant exhausts its own refill
+   rate and sheds with ``ServerOverloaded`` while every other tenant's
+   bucket, and therefore throughput, is untouched;
+2. **per-class inflight caps + priority reservation** — QoS classes are
+   declared in priority order with an inflight share ("gold:50:0.5,..." =
+   name:deadline_ms:share).  A class is capped at its share of the global
+   inflight bound, and lower classes additionally cannot consume the
+   headroom still reserved by higher classes — so under global pressure the
+   shed order is strictly lowest-class-first and gold's share is always
+   available to gold;
+3. **global bounded inflight** — the fleet-wide backstop.
+
+Dispatch is weighted least-depth: among routable engines whose weights are
+within ``max_weight_lag`` of the rollout target (`StalenessFence` semantics,
+per engine, role "router"), pick the minimum of
+``(queue_depth + router_inflight) / lanes``.  An accepted request survives
+engine death: the engine's futures fail with ``ServerClosed``, and the
+router re-dispatches them to surviving engines — accepted requests are only
+ever lost when NO engine remains (counted as ``lost``; the soak gates it at
+zero).
+"""
+
+from __future__ import annotations
+
+import collections
+import dataclasses
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional, Sequence, Set
+
+from rainbow_iqn_apex_tpu.parallel.elastic import StalenessFence
+from rainbow_iqn_apex_tpu.serving.batcher import (
+    ServeFuture,
+    ServerClosed,
+    ServerOverloaded,
+)
+from rainbow_iqn_apex_tpu.serving.fleet.registry import (
+    EngineDead,
+    EngineHandle,
+    EngineRegistry,
+)
+
+
+@dataclasses.dataclass(frozen=True)
+class QoSClass:
+    """One deadline tier.  ``priority`` 0 is highest (list order in the
+    spec); ``share`` is the fraction of the global inflight bound this class
+    is capped at AND has reserved against lower classes."""
+
+    name: str
+    deadline_ms: float
+    share: float
+    priority: int
+
+
+def parse_qos_classes(spec: str) -> List[QoSClass]:
+    """Parse "gold:50:0.5,std:200:0.35,batch:1000:0.15" (priority = list
+    order, first highest) into QoSClass tiers."""
+    out: List[QoSClass] = []
+    for i, part in enumerate(p for p in str(spec).split(",") if p.strip()):
+        fields = part.strip().split(":")
+        if len(fields) != 3:
+            raise ValueError(
+                f"QoS class {part!r} is not name:deadline_ms:share")
+        name, deadline_ms, share = fields
+        out.append(QoSClass(name=name.strip(), deadline_ms=float(deadline_ms),
+                            share=float(share), priority=i))
+    if not out:
+        raise ValueError(f"no QoS classes in {spec!r}")
+    names = [c.name for c in out]
+    if len(set(names)) != len(names):
+        raise ValueError(f"duplicate QoS class names in {spec!r}")
+    if sum(c.share for c in out) > 1.0 + 1e-9:
+        raise ValueError(f"QoS shares sum past 1.0 in {spec!r}")
+    return out
+
+
+def _pctl(sorted_vals: Sequence[float], q: float) -> float:
+    """Window percentile, the obs/registry.Histogram indexing convention."""
+    n = len(sorted_vals)
+    return sorted_vals[min(int(n * q), n - 1)]
+
+
+class TokenBucket:
+    """Seeded-clock token bucket: ``rate`` tokens/s up to ``burst``.
+    ``rate <= 0`` disables (always admits)."""
+
+    def __init__(self, rate: float, burst: int,
+                 clock: Callable[[], float] = time.monotonic):
+        self.rate = float(rate)
+        self.burst = max(int(burst), 1)
+        self.clock = clock
+        self.tokens = float(self.burst)
+        self._t_last = clock()
+
+    def try_take(self, n: float = 1.0) -> bool:
+        if self.rate <= 0:
+            return True
+        now = self.clock()
+        self.tokens = min(self.tokens + (now - self._t_last) * self.rate,
+                          float(self.burst))
+        self._t_last = now
+        if self.tokens >= n:
+            self.tokens -= n
+            return True
+        return False
+
+
+class RoutedFuture(ServeFuture):
+    """The client-facing future: fulfilled by whichever engine ends up
+    serving the request — possibly not the one it was first dispatched to
+    (dead-engine re-dispatch is invisible to the client beyond latency)."""
+
+    __slots__ = ("tenant", "qos", "engine_id", "tried", "_engine_cancel")
+
+    def __init__(self, obs, tenant: str, qos: QoSClass):
+        super().__init__(obs)
+        self.tenant = tenant
+        self.qos = qos
+        self.engine_id: Optional[int] = None
+        self.tried: Set[int] = set()
+        self._engine_cancel: Optional[Callable[[], bool]] = None
+
+    def cancel(self) -> bool:
+        # the cancel propagates DOWN to the engine-side future so the
+        # batcher skips its batch slot (serve_cancelled_total); the engine
+        # future's done-callback then releases the router's inflight
+        won = super().cancel()
+        if won and self._engine_cancel is not None:
+            self._engine_cancel()
+        return won
+
+
+class _Shed(ServerOverloaded):
+    """Internal: ServerOverloaded carrying the shed reason for metrics."""
+
+    def __init__(self, reason: str, detail: str):
+        super().__init__(detail)
+        self.reason = reason
+
+
+class FrontRouter:
+    """Shared-nothing front router over an `EngineRegistry`.
+
+    ``submit(obs, tenant=..., qos=...)`` -> `RoutedFuture`; sheds raise
+    ``ServerOverloaded`` (reason in ``.reason``), shutdown raises
+    ``ServerClosed``.  ``housekeeping()`` (or the ``start()`` thread) drives
+    the lease poll, the staleness fences and the periodic ``route`` row.
+    """
+
+    def __init__(
+        self,
+        registry: EngineRegistry,
+        qos_classes: Sequence[QoSClass] = (),
+        default_class: str = "",
+        max_inflight: int = 512,
+        tenant_rate: float = 0.0,
+        tenant_burst: int = 64,
+        max_weight_lag: int = 0,
+        target_version_fn: Optional[Callable[[], int]] = None,
+        logger=None,
+        obs_registry=None,
+        metrics_interval_s: float = 5.0,
+        poll_interval_s: float = 0.25,
+        reroute_window_s: float = 2.0,
+        clock: Callable[[], float] = time.monotonic,
+    ):
+        self.registry = registry
+        self.classes = list(qos_classes) or [
+            QoSClass("default", 1000.0, 1.0, 0)]
+        self._by_name = {c.name: c for c in self.classes}
+        self.default_class = (self._by_name[default_class]
+                              if default_class else self.classes[-1])
+        self.max_inflight = int(max_inflight)
+        self.tenant_rate = float(tenant_rate)
+        self.tenant_burst = int(tenant_burst)
+        self.max_weight_lag = int(max_weight_lag)
+        # rollout target: what "current" means for the staleness fence; the
+        # default (no rollout controller wired) fences against the freshest
+        # version any routable engine serves
+        self._target_version_fn = target_version_fn
+        self.logger = logger
+        self.obs_registry = obs_registry
+        self.metrics_interval_s = float(metrics_interval_s)
+        self.poll_interval_s = float(poll_interval_s)
+        self.reroute_window_s = float(reroute_window_s)
+        self.clock = clock
+        self._lock = threading.Lock()
+        self._closed = False
+        self._buckets: Dict[str, TokenBucket] = {}
+        self._inflight_total = 0
+        self._inflight_class: Dict[str, int] = {c.name: 0 for c in self.classes}
+        self._inflight_engine: Dict[int, int] = {}
+        # per-engine staleness fence (PR 4 semantics, role "router"): an
+        # engine behind the rollout target by more than max_weight_lag is
+        # unroutable until it catches up — stale weights answer live traffic
+        # exactly as silently as they corrupt replay
+        self._fences: Dict[int, StalenessFence] = {}
+        # window counters (route row cadence; lifetime mirrors kept too)
+        self._win = self._zero_window()
+        self.totals = self._zero_window()
+        # bounded like ServeMetrics' window: a router whose route rows are
+        # off (metrics_interval_s <= 0) must not grow latency state forever
+        self._latency_ms: collections.deque = collections.deque(maxlen=65536)
+        # accepted requests whose dead-engine re-dispatch found only FULL
+        # survivors: parked here and retried by housekeeping until the
+        # reroute window closes — momentary backpressure on a survivor must
+        # not turn an accepted request into a loss (the zero-loss invariant
+        # only yields when NO engine remains)
+        self._retry: collections.deque = collections.deque()
+        self._thread: Optional[threading.Thread] = None
+        self._stop = threading.Event()
+        self._t_last_emit = clock()
+
+    @staticmethod
+    def _zero_window() -> Dict[str, Any]:
+        return {
+            "accepted": 0, "shed": 0, "completed": 0, "failed": 0,
+            "rerouted": 0, "lost": 0, "cancelled": 0,
+            "shed_by_reason": {}, "tenants": {},
+        }
+
+    @classmethod
+    def from_config(cls, cfg, registry: EngineRegistry, **kwargs) -> "FrontRouter":
+        return cls(
+            registry,
+            qos_classes=parse_qos_classes(cfg.fleet_qos_classes),
+            default_class=cfg.fleet_default_class,
+            max_inflight=cfg.fleet_max_inflight,
+            tenant_rate=cfg.fleet_tenant_rate,
+            tenant_burst=cfg.fleet_tenant_burst,
+            max_weight_lag=cfg.max_weight_lag,
+            metrics_interval_s=cfg.serve_metrics_interval_s,
+            **kwargs,
+        )
+
+    # -------------------------------------------------------------- admission
+    def _tenant_window(self, tenant: str) -> Dict[str, int]:
+        t = self._win["tenants"].get(tenant)
+        if t is None:
+            t = {"accepted": 0, "shed": 0}
+            self._win["tenants"][tenant] = t
+        tt = self.totals["tenants"].get(tenant)
+        if tt is None:
+            self.totals["tenants"][tenant] = {"accepted": 0, "shed": 0}
+        return t
+
+    def _shed_locked(self, tenant: str, reason: str) -> None:
+        self._win["shed"] += 1
+        self.totals["shed"] += 1
+        for w in (self._win, self.totals):
+            w["shed_by_reason"][reason] = w["shed_by_reason"].get(reason, 0) + 1
+        self._tenant_window(tenant)["shed"] += 1
+        self.totals["tenants"][tenant]["shed"] += 1
+        if self.obs_registry is not None:
+            self.obs_registry.counter("route_shed_total", "router").inc()
+
+    def _reserved_above_locked(self, qos: QoSClass) -> int:
+        """Inflight headroom still reserved by classes of HIGHER priority —
+        capacity a lower class may not consume (the shed order)."""
+        reserved = 0
+        for c in self.classes:
+            if c.priority >= qos.priority:
+                continue
+            cap = int(c.share * self.max_inflight)
+            reserved += max(0, cap - self._inflight_class[c.name])
+        return reserved
+
+    def _admit_locked(self, tenant: str, qos: QoSClass) -> Optional[str]:
+        """None to admit, else the shed reason."""
+        bucket = self._buckets.get(tenant)
+        if bucket is None:
+            bucket = TokenBucket(self.tenant_rate, self.tenant_burst,
+                                 clock=self.clock)
+            self._buckets[tenant] = bucket
+        if not bucket.try_take():
+            return "tenant_rate"
+        cap = max(int(qos.share * self.max_inflight), 1)
+        if self._inflight_class[qos.name] >= cap:
+            return "class_inflight"
+        if (self._inflight_total + 1 + self._reserved_above_locked(qos)
+                > self.max_inflight):
+            return "global_inflight"
+        return None
+
+    # --------------------------------------------------------------- dispatch
+    def _candidates(self, exclude: Set[int]) -> List[EngineHandle]:
+        """Routable engines within the weight-lag fence, least-depth first
+        (depth + this router's own inflight, weighted by lane count)."""
+        target = self.target_version()
+        ranked = []
+        with self._lock:
+            inflight = dict(self._inflight_engine)
+        for h in self.registry.routable():
+            if h.engine_id in exclude:
+                continue
+            fence = self._fences.get(h.engine_id)
+            if fence is None:
+                fence = StalenessFence(self.max_weight_lag, metrics=self.logger,
+                                       registry=self.obs_registry, role="router")
+                self._fences[h.engine_id] = fence
+            if not fence.observe(h.version(), target, frames_at_stake=1):
+                continue
+            score = (h.depth() + inflight.get(h.engine_id, 0)) / h.lanes
+            ranked.append((score, h.engine_id, h))
+        ranked.sort(key=lambda t: t[:2])
+        return [h for _, _, h in ranked]
+
+    def target_version(self) -> int:
+        if self._target_version_fn is not None:
+            return int(self._target_version_fn())
+        versions = [h.version() for h in self.registry.routable()]
+        return max(versions, default=0)
+
+    def _dispatch(self, rf: RoutedFuture) -> bool:
+        """Try engines least-depth first; bind the first that takes it."""
+        for h in self._candidates(exclude=rf.tried):
+            try:
+                fut = h.transport.submit(rf.obs)
+            except ServerOverloaded:
+                # momentarily full, NOT dead: a later attempt (the retry
+                # queue) may still land here once its batcher drains
+                continue
+            except (ServerClosed, EngineDead):
+                rf.tried.add(h.engine_id)
+                continue
+            rf.engine_id = h.engine_id
+            rf.tried.add(h.engine_id)
+            with self._lock:
+                self._inflight_engine[h.engine_id] = (
+                    self._inflight_engine.get(h.engine_id, 0) + 1)
+            fut.add_done_callback(
+                lambda f, rf=rf, eid=h.engine_id: self._on_engine_done(rf, eid, f))
+            # a client cancel must reach the ENGINE future so the batcher
+            # skips its slot; wire it through the routed future
+            rf._engine_cancel = fut.cancel
+            return True
+        return False
+
+    def submit(self, obs, tenant: str = "default",
+               qos: Optional[str] = None) -> RoutedFuture:
+        """Admit + dispatch one request.  Raises ``ServerOverloaded`` on any
+        shed (``.reason`` says which bound), ``ServerClosed`` after stop()."""
+        if qos is not None and qos not in self._by_name:
+            raise ValueError(f"unknown QoS class {qos!r}; "
+                             f"valid: {sorted(self._by_name)}")
+        klass = self._by_name[qos] if qos else self.default_class
+        with self._lock:
+            if self._closed:
+                raise ServerClosed("router is shut down")
+            reason = self._admit_locked(tenant, klass)
+            if reason is not None:
+                self._shed_locked(tenant, reason)
+                raise _Shed(reason, f"router shed ({reason}) tenant={tenant} "
+                                    f"class={klass.name}")
+            # reserve BEFORE dispatch: concurrent submits must see the slot
+            self._inflight_total += 1
+            self._inflight_class[klass.name] += 1
+        rf = RoutedFuture(obs, tenant, klass)
+        if not self._dispatch(rf):
+            with self._lock:
+                self._inflight_total -= 1
+                self._inflight_class[klass.name] -= 1
+                n_routable = len(self.registry.routable())
+                reason = "no_engine" if n_routable == 0 else "engine_backpressure"
+                self._shed_locked(tenant, reason)
+            raise _Shed(reason, f"router shed ({reason}) tenant={tenant}")
+        with self._lock:
+            self._win["accepted"] += 1
+            self.totals["accepted"] += 1
+            self._tenant_window(tenant)["accepted"] += 1
+            self.totals["tenants"][tenant]["accepted"] += 1
+        if self.obs_registry is not None:
+            self.obs_registry.counter("route_accepted_total", "router").inc()
+            self.obs_registry.gauge("route_inflight", "router").set(
+                self._inflight_total)
+        return rf
+
+    # ------------------------------------------------- completion / re-route
+    def _release_locked(self, rf: RoutedFuture) -> None:
+        self._inflight_total = max(self._inflight_total - 1, 0)
+        self._inflight_class[rf.qos.name] = max(
+            self._inflight_class[rf.qos.name] - 1, 0)
+
+    def _on_engine_done(self, rf: RoutedFuture, engine_id: int,
+                        fut: ServeFuture) -> None:
+        """Runs on the engine worker (or cancelling client) thread whenever
+        an engine-side future settles."""
+        with self._lock:
+            n = self._inflight_engine.get(engine_id, 0)
+            self._inflight_engine[engine_id] = max(n - 1, 0)
+        if fut.cancelled() or rf.cancelled():
+            with self._lock:
+                self._release_locked(rf)
+                self._win["cancelled"] += 1
+                self.totals["cancelled"] += 1
+            return
+        err = fut._error  # settled: no race left on the slot
+        if err is None:
+            rf.set_result(fut._action, fut._q)
+            with self._lock:
+                self._release_locked(rf)
+                self._win["completed"] += 1
+                self.totals["completed"] += 1
+                self._latency_ms.append(
+                    (time.monotonic() - rf.t_enqueue) * 1e3)
+            return
+        if isinstance(err, (ServerClosed, EngineDead)):
+            # the engine died with this ACCEPTED request queued: re-route to
+            # a survivor.  Eagerly mark the engine dead so concurrent
+            # dispatches stop picking it before the lease times out.
+            self.registry.mark_dead(engine_id)
+            if self._dispatch(rf):
+                self._count_reroute()
+                return
+            if any(h.engine_id not in rf.tried
+                   for h in self.registry.routable()):
+                # survivors exist but were momentarily FULL: park for the
+                # housekeeping retry loop — backpressure is not death, and
+                # declaring this accepted request lost here would break the
+                # zero-loss invariant against a healthy fleet
+                with self._lock:
+                    self._retry.append(
+                        (rf, self.clock() + self.reroute_window_s))
+                return
+            self._lose(rf, engine_id)
+            return
+        # a real inference error: propagate to the client
+        with self._lock:
+            self._release_locked(rf)
+            self._win["failed"] += 1
+            self.totals["failed"] += 1
+        rf.set_error(err)
+
+    def _count_reroute(self) -> None:
+        with self._lock:
+            self._win["rerouted"] += 1
+            self.totals["rerouted"] += 1
+        if self.obs_registry is not None:
+            self.obs_registry.counter("route_rerouted_total", "router").inc()
+
+    def _lose(self, rf: RoutedFuture, engine_id: Optional[int]) -> None:
+        with self._lock:
+            self._release_locked(rf)
+            self._win["lost"] += 1
+            self.totals["lost"] += 1
+        if self.obs_registry is not None:
+            self.obs_registry.counter("route_lost_total", "router").inc()
+        rf.set_error(ServerClosed(
+            f"request lost: engine {engine_id} died and no engine "
+            f"could take the re-route"))
+
+    def _drain_retries(self) -> None:
+        """Re-attempt parked re-routes; a request is lost only once no
+        engine remains or its reroute window closes."""
+        while True:
+            with self._lock:
+                if not self._retry:
+                    return
+                rf, deadline = self._retry.popleft()
+            if rf.cancelled():
+                with self._lock:
+                    self._release_locked(rf)
+                    self._win["cancelled"] += 1
+                    self.totals["cancelled"] += 1
+                continue
+            if self._dispatch(rf):
+                self._count_reroute()
+                continue
+            routable = any(h.engine_id not in rf.tried
+                           for h in self.registry.routable())
+            if not routable or self.clock() >= deadline:
+                self._lose(rf, rf.engine_id)
+                continue
+            with self._lock:
+                self._retry.appendleft((rf, deadline))
+            return  # still full: let the queues drain until the next sweep
+
+    # ----------------------------------------------------------- housekeeping
+    def housekeeping(self) -> List[Dict[str, Any]]:
+        """One sweep: lease poll (+ edge events), parked re-route retries,
+        periodic route row."""
+        events = self.registry.poll()
+        self._drain_retries()
+        now = self.clock()
+        if (self.metrics_interval_s > 0
+                and now - self._t_last_emit >= self.metrics_interval_s):
+            self._t_last_emit = now
+            self.emit_route_row()
+        return events
+
+    def emit_route_row(self) -> Dict[str, Any]:
+        """Snapshot-and-reset the window into one ``route`` JSONL row."""
+        with self._lock:
+            row: Dict[str, Any] = {
+                k: self._win[k]
+                for k in ("accepted", "shed", "completed", "failed",
+                          "rerouted", "lost", "cancelled")
+            }
+            row["shed_by_reason"] = dict(self._win["shed_by_reason"])
+            row["tenants"] = {t: dict(v)
+                              for t, v in self._win["tenants"].items()}
+            row["inflight"] = self._inflight_total
+            lat = sorted(self._latency_ms)
+            self._win = self._zero_window()
+            self._latency_ms.clear()
+        if lat:
+            row["latency_p50_ms"] = round(_pctl(lat, 0.5), 3)
+            row["latency_p99_ms"] = round(_pctl(lat, 0.99), 3)
+        row["engines"] = self.registry.snapshot()
+        row["target_version"] = self.target_version()
+        if self.logger is not None:
+            self.logger.log("route", **row)
+        return row
+
+    def p99_ms(self) -> Optional[float]:
+        """Current-window completion p99 (the autoscaler's latency input)."""
+        with self._lock:
+            lat = sorted(self._latency_ms)
+        return _pctl(lat, 0.99) if lat else None
+
+    def mean_depth_fraction(self, queue_bound: int) -> float:
+        """Mean routable-engine queue fill fraction (the autoscaler's depth
+        input); 1.0 when NO engine is routable — an engine-starved fleet
+        must read as maximally loaded, not idle."""
+        handles = self.registry.routable()
+        if not handles:
+            return 1.0
+        return sum(min(h.depth() / max(queue_bound, 1), 1.0)
+                   for h in handles) / len(handles)
+
+    def inflight(self) -> int:
+        with self._lock:
+            return self._inflight_total
+
+    # -------------------------------------------------------------- lifecycle
+    def _run(self) -> None:
+        while not self._stop.wait(self.poll_interval_s):
+            try:
+                self.housekeeping()
+            except Exception:
+                pass  # a flaky lease read must not kill the router loop
+
+    def start(self) -> "FrontRouter":
+        if self._thread is None:
+            self._thread = threading.Thread(
+                target=self._run, name="fleet-router", daemon=True)
+            self._thread.start()
+        return self
+
+    def stop(self) -> Dict[str, Any]:
+        with self._lock:
+            self._closed = True
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=10)
+            self._thread = None
+        self._drain_retries()  # one last placement attempt, then fail fast:
+        # a parked request must not hang its client until result() times out
+        while True:
+            with self._lock:
+                if not self._retry:
+                    break
+                rf, _ = self._retry.popleft()
+                self._release_locked(rf)
+                self._win["failed"] += 1
+                self.totals["failed"] += 1
+            rf.set_error(ServerClosed("router stopped with the re-route "
+                                      "still parked"))
+        self.emit_route_row()
+        return self.stats()
+
+    def stats(self) -> Dict[str, Any]:
+        with self._lock:
+            out = {k: self.totals[k]
+                   for k in ("accepted", "shed", "completed", "failed",
+                             "rerouted", "lost", "cancelled")}
+            out["shed_by_reason"] = dict(self.totals["shed_by_reason"])
+            out["tenants"] = {t: dict(v)
+                              for t, v in self.totals["tenants"].items()}
+            out["inflight"] = self._inflight_total
+        return out

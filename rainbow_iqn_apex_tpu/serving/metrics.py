@@ -46,6 +46,7 @@ class ServeMetrics:
         self.registry = registry if registry is not None else obs_registry.get()
         self._c_requests = self.registry.counter("serve_requests_total", "serve")
         self._c_shed = self.registry.counter("serve_shed_total", "serve")
+        self._c_cancelled = self.registry.counter("serve_cancelled_total", "serve")
         self._c_batches = self.registry.counter("serve_batches_total", "serve")
         self._c_swaps = self.registry.counter("serve_swaps_total", "serve")
         self._c_padded = self.registry.counter("serve_padded_rows_total", "serve")
@@ -59,12 +60,14 @@ class ServeMetrics:
         self.total_shed = 0
         self.total_batches = 0
         self.total_swaps = 0
+        self.total_cancelled = 0
 
     def _reset_window(self) -> None:
         self._win_requests = 0
         self._win_rows_padded = 0
         self._win_batches = 0
         self._win_shed = 0
+        self._win_cancelled = 0
         self._win_queue_depth_sum = 0.0
 
     # ------------------------------------------------------------- recording
@@ -91,6 +94,16 @@ class ServeMetrics:
             self._win_shed += n
             self.total_shed += n
         self._c_shed.inc(n)
+
+    def record_cancelled(self, n: int = 1) -> None:
+        """Queued futures dropped by the batcher because their client
+        cancelled (result() timeout, disconnect) — capacity saved, not an
+        error; a climbing rate means clients are giving up faster than the
+        server answers."""
+        with self._lock:
+            self._win_cancelled += n
+            self.total_cancelled += n
+        self._c_cancelled.inc(n)
 
     def record_swap(self, **fields: Any) -> None:
         """A completed (or failed) weight swap; always emitted immediately —
@@ -121,6 +134,7 @@ class ServeMetrics:
             "requests": self._win_requests,
             "batches": self._win_batches,
             "shed": self._win_shed,
+            "cancelled": self._win_cancelled,
             "batch_occupancy_mean": round(self._win_requests / batches, 3),
             # an idle window pays no padding tax (0/0 is NOT "100% padded")
             "pad_fraction": 0.0 if self._win_rows_padded == 0 else round(
@@ -156,6 +170,7 @@ class ServeMetrics:
             "total_shed": self.total_shed,
             "total_batches": self.total_batches,
             "total_swaps": self.total_swaps,
+            "total_cancelled": self.total_cancelled,
             "batch_occupancy_lifetime": round(
                 self.total_requests / max(self.total_batches, 1), 3
             ),

@@ -218,16 +218,37 @@ class PolicyServer:
             raise TypeError(f"observations must be uint8 frames, got {arr.dtype}")
         return self.batcher.submit(arr)
 
+    def try_submit(self, obs: np.ndarray) -> Optional[ServeFuture]:
+        """submit() that returns None on a full queue instead of recording a
+        shed — for the fleet router's multi-engine dispatch probes (the
+        router owns the shed story; see MicroBatcher.try_submit)."""
+        arr = np.asarray(obs)
+        if tuple(arr.shape) != self._obs_shape:
+            raise ValueError(
+                f"observation shape {tuple(arr.shape)} != served {self._obs_shape}"
+            )
+        if arr.dtype != np.uint8:
+            raise TypeError(f"observations must be uint8 frames, got {arr.dtype}")
+        return self.batcher.try_submit(arr)
+
     def act(self, obs: np.ndarray, timeout: Optional[float] = 30.0) -> int:
         """Blocking convenience: one observation in, one action out."""
-        action, _ = self.submit(obs).result(timeout)
+        action, _ = self.act_values(obs, timeout)
         return action
 
     def act_values(
         self, obs: np.ndarray, timeout: Optional[float] = 30.0
     ) -> Tuple[int, np.ndarray]:
-        """Blocking act returning (action, expected Q per action [A])."""
-        return self.submit(obs).result(timeout)
+        """Blocking act returning (action, expected Q per action [A]).
+        A timed-out request is CANCELLED before the TimeoutError propagates:
+        this client has given up, so the batcher must not pad, dispatch and
+        fulfil its dead slot (counted as serve_cancelled_total)."""
+        fut = self.submit(obs)
+        try:
+            return fut.result(timeout)
+        except TimeoutError:
+            fut.cancel()
+            raise
 
     def reload(self, step: Optional[int] = None, force: bool = False) -> Dict[str, Any]:
         """Explicit hot-swap from the watched checkpoint dir."""

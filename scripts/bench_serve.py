@@ -16,8 +16,22 @@ CPU smoke shape (default): 44x44x2 frames, hidden 64, IQN taus 8/8/4 — the
 same small-but-real network the parallel tests use, so the numbers track the
 serving machinery, not conv throughput.
 
+``--fleet-soak`` switches to the heavy-traffic fleet scenario
+(serving/fleet/, docs/SERVING.md "fleet"): an in-process router + N-engine
+fleet under bursty OPEN-LOOP arrivals from multiple QoS tenants, a cohort of
+deliberately slow clients that abandon (cancel) their requests, one engine
+killed cold mid-load (lease expiry -> re-route; the supervisor respawns it
+with backoff), and two fleet-wide weight rollouts — one of which is a
+deliberate BACKWARD publish that must be refused.  Gates (enforced, exit 1):
+zero lost accepted requests, every accepted request accounted for, p99 and
+shed-rate bounds, rollout convergence with no version rollback.  The result
+is one ``fleet_soak`` row in the PR-6 budgeted-row convention (no ``status``
+key when healthy; ``"status": "error"/"gate_failed"`` otherwise), plus a
+lint-clean run dir of route/scale/rollout/serve JSONL.
+
 Usage:
     JAX_PLATFORMS=cpu python scripts/bench_serve.py --clients 64 --requests 2000
+    JAX_PLATFORMS=cpu python scripts/bench_serve.py --fleet-soak --engines 2
 """
 
 import argparse
@@ -42,6 +56,363 @@ def row(**fields):
     print(json.dumps(fields), flush=True)
 
 
+class _InProcFleet:
+    """The soak's in-process fleet: N PolicyServers wrapped as FleetEngines
+    (lease self-registration in a shared heartbeat dir), one EngineRegistry +
+    FrontRouter over them, a RoleSupervisor-backed Autoscaler, and a
+    FleetRollout — the full serving/fleet composition on one host."""
+
+    def __init__(self, cfg, num_actions, params, out_dir):
+        import jax
+
+        from rainbow_iqn_apex_tpu.obs.registry import MetricRegistry
+        from rainbow_iqn_apex_tpu.parallel.elastic import RoleSupervisor
+        from rainbow_iqn_apex_tpu.serving import PolicyServer
+        from rainbow_iqn_apex_tpu.serving.fleet import (
+            Autoscaler,
+            EngineRegistry,
+            FleetEngine,
+            FleetRollout,
+            FrontRouter,
+            ScalePolicy,
+        )
+        from rainbow_iqn_apex_tpu.utils.logging import MetricsLogger
+
+        self.cfg = cfg
+        self.num_actions = num_actions
+        self.params = params
+        self.out_dir = out_dir
+        self._jax = jax
+        self._PolicyServer = PolicyServer
+        self._FleetEngine = FleetEngine
+        self.logger = MetricsLogger(
+            os.path.join(out_dir, "metrics.jsonl"), run_id=cfg.run_id,
+            echo=False)
+        self.obs = MetricRegistry()
+        self.hb_dir = os.path.join(out_dir, "heartbeats")
+        self.registry = EngineRegistry(
+            self.hb_dir, lease_timeout_s=cfg.fleet_lease_timeout_s,
+            logger=self.logger, obs_registry=self.obs)
+        self.rollout = FleetRollout(logger=self.logger, obs_registry=self.obs)
+        self.router = FrontRouter.from_config(
+            cfg, self.registry, target_version_fn=self.rollout.version,
+            logger=self.logger, obs_registry=self.obs)
+        self.router.metrics_interval_s = 1.0
+        self.supervisor = RoleSupervisor.from_config(
+            cfg, metrics=self.logger, registry=self.obs)
+        self.autoscaler = Autoscaler(
+            ScalePolicy.from_config(cfg),
+            spawn_engine=self.spawn_engine,
+            stop_engine=self.stop_engine,
+            load_fn=self.load,
+            supervisor=self.supervisor,
+            logger=self.logger, obs_registry=self.obs)
+        self.engines = {}
+
+    def spawn_engine(self, engine_id, epoch):
+        """Boot one engine (fresh PolicyServer + lease at ``epoch``), attach
+        it to the registry and catch it up to the rollout target.  Also the
+        supervisor's respawn path after a kill."""
+        server = self._PolicyServer(
+            self.cfg, self.num_actions, self.params,
+            devices=self._jax.devices()[:1],
+            metrics_path=os.path.join(self.out_dir, f"engine{engine_id}.jsonl"),
+        )
+        engine = self._FleetEngine(
+            server, engine_id, self.hb_dir,
+            interval_s=self.cfg.fleet_lease_interval_s, epoch=epoch)
+        engine.start(warmup=True)
+        self.engines[engine_id] = engine
+        self.registry.attach(engine_id, engine.transport)
+        self.rollout.track(engine)
+        self.rollout.sync()
+        return engine.proc()
+
+    def stop_engine(self, engine_id):
+        engine = self.engines.pop(engine_id, None)
+        if engine is not None:
+            self.rollout.untrack(engine_id)
+            self.registry.detach(engine_id)
+            engine.stop()
+
+    def kill_engine(self, engine_id):
+        """The mid-soak SIGKILL analog: heartbeats stop cold, queued
+        requests fail NOW (the router re-routes them), the lease expires on
+        the monitor's clock and the supervisor respawns with backoff."""
+        engine = self.engines.get(engine_id)
+        if engine is not None:
+            engine.kill()
+
+    def load(self):
+        return {
+            "engines": len(self.registry.routable()),
+            "depth_frac": self.router.mean_depth_fraction(
+                self.cfg.serve_queue_bound),
+            "p99_ms": self.router.p99_ms(),
+        }
+
+    def start(self, n_engines):
+        for i in range(n_engines):
+            proc = self.spawn_engine(i, 0)
+            self.autoscaler.adopt_engine(i, proc=proc)
+        self.router.start()
+
+    def stop(self):
+        self.router.stop()
+        self.supervisor.stop_all()
+        for engine_id in list(self.engines):
+            self.stop_engine(engine_id)
+        self.logger.close()
+
+
+def fleet_soak(args) -> int:
+    import numpy as np
+
+    import jax
+
+    from rainbow_iqn_apex_tpu.config import Config
+    from rainbow_iqn_apex_tpu.ops.learn import init_train_state
+    from rainbow_iqn_apex_tpu.serving import ServerOverloaded
+
+    out_dir = (args.out if args.out != "results/serve_bench"
+               else "results/fleet_soak")
+    os.makedirs(out_dir, exist_ok=True)
+    cfg = Config(
+        compute_dtype="float32",
+        frame_height=44, frame_width=44, history_length=2,
+        hidden_size=64, num_cosines=16,
+        num_tau_samples=8, num_tau_prime_samples=8, num_quantile_samples=4,
+        serve_batch_buckets=args.buckets,
+        serve_deadline_ms=args.deadline_ms,
+        serve_queue_bound=64,  # small per-engine bound: the soak WANTS
+        # backpressure visible at the router, not hidden in deep queues
+        serve_mode=args.mode,
+        serve_metrics_interval_s=1.0,
+        fleet_min_engines=args.engines,
+        fleet_max_engines=args.max_engines,
+        fleet_max_inflight=256,
+        fleet_tenant_rate=args.rate,  # one tenant alone cannot flood the
+        fleet_tenant_burst=64,        # fleet past the aggregate target rate
+        fleet_lease_interval_s=0.25,
+        fleet_lease_timeout_s=1.5,
+        fleet_scale_patience=3,
+        fleet_scale_cooldown_s=2.0,
+        max_weight_lag=1,  # a respawned engine serves only after it is
+        # caught up to within one publish of the rollout target
+        respawn_base_s=0.2, respawn_max_s=1.0,
+        run_id="fleet_soak",
+        seed=args.seed,
+    )
+    state = init_train_state(cfg, args.num_actions, jax.random.PRNGKey(0))
+    fleet = _InProcFleet(cfg, args.num_actions, state.params, out_dir)
+    row(event="fleet_soak_start", engines=args.engines,
+        max_engines=args.max_engines, duration_s=args.duration,
+        rate=args.rate, out=out_dir)
+    t0 = time.monotonic()
+    fleet.start(args.engines)
+    fleet.rollout.publish(state.params, version=1)
+    row(event="fleet_up", engines=len(fleet.engines),
+        boot_s=round(time.monotonic() - t0, 2))
+
+    rng = np.random.default_rng(args.seed)
+    obs_pool = rng.integers(0, 255, (64, 44, 44, 2), dtype=np.uint8)
+    stop_ev = threading.Event()
+    lock = threading.Lock()
+    counts = {"submitted": 0, "shed": 0, "slow_submitted": 0,
+              "slow_cancelled": 0, "slow_served": 0}
+    latencies = []
+
+    def collect(fut):
+        if fut.cancelled():
+            return
+        try:
+            fut.result(timeout=0)
+        except Exception:
+            return
+        with lock:
+            latencies.append((time.monotonic() - fut.t_enqueue) * 1e3)
+
+    # three tenants across the QoS tiers; "burst" rides the lowest class so
+    # its flood sheds FIRST under pressure (the QoS story, observable in the
+    # route rows' shed_by_reason/tenants split)
+    tenants = [("gold_t", "gold", 0.2), ("std_t", "std", 0.5),
+               ("burst_t", "batch", 0.3)]
+
+    def arrivals(worker_seed):
+        """Open-loop generator: submissions happen on the wall-clock
+        schedule whether or not the fleet keeps up — the IMPACT-style
+        decoupling the admission layer exists for."""
+        wrng = np.random.default_rng(worker_seed)
+        t_end = t0_load + args.duration
+        i = 0
+        while not stop_ev.is_set() and time.monotonic() < t_end:
+            phase = ((time.monotonic() - t0_load) % args.burst_period
+                     < args.burst_period * 0.5)
+            rate = args.rate * (args.burst_factor if phase else 0.3)
+            time.sleep(min(float(wrng.exponential(1.0 / max(rate, 1e-6))),
+                           0.05))
+            r = wrng.random()
+            acc = 0.0
+            for name, qos, share in tenants:
+                acc += share
+                if r <= acc:
+                    break
+            with lock:
+                counts["submitted"] += 1
+            try:
+                fut = fleet.router.submit(
+                    obs_pool[i % len(obs_pool)], tenant=name, qos=qos)
+                fut.add_done_callback(collect)
+            except ServerOverloaded:
+                with lock:
+                    counts["shed"] += 1
+            i += 1
+
+    def slow_client(worker_seed):
+        """Deliberately slow cohort: submit, give up almost immediately,
+        CANCEL — abandoned futures must not burn batch capacity
+        (serve_cancelled_total counts the skips)."""
+        wrng = np.random.default_rng(worker_seed)
+        t_end = t0_load + args.duration
+        i = 0
+        while not stop_ev.is_set() and time.monotonic() < t_end:
+            with lock:
+                counts["slow_submitted"] += 1
+            try:
+                fut = fleet.router.submit(
+                    obs_pool[i % len(obs_pool)], tenant="slow_t", qos="batch")
+            except ServerOverloaded:
+                time.sleep(0.01)
+                continue
+            try:
+                fut.result(timeout=args.slow_timeout)
+                with lock:
+                    counts["slow_served"] += 1
+            except TimeoutError:
+                fut.cancel()
+                with lock:
+                    counts["slow_cancelled"] += 1
+            except Exception:
+                pass  # engine-kill window: the error is the router's story
+            time.sleep(float(wrng.exponential(0.02)))
+            i += 1
+
+    t0_load = time.monotonic()
+    threads = [threading.Thread(target=arrivals, args=(args.seed + 1,),
+                                daemon=True)]
+    threads += [threading.Thread(target=slow_client, args=(args.seed + 10 + k,),
+                                 daemon=True)
+                for k in range(args.slow_clients)]
+    for t in threads:
+        t.start()
+
+    killed = rolled_v2 = refused_checked = False
+    kill_at = t0_load + args.duration * args.kill_frac
+    while time.monotonic() < t0_load + args.duration:
+        fleet.autoscaler.evaluate()
+        fleet.rollout.sync()
+        fleet.rollout.maybe_emit_converged()
+        now = time.monotonic()
+        if not killed and now >= kill_at:
+            victim = min(fleet.engines)
+            # catch the victim with requests QUEUED, so the kill provably
+            # exercises the re-route path (gated rerouted >= 1 below) —
+            # under open-loop load this spin resolves in milliseconds
+            spin_deadline = time.monotonic() + 2.0
+            transport = fleet.engines[victim].transport
+            while (transport.depth() < 2
+                   and time.monotonic() < spin_deadline):
+                time.sleep(0.001)
+            depth_at_kill = transport.depth()
+            fleet.kill_engine(victim)
+            killed = True
+            row(event="engine_killed", engine=victim,
+                depth_at_kill=depth_at_kill,
+                at_s=round(now - t0_load, 2))
+        if killed and not rolled_v2 and now >= kill_at + 0.5:
+            perturbed = jax.tree.map(lambda x: x + 0.01, state.params)
+            fleet.rollout.publish(perturbed, version=2)
+            rolled_v2 = True
+            row(event="rollout_fired", version=2)
+        if rolled_v2 and not refused_checked:
+            refused = fleet.rollout.publish(state.params, version=1)
+            refused_checked = True
+            row(event="backward_publish_refused",
+                ok=refused.get("event") == "refused_backward")
+        time.sleep(0.2)
+    stop_ev.set()
+    for t in threads:
+        t.join(timeout=10)
+
+    # drain: every accepted request must settle (complete, cancel or — the
+    # gated failure — be lost); respawn/rollout stragglers get a last sync
+    drain_deadline = time.monotonic() + 30
+    while fleet.router.inflight() > 0 and time.monotonic() < drain_deadline:
+        fleet.autoscaler.evaluate()
+        fleet.rollout.sync()
+        time.sleep(0.1)
+    converged = fleet.rollout.wait_converged(timeout_s=15.0)
+    versions = fleet.rollout.engine_versions()
+    wall_s = time.monotonic() - t0_load
+    stats = fleet.router.stats()
+    fleet.stop()
+
+    lat = sorted(latencies)
+    p99 = lat[min(int(len(lat) * 0.99), len(lat) - 1)] if lat else None
+    p50 = lat[len(lat) // 2] if lat else None
+    accepted = stats["accepted"]
+    settled = (stats["completed"] + stats["cancelled"] + stats["failed"]
+               + stats["lost"])
+    shed_rate = stats["shed"] / max(counts["submitted"]
+                                    + counts["slow_submitted"], 1)
+    gates = {
+        "lost_zero": stats["lost"] == 0,
+        "accepted_accounted": settled == accepted,
+        "p99_ms": p99 is not None and p99 <= args.p99_gate_ms,
+        "shed_rate": shed_rate <= args.shed_gate,
+        # the kill waited for queued requests on the victim, so the re-route
+        # path MUST have fired — a vacuous pass here would mean the soak
+        # never exercised what it claims to gate
+        "rerouted_after_kill": stats["rerouted"] >= 1,
+        "rollout_converged": converged,
+        # the deliberate backward publish was refused AND the fleet target
+        # ended where the forward publishes left it — no rollback happened
+        "no_rollback": (fleet.rollout.refused == 1
+                        and fleet.rollout.target_version == 2),
+        "cancel_worked": counts["slow_cancelled"] == 0
+        or stats["cancelled"] > 0,
+    }
+    result = {
+        "path": "fleet_soak",
+        "metric": "fleet_soak_requests_per_sec",
+        "value": round(stats["completed"] / max(wall_s, 1e-9), 1),
+        "unit": "req/s",
+        "wall_s": round(wall_s, 2),
+        "submitted": counts["submitted"] + counts["slow_submitted"],
+        "accepted": accepted,
+        "completed": stats["completed"],
+        "shed": stats["shed"],
+        "shed_rate": round(shed_rate, 4),
+        "shed_by_reason": stats["shed_by_reason"],
+        "rerouted": stats["rerouted"],
+        "lost": stats["lost"],
+        "cancelled": stats["cancelled"],
+        "slow_cancelled": counts["slow_cancelled"],
+        "latency_p50_ms": None if p50 is None else round(p50, 2),
+        "latency_p99_ms": None if p99 is None else round(p99, 2),
+        "engine_versions": {str(k): v for k, v in versions.items()},
+        "rollout_converged": converged,
+        "tenants": stats["tenants"],
+        "gates": gates,
+    }
+    if not all(gates.values()):
+        result["status"] = "gate_failed"
+        row(**result)
+        return 1
+    row(**result)
+    return 0
+
+
 def main() -> int:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("--clients", type=int, default=64)
@@ -55,7 +426,33 @@ def main() -> int:
     ap.add_argument("--num-actions", type=int, default=6)
     ap.add_argument("--out", default="results/serve_bench",
                     help="directory for the JSONL metrics log")
+    # ---- fleet soak (serving/fleet/) ----
+    ap.add_argument("--fleet-soak", action="store_true",
+                    help="run the router+fleet heavy-traffic soak instead")
+    ap.add_argument("--engines", type=int, default=2,
+                    help="initial engine count (fleet soak)")
+    ap.add_argument("--max-engines", type=int, default=3,
+                    help="autoscaler ceiling (fleet soak)")
+    ap.add_argument("--duration", type=float, default=8.0,
+                    help="seconds of open-loop arrivals (fleet soak)")
+    ap.add_argument("--rate", type=float, default=250.0,
+                    help="mean arrivals/s across tenants (fleet soak)")
+    ap.add_argument("--burst-factor", type=float, default=3.0,
+                    help="hi-phase arrival multiplier (lo phase = 0.3x)")
+    ap.add_argument("--burst-period", type=float, default=2.0)
+    ap.add_argument("--slow-clients", type=int, default=3,
+                    help="cohort of clients that abandon (cancel) requests")
+    ap.add_argument("--slow-timeout", type=float, default=0.03,
+                    help="seconds a slow client waits before giving up")
+    ap.add_argument("--kill-frac", type=float, default=0.5,
+                    help="fraction of --duration at which an engine is killed")
+    ap.add_argument("--p99-gate-ms", type=float, default=2000.0)
+    ap.add_argument("--shed-gate", type=float, default=0.6,
+                    help="max tolerated shed fraction of submissions")
+    ap.add_argument("--seed", type=int, default=0)
     args = ap.parse_args()
+    if args.fleet_soak:
+        return fleet_soak(args)
 
     import jax
     import numpy as np

@@ -85,6 +85,51 @@ def _mean(vals: List[float]) -> float:
     return sum(vals) / len(vals) if vals else 0.0
 
 
+def _fleet_section(by_kind: Dict[str, List[Dict[str, Any]]]) -> Dict[str, Any]:
+    """Fold route/scale/rollout rows into the fleet report: who got served
+    (per-tenant accept/shed), how even the fleet ran (per-engine depth and
+    version spread from the LAST route row's snapshot), what the autoscaler
+    did, and how fast weight rollouts converged."""
+    route = by_kind.get("route", [])
+    scale = by_kind.get("scale", [])
+    rollout = by_kind.get("rollout", [])
+    tenants: Dict[str, Dict[str, int]] = {}
+    shed_by_reason: Dict[str, int] = {}
+    for row in route:
+        for tenant, counts in (row.get("tenants") or {}).items():
+            agg = tenants.setdefault(tenant, {"accepted": 0, "shed": 0})
+            agg["accepted"] += int(counts.get("accepted", 0))
+            agg["shed"] += int(counts.get("shed", 0))
+        for reason, n in (row.get("shed_by_reason") or {}).items():
+            shed_by_reason[reason] = shed_by_reason.get(reason, 0) + int(n)
+    engines = {}
+    for row in reversed(route):
+        if row.get("engines"):
+            engines = row["engines"]
+            break
+    versions = [e.get("version") for e in engines.values()
+                if e.get("version") is not None]
+    converged = [r for r in rollout if r.get("event") == "converged"]
+    return {
+        "accepted": sum(int(r.get("accepted", 0)) for r in route),
+        "shed": sum(int(r.get("shed", 0)) for r in route),
+        "rerouted": sum(int(r.get("rerouted", 0)) for r in route),
+        "lost": sum(int(r.get("lost", 0)) for r in route),
+        "cancelled": sum(int(r.get("cancelled", 0)) for r in route),
+        "shed_by_reason": shed_by_reason,
+        "tenants": tenants,
+        "engines": engines,
+        "version_spread": (max(versions) - min(versions)) if versions else None,
+        "scale_out": sum(1 for r in scale if r.get("action") == "out"),
+        "scale_in": sum(1 for r in scale if r.get("action") == "in"),
+        "rollouts": sum(1 for r in rollout if r.get("event") == "publish"),
+        "rollouts_refused": sum(1 for r in rollout
+                                if r.get("event") == "refused_backward"),
+        "rollout_convergence_s": (converged[-1].get("convergence_s")
+                                  if converged else None),
+    }
+
+
 def aggregate(rows: List[Dict[str, Any]]) -> Dict[str, Any]:
     by_kind: Dict[str, List[Dict[str, Any]]] = {}
     for row in rows:
@@ -208,6 +253,9 @@ def aggregate(rows: List[Dict[str, Any]]) -> Dict[str, Any]:
             "mirror_reconcile_s": _last_with(rows, "health", "mirror_reconcile_s")
             .get("mirror_reconcile_s"),
         },
+        # serving fleet (docs/SERVING.md "fleet"): per-tenant accept/shed,
+        # per-engine depth/version spread, scale events, rollout convergence
+        "fleet": _fleet_section(by_kind),
         "shed_total": shed_total,
         "final_eval": {
             k: v for k, v in last_eval.items()
@@ -264,6 +312,25 @@ def render(report: Dict[str, Any]) -> str:
                 f"mirror_reconcile_s={p['mirror_reconcile_s']}"
             )
         lines.append(line)
+    f = report["fleet"]
+    if f["accepted"] or f["shed"] or f["rollouts"] or f["engines"]:
+        lines.append(
+            f"fleet:   accepted={f['accepted']} shed={f['shed']} "
+            f"rerouted={f['rerouted']} lost={f['lost']} "
+            f"cancelled={f['cancelled']} "
+            f"scale_out={f['scale_out']} scale_in={f['scale_in']} "
+            f"rollouts={f['rollouts']} "
+            f"(refused={f['rollouts_refused']}, "
+            f"convergence_s={f['rollout_convergence_s']}) "
+            f"version_spread={f['version_spread']}"
+        )
+        for tenant, counts in sorted(f["tenants"].items()):
+            lines.append(f"  tenant {tenant}: accepted={counts['accepted']} "
+                         f"shed={counts['shed']}")
+        for eid, snap in sorted(f["engines"].items()):
+            lines.append(f"  engine {eid}: depth={snap.get('depth')} "
+                         f"version={snap.get('version')} "
+                         f"alive={snap.get('alive')}")
     e = report["elastic"]
     if any(e.values()):
         lines.append(

@@ -213,6 +213,10 @@ def health_attribution(metrics_glob) -> dict:
     # degraded AND healed reads very differently from one that stayed
     # degraded — the heal tallies carry that distinction into phase_done
     heals = {"host_alive": 0, "shard_readmit": 0, "actor_fenced": 0}
+    # serving-fleet rows (docs/SERVING.md "fleet"): a phase that drove a
+    # router/fleet (bench_serve soak) gets its route/scale/rollout activity
+    # attributed the same way — sheds and scale churn are the phase's story
+    fleet = {"route": 0, "scale": 0, "rollout": 0}
     last = None
     for path in sorted(_glob.glob(metrics_glob)):
         try:
@@ -230,13 +234,15 @@ def health_attribution(metrics_glob) -> dict:
                             last = status
                     elif kind in heals:
                         heals[kind] += 1
+                    elif kind in fleet:
+                        fleet[kind] += 1
         except OSError:
             continue
     order = {"ok": 0, "degraded": 1, "failing": 2}
     worst = max((s for s, n in counts.items() if n),
                 key=lambda s: order[s], default=None)
     return {"rows": sum(counts.values()), "counts": counts,
-            "last": last, "worst": worst, "heals": heals}
+            "last": last, "worst": worst, "heals": heals, "fleet": fleet}
 
 
 def classify_phase(rc: int, tail: str) -> str:

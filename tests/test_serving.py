@@ -21,6 +21,8 @@ from rainbow_iqn_apex_tpu.serving import (
     InferenceEngine,
     MicroBatcher,
     PolicyServer,
+    RequestCancelled,
+    ServeFuture,
     ServerClosed,
     ServerOverloaded,
     ServeMetrics,
@@ -118,6 +120,89 @@ def test_batcher_close_refuses_new_but_drains_queued():
     batch = b.take()  # queued request still handed to the worker
     assert batch == [fut]
     assert b.take() is None  # drained + closed -> worker exit signal
+
+
+# -------------------------------------------------------------- cancellation
+def test_serve_future_cancel_semantics():
+    """cancel() wins only before fulfilment, settles result() with
+    RequestCancelled, fires done-callbacks exactly once, and a late
+    set_result cannot overturn the cancelled outcome."""
+    fut = ServeFuture(_obs()[0])
+    calls = []
+    fut.add_done_callback(lambda f: calls.append("cb"))
+    assert fut.cancel() and fut.cancelled() and fut.done()
+    assert calls == ["cb"]
+    with pytest.raises(RequestCancelled):
+        fut.result(timeout=0)
+    assert not fut.cancel()  # already settled: the second cancel loses
+    fut.set_result(3, np.zeros(4))  # the worker racing the cancel
+    with pytest.raises(RequestCancelled):
+        fut.result(timeout=0)  # outcome stands
+    assert calls == ["cb"]  # callbacks fired exactly once
+    # ... and the mirror race: a fulfilled future refuses to cancel
+    fut2 = ServeFuture(_obs()[0])
+    fut2.set_result(1, np.zeros(4))
+    assert not fut2.cancel() and not fut2.cancelled()
+    assert fut2.result(timeout=0)[0] == 1
+    # a callback added after settling still runs (immediately)
+    fut2.add_done_callback(lambda f: calls.append("late"))
+    assert calls == ["cb", "late"]
+
+
+def test_batcher_skips_cancelled_futures():
+    """The slow-client bugfix: a cancelled future must not pad, dispatch, or
+    hold the deadline clock — the batcher drops it (serve_cancelled_total)
+    and the batch carries only live requests."""
+    m = ServeMetrics()
+    b = MicroBatcher([4], deadline_s=0.02, queue_bound=16, metrics=m)
+    futs = [b.submit(_obs()[0]) for _ in range(3)]
+    futs[0].cancel()  # the HEAD: its enqueue time must stop driving the
+    futs[2].cancel()  # deadline once dropped
+    batch = b.take()
+    assert batch == [futs[1]]
+    assert m.total_cancelled == 2
+    b.close()
+
+
+def test_try_submit_full_queue_is_quiet():
+    """try_submit (the fleet router's dispatch probe) returns None on a full
+    queue WITHOUT recording a shed — a probe that lands on another engine is
+    not this engine's shed, and phantom sheds would degrade health."""
+    m = ServeMetrics()
+    b = MicroBatcher([4], deadline_s=10.0, queue_bound=1, metrics=m)
+    b.submit(_obs()[0])
+    assert b.try_submit(_obs()[0]) is None
+    assert m.total_shed == 0  # quiet refusal
+    with pytest.raises(ServerOverloaded):
+        b.submit(_obs()[0])  # the client-facing path still counts
+    assert m.total_shed == 1
+    b.close()
+    with pytest.raises(ServerClosed):
+        b.try_submit(_obs()[0])  # closed is still loud
+
+
+def test_batcher_all_cancelled_yields_no_batch():
+    m = ServeMetrics()
+    b = MicroBatcher([4], deadline_s=0.01, queue_bound=16, metrics=m)
+    for fut in [b.submit(_obs()[0]) for _ in range(2)]:
+        fut.cancel()
+    assert b.take(idle_timeout_s=0.05) == []  # nothing live to dispatch
+    assert m.total_cancelled == 2 and m.total_batches == 0
+    b.close()
+
+
+def test_act_timeout_cancels_queued_request(state):
+    """A client that times out in act() leaves a CANCELLED future behind,
+    not a live one the worker would still serve into a dead slot."""
+    server = PolicyServer(CFG, A, state.params, devices=jax.devices()[:1])
+    # worker never started: the request is guaranteed still queued when the
+    # client's timeout fires
+    with pytest.raises(TimeoutError):
+        server.act(_obs()[0], timeout=0.02)
+    with server.batcher._lock:
+        (queued,) = server.batcher._queue
+    assert queued.cancelled()
+    server.stop()
 
 
 # ------------------------------------------------------------------- engine
