@@ -2,7 +2,7 @@
 
 PY ?= python
 
-.PHONY: test test-fast serve-smoke serve-bench chaos-smoke obs-smoke soak-smoke perf-smoke fleet-smoke
+.PHONY: test test-fast serve-smoke serve-bench chaos-smoke obs-smoke soak-smoke perf-smoke fleet-smoke quant-smoke
 
 # tier-1: fast unit + integration tests on the virtual 8-device CPU mesh
 test-fast:
@@ -57,8 +57,11 @@ soak-smoke:
 # docs/PERFORMANCE.md) must beat the per-step-sync loop on the CPU synthetic
 # apex_loop harness, the device sample frontier (replay/frontier.py) must
 # beat the host sum-tree sample path by >= 1.5x on the sample_path micro
-# row, and the bench rows must lint as strict JSON.  Small watchdog: the
-# toy harnesses finish in well under a minute per mode.
+# row, the int8-delta weight publish (utils/quantize.py) must ship >= 3x
+# fewer bytes/publish than fp32 full on the weight_publish row (decoder
+# verified bit-exact inside the row), and the bench rows must lint as
+# strict JSON.  Small watchdog: the toy harnesses finish in well under a
+# minute per mode.
 perf-smoke:
 	rm -f /tmp/ria_perf_smoke.jsonl
 	JAX_PLATFORMS=cpu BENCH_APEX_ONLY=1 BENCH_WATCHDOG_SECS=300 \
@@ -76,7 +79,25 @@ perf-smoke:
 	  assert s.get('status') is None, 'sample_path row: %s' % s['status']; \
 	  print('sample_path: frontier %.1f batches/s vs host %.1f (speedup %.3f)' \
 	        % (s['value'], s['host_batches_per_sec'], s['speedup_vs_host'])); \
-	  assert s['speedup_vs_host'] >= 1.5, 'device sample path under 1.5x'"
+	  assert s['speedup_vs_host'] >= 1.5, 'device sample path under 1.5x'; \
+	  w = [x for x in rows if x.get('path') == 'weight_publish'][-1]; \
+	  assert w.get('status') is None, 'weight_publish row: %s' % w['status']; \
+	  print('weight_publish: int8-delta %.0f B/publish vs fp32 %d B (%.2fx)' \
+	        % (w['value'], w['fp32_bytes_per_publish'], w['ratio_vs_fp32'])); \
+	  assert w['ratio_vs_fp32'] >= 3.0, 'int8-delta publish under 3x vs fp32'"
+
+# quant smoke (docs/PERFORMANCE.md "quantization"): the quantize unit tests
+# (codec bit-exactness, delta resync, gate fallback, off-mode bitwise), one
+# REAL-engine int8 serve via bench_serve --quant (the agreement gate must
+# ACTIVATE the quantized path and both numeric modes must answer the same
+# load correctly), and the run dir must lint as strict schema-versioned
+# JSONL (quant/quant_fallback/publish rows included)
+quant-smoke:
+	JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_quantize.py -q
+	rm -rf /tmp/ria_quant_smoke
+	JAX_PLATFORMS=cpu $(PY) scripts/bench_serve.py --quant \
+	  --clients 16 --requests 300 --out /tmp/ria_quant_smoke
+	$(PY) scripts/lint_jsonl.py /tmp/ria_quant_smoke
 
 # obs smoke: a short anakin run must yield a lintable, reportable run dir —
 # obs_report prints per-role throughput / learn-step percentiles / health,

@@ -128,6 +128,11 @@ def measure() -> None:
     # the rows behind it).
     if os.environ.get("BENCH_APEX_ONLY") == "1":
         for row in _run_row_budgeted(
+            "weight_publish", "weight_publish_bytes_per_publish",
+            _measure_weight_publish, left, share=0.2,
+        ):
+            print(json.dumps(row), flush=True)
+        for row in _run_row_budgeted(
             "apex_loop", "apex_loop_steps_per_sec",
             _measure_apex_loop, left, share=0.5,
         ):
@@ -232,6 +237,11 @@ def measure() -> None:
         print(json.dumps(host_feed_row), flush=True)
         if left() > 45:
             for row in _run_row_budgeted(
+                "weight_publish", "weight_publish_bytes_per_publish",
+                _measure_weight_publish, left, share=0.15,
+            ):
+                print(json.dumps(row), flush=True)
+            for row in _run_row_budgeted(
                 "apex_loop", "apex_loop_steps_per_sec",
                 _measure_apex_loop, left, share=0.45,
             ):
@@ -300,6 +310,84 @@ def _run_row_budgeted(path_name, metric, fn, left, share) -> list:
         "vs_baseline": None,
         "path": path_name,
         "status": status,
+    }]
+
+
+def _measure_weight_publish(left=None) -> list:
+    """Weight-distribution bytes bench (ISSUE 8): bytes/publish for a real
+    Rainbow-IQN param tree under three distribution schemes — fp32 full
+    (the seed's WeightMailbox/rollout payload), bf16 full
+    (cfg.bf16_weight_sync), and the int8-delta codec (utils/quantize.py:
+    periodic base snapshot + int8 per-tensor deltas, closed-loop).  One row
+    carries all three plus ``ratio_vs_fp32``; `make perf-smoke` gates the
+    ratio at >= 3x.  Bytes are deterministic (no timing), so the only
+    budget risk is the one-time flax init; the drift between publishes is
+    simulated as small Gaussian steps (an Adam-scale perturbation), which
+    is the delta codec's operating distribution.  The run also asserts the
+    decoder's reconstruction stays bit-exact with the encoder — a silently
+    divergent codec must fail the bench, not ship."""
+    if left is None:
+        left = lambda: float("inf")  # noqa: E731
+    import jax
+    import numpy as np
+
+    from rainbow_iqn_apex_tpu.config import Config
+    from rainbow_iqn_apex_tpu.ops.learn import init_train_state
+    from rainbow_iqn_apex_tpu.utils import quantize as quantize_mod
+
+    # toy-but-real tree: the bytes RATIO is shape-independent (every scheme
+    # scales with param count), so the apex_loop toy shape keeps the row
+    # cheap on CPU while exercising a genuine multi-layer flax tree
+    h = w = int(os.environ.get("BENCH_WP_FRAME", "44"))
+    publishes = int(os.environ.get("BENCH_WP_PUBLISHES", "20"))
+    base_interval = int(os.environ.get("BENCH_WP_BASE_INTERVAL", "10"))
+    cfg = Config().replace(
+        compute_dtype="float32", frame_height=h, frame_width=w,
+        history_length=2, hidden_size=64, num_cosines=16,
+        num_tau_samples=4, num_tau_prime_samples=4, num_quantile_samples=4,
+        publish_base_interval=base_interval,
+    )
+    state = init_train_state(cfg, 6, jax.random.PRNGKey(0))
+    params = jax.tree.map(np.asarray, state.params)
+    fp32_bytes = quantize_mod.tree_bytes(params)
+    if left() < 5:
+        return []
+
+    rng = np.random.default_rng(0)
+    flat = quantize_mod.flatten_tree(params)
+    enc = quantize_mod.DeltaEncoder(base_interval)
+    dec = quantize_mod.DeltaDecoder()
+    delta_bytes = 0
+    for v in range(1, publishes + 1):
+        flat = {p: a + rng.normal(scale=1e-4, size=a.shape).astype(np.float32)
+                for p, a in flat.items()}
+        packet = enc.encode(quantize_mod.unflatten_tree(flat), v)
+        delta_bytes += packet.nbytes()
+        dec.apply(packet)
+    ref = quantize_mod.flatten_tree(enc.reconstructed())
+    got = quantize_mod.flatten_tree(dec.params())
+    exact = all(np.array_equal(ref[p], got[p]) for p in ref)
+    if not exact:
+        raise RuntimeError("delta decoder diverged from encoder (not bit-exact)")
+    per_publish = delta_bytes / publishes
+    return [{
+        "metric": "weight_publish_bytes_per_publish",
+        "value": round(per_publish, 1),
+        "unit": (
+            f"bytes/publish (int8-delta codec, base every {base_interval} "
+            f"publishes ({'bf16' if quantize_mod.HAVE_ML_DTYPES else 'fp32'} "
+            f"base), {publishes} publishes of a {fp32_bytes // 1024}KiB-fp32 "
+            "Rainbow-IQN tree, decoder verified bit-exact vs encoder; vs "
+            "fp32-full and bf16-full rows alongside)"
+        ),
+        "vs_baseline": None,  # bytes row — not a learn-steps/s number
+        "path": "weight_publish",
+        "fp32_bytes_per_publish": fp32_bytes,
+        "bf16_bytes_per_publish": fp32_bytes // 2,
+        "ratio_vs_fp32": round(fp32_bytes / max(per_publish, 1e-9), 3),
+        "ratio_vs_bf16": round((fp32_bytes // 2) / max(per_publish, 1e-9), 3),
+        "publishes": publishes,
+        "base_interval": base_interval,
     }]
 
 

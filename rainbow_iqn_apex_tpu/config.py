@@ -192,6 +192,31 @@ class Config:
     serve_mode: str = "greedy"  # "greedy" (noise off) | "noisy" (eval_noisy-style)
     serve_metrics_interval_s: float = 5.0  # seconds between 'serve' JSONL rows
 
+    # ---- quantized inference + compressed weight distribution -----------------
+    # (utils/quantize.py; QuaRL arXiv:1910.01055; docs/PERFORMANCE.md
+    # "quantization", docs/SERVING.md config table)
+    serve_quantize: str = "off"  # "off" | "int8" | "fp8": quantized policy
+    # inference in serving/ engines AND the apex actor lanes.  int8 =
+    # symmetric per-channel weight quantization, dequantized inside each
+    # bucket's XLA executable (params ship/live int8); fp8 = e4m3 cast
+    # (needs ml_dtypes).  Guarded by the greedy-action agreement gate below;
+    # "off" (default) keeps today's fp32/bf16 paths bitwise intact.
+    quant_agreement_min: float = 0.99  # quantized params serve traffic only
+    # when their greedy actions agree with the fp32 policy on at least this
+    # fraction of the calibration batch; below -> fp32 fallback + one
+    # reasoned 'quant_fallback' row per failed gate
+    quant_calib_batch: int = 64  # calibration observations for the gate
+    # (serving engines synthesize frames unless handed real ones; apex
+    # actors draw the batch from replay observation statistics)
+    publish_compression: str = "off"  # "off" | "int8_delta": weight
+    # DISTRIBUTION compression (WeightMailbox / FleetRollout): a periodic
+    # full base snapshot (bf16 under ml_dtypes, else fp32) plus int8
+    # per-tensor-scaled deltas against the last reconstruction —
+    # subscribers rebuild bit-exact; >=3x fewer bytes/publish than fp32
+    # full (gated in `make perf-smoke`).  "off" = today's full publishes.
+    publish_base_interval: int = 10  # publishes between full base snapshots
+    # (the delta chain a late joiner replays is at most this long)
+
     # ---- serving fleet (serving/fleet/; docs/SERVING.md "fleet") ------------------
     fleet_min_engines: int = 1  # autoscaler floor
     fleet_max_engines: int = 4  # autoscaler ceiling

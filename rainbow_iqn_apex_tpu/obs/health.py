@@ -143,6 +143,24 @@ class RunHealth:
                 with self._lock:
                     self.fault_counts["rollout_refused"] += 1
                     self._win_faults["rollout_refused"] += 1
+        elif kind == "quant_fallback":
+            # the agreement gate refused quantized params: the run keeps
+            # serving fp32 correctly, but the window is degraded — the
+            # operator is paying full-precision cost they configured away
+            # (RUNBOOK "agreement gate keeps falling back")
+            with self._lock:
+                self.fault_counts["quant_fallback"] += 1
+                self._win_faults["quant_fallback"] += 1
+            self.registry.counter("quant_fallback_total", "health").inc()
+        elif kind == "quant":
+            if row.get("agreement") is not None:
+                self.registry.gauge("quant_action_agreement", "health").set(
+                    float(row["agreement"]))
+        elif kind == "publish":
+            b = int(row.get("bytes") or 0)
+            if b:
+                self.registry.counter("publish_bytes_total", "health").inc(b)
+            self.registry.gauge("publish_bytes_last", "health").set(b)
 
     def note_fault(self, event: str, row: Optional[Dict[str, Any]] = None) -> None:
         with self._lock:

@@ -56,6 +56,14 @@ REQUIRED_KEYS: Dict[str, frozenset] = {
     "scale": frozenset({"action", "engines"}),  # one autoscaler decision
     "rollout": frozenset({"event", "version"}),  # fleet weight rollout
     # (event: publish/sync/converged/refused_backward)
+    # quantization rows (utils/quantize.py; docs/PERFORMANCE.md "quant"):
+    "publish": frozenset({"version", "bytes"}),  # one weight publish
+    # (carries bytes_fp32 + mode ("int8"/"fp8"/"bf16"/"fp32") + quant_active
+    # so bytes-saved is computable per row)
+    "quant": frozenset({"event"}),  # agreement-gate outcome (event "gate"
+    # carries agreement/threshold/mode/active)
+    "quant_fallback": frozenset({"reason"}),  # the gate REFUSED quantized
+    # params (reason e.g. agreement_below_min; carries agreement/threshold)
 }
 
 HEALTH_STATUSES = ("ok", "degraded", "failing")

@@ -64,9 +64,11 @@ from rainbow_iqn_apex_tpu.parallel.mesh import (
     replicated,
     split_devices,
 )
+from rainbow_iqn_apex_tpu.parallel.quant_publish import QuantPublishMixin
 from rainbow_iqn_apex_tpu.parallel.sharded_replay import ShardedReplay
 from rainbow_iqn_apex_tpu.parallel.supervisor import TrainSupervisor
 from rainbow_iqn_apex_tpu.utils import faults
+from rainbow_iqn_apex_tpu.utils.quantize import wrap_act_quantized
 from rainbow_iqn_apex_tpu.utils.checkpoint import (
     Checkpointer,
     maybe_restore_replay,
@@ -129,8 +131,12 @@ class ActorPriorityEstimator:
         return np.abs(rn + boot - self.q_sel[0]).astype(np.float64)
 
 
-class ApexDriver:
-    """Owns meshes, sharded compute fns, and the stale actor-param copy."""
+class ApexDriver(QuantPublishMixin):
+    """Owns meshes, sharded compute fns, and the stale actor-param copy.
+
+    The gated quantized publish surface (publish_weights, attach_obs,
+    calibration handshake, quant/publish rows) is the shared
+    `QuantPublishMixin` — the two apex drivers must not drift on it."""
 
     def __init__(
         self,
@@ -191,6 +197,35 @@ class ApexDriver:
         )
         self._put_lanes = lane_put(lane_sh)
         self.actor_stack = None  # created lazily at the first act_frames
+        # quantized actor lanes (utils/quantize.py + the shared
+        # QuantPublishMixin; cfg.serve_quantize): publishes ship int8 (4x
+        # less ICI/DCN traffic than fp32) and the actor act step
+        # dequantizes inside its own executable — guarded by the
+        # greedy-action agreement gate on a replay-drawn calibration batch.
+        self._rep_a = rep_a
+        if self._init_quant_publish(
+                cfg, multihost=jax.process_count() > 1) != "off":
+            act_q_fn = wrap_act_quantized(act_fn)
+            self._act_q = jax.jit(
+                act_q_fn,
+                in_shardings=(rep_a, lane_sh, rep_a),
+                out_shardings=(lane_sh, lane_sh),
+            )
+
+            def stack_act_q(qparams, stack, frame, keep, key):
+                stack = shift_stack(stack, frame, keep)
+                a, q = act_q_fn(qparams, stack, key)
+                return a, q, stack
+
+            self._stack_act_q = jax.jit(
+                stack_act_q,
+                in_shardings=(rep_a, lane_sh, lane_sh, lane_sh, rep_a),
+                out_shardings=(lane_sh, lane_sh, lane_sh),
+                donate_argnums=1,
+            )
+            # the gate runs on the LEARNER mesh copy (plain jit)
+            self._gate_act32 = jax.jit(act_fn)
+            self._gate_actq = jax.jit(act_q_fn)
         if cfg.bf16_weight_sync:
             self._cast = jax.jit(
                 lambda p: jax.tree.map(lambda x: x.astype(jnp.bfloat16), p)
@@ -212,19 +247,20 @@ class ApexDriver:
         self.publish_weights()  # initial broadcast
 
     # ------------------------------------------------------------- weight sync
-    def publish_weights(self) -> int:
-        """Learner -> actor-mesh broadcast (the Redis SET + actor GET pair).
-        Returns the new monotonically increasing weight version; the actor
-        mesh adopts it atomically with the params."""
-        p = self.state.params
-        if self.cfg.bf16_weight_sync:
-            p = self._uncast(jax.device_put(self._cast(p), replicated(self.amesh)))
-        else:
-            p = jax.device_put(p, replicated(self.amesh))
-        self.actor_params = p
-        self.weights_version += 1
-        self.actor_weights_version = self.weights_version
-        return self.weights_version
+    # publish_weights / attach_obs / wants_calibration and the gated
+    # quantized broadcast live in QuantPublishMixin (shared with the r2d2
+    # driver); only the act-signature-shaped hooks are defined here.
+    def set_calibration(self, obs_batch: np.ndarray) -> None:
+        """Calibration observations for the agreement gate, drawn from
+        replay statistics (a sampled batch's stacked obs).  Clipped to
+        ``cfg.quant_calib_batch`` so the gate executables compile once."""
+        n = min(len(obs_batch), max(int(self.cfg.quant_calib_batch), 1))
+        self._calib_obs = jnp.asarray(np.asarray(obs_batch[:n], np.uint8))
+
+    def _gate_actions(self, params, qparams):
+        a32, _ = self._gate_act32(params, self._calib_obs, self._gate_key)
+        aq, _ = self._gate_actq(qparams, self._calib_obs, self._gate_key)
+        return a32, aq
 
     # ---------------------------------------------------------------- resume
     def load_state(self, state, extra: Optional[Dict[str, Any]] = None) -> None:
@@ -264,7 +300,8 @@ class ApexDriver:
     def act_async(self, stacked_obs: np.ndarray):
         """Dispatch lane-sharded inference; returns DEVICE arrays immediately
         (JAX async dispatch) so the host can overlap env work."""
-        return self._act(self.actor_params, put_frames(stacked_obs), self._next_key())
+        act = self._act_q if self._actor_quant else self._act
+        return act(self.actor_params, put_frames(stacked_obs), self._next_key())
 
     def act(self, stacked_obs: np.ndarray) -> Tuple[np.ndarray, np.ndarray]:
         a, q = self.act_async(stacked_obs)
@@ -287,7 +324,8 @@ class ApexDriver:
                 np.zeros((frames.shape[0], h, w, self.cfg.history_length), np.uint8)
             )
         keep = self._put_lanes((~np.asarray(prev_cuts, bool)).astype(np.uint8))
-        a, q, self.actor_stack = self._stack_act(
+        stack_act = self._stack_act_q if self._actor_quant else self._stack_act
+        a, q, self.actor_stack = stack_act(
             self.actor_params,
             self.actor_stack,
             self._put_lanes(np.asarray(frames, np.uint8)),
@@ -363,7 +401,8 @@ class ApexDriver:
     def act_local(self, stacked_obs: np.ndarray) -> Tuple[np.ndarray, np.ndarray]:
         """Lane-sharded inference fed from this host's local lanes."""
         obs = self._put_lanes(stacked_obs)
-        a, q = self._act(self.actor_params, obs, self._next_key())
+        act = self._act_q if self._actor_quant else self._act
+        a, q = act(self.actor_params, obs, self._next_key())
         with hostsync.sanctioned():  # obligatory actor->env hand-off
             return _local_rows(a), _local_rows(q)
 
@@ -457,6 +496,12 @@ def train_apex(cfg: Config, max_frames: Optional[int] = None) -> Dict[str, Any]:
     faults.install_from(cfg)
     obs_run = RunObs(cfg, metrics, role="learner")
     memory.attach_registry(obs_run.registry)
+    driver.attach_obs(metrics, obs_run.registry)
+    if driver.quant_disabled_reason is not None:
+        # mirrors the device_sampling multihost fallback: identical cfg on
+        # every host, so the whole pod declines together (lockstep SPMD)
+        metrics.log("notice", event="quant_fallback_multihost",
+                    reason="multihost: fp32/bf16 publish path retained")
     # NOTE (multi-host): the injector/retry decisions are pure functions of
     # (spec, seed, call order), identical on every host — supervised control
     # flow can never diverge the SPMD program around a collective.
@@ -637,6 +682,17 @@ def train_apex(cfg: Config, max_frames: Optional[int] = None) -> Dict[str, Any]:
                 else len(memory) >= learn_start and memory.sampleable
             )
             if warm:
+                if driver.wants_calibration():
+                    # calibration from replay observation statistics: one
+                    # sampled batch's stacked obs (the gate's yardstick —
+                    # QuaRL calibrates post-training quantization the same
+                    # way).  Only reached with serve_quantize on, so the
+                    # off-mode sampler RNG stream is untouched.
+                    calib = memory.sample(
+                        min(cfg.quant_calib_batch, cfg.batch_size),
+                        priority_beta(cfg, frames),
+                    )
+                    driver.set_calibration(calib.obs)
                 if frontier is not None and prefetcher is None:
                     # sample-ahead pusher: device-drawn index blocks,
                     # host-DRAM frame gather, staged device batches PUSHED

@@ -64,6 +64,8 @@ from rainbow_iqn_apex_tpu.utils.checkpoint import (
     rng_extra,
     rng_from_extra,
 )
+from rainbow_iqn_apex_tpu.parallel.quant_publish import QuantPublishMixin
+from rainbow_iqn_apex_tpu.utils.quantize import wrap_act_quantized
 from rainbow_iqn_apex_tpu.utils.logging import MetricsLogger
 from rainbow_iqn_apex_tpu.utils.prefetch import BatchPrefetcher
 from rainbow_iqn_apex_tpu.utils.writeback import (
@@ -73,7 +75,10 @@ from rainbow_iqn_apex_tpu.utils.writeback import (
 )
 
 
-class R2D2ApexDriver:
+class R2D2ApexDriver(QuantPublishMixin):
+    """Recurrent apex driver; the gated quantized publish surface is the
+    shared `QuantPublishMixin` (the two drivers must not drift on it)."""
+
     def __init__(
         self,
         cfg: Config,
@@ -159,6 +164,36 @@ class R2D2ApexDriver:
         self._lane_sh = lane_sh
         self._put_lanes = lane_put(lane_sh)
         self.actor_params = None
+        # quantized actor lanes — the shared QuantPublishMixin surface,
+        # gated on a replay-drawn calibration batch under a zero LSTM state
+        # (the episode-start condition every lane revisits)
+        if self._init_quant_publish(cfg, multihost=self._multihost) != "off":
+            act_q_fn = wrap_act_quantized(act_fn)
+            self._act_q = jax.jit(
+                act_q_fn,
+                in_shardings=(rep_a, lane_sh, (lane_sh, lane_sh), rep_a),
+                out_shardings=(lane_sh, lane_sh, (lane_sh, lane_sh)),
+            )
+
+            def stack_act_q(qparams, stack, frame, keep, lstm_state, key):
+                stack = shift_stack(stack, frame, keep)
+                a, q, new_state = act_q_fn(qparams, stack, lstm_state, key)
+                return a, q, new_state, stack
+
+            self._stack_act_q = jax.jit(
+                stack_act_q,
+                in_shardings=(
+                    rep_a, lane_sh, lane_sh, lane_sh, (lane_sh, lane_sh),
+                    rep_a,
+                ),
+                out_shardings=(
+                    lane_sh, lane_sh, (lane_sh, lane_sh), lane_sh,
+                ),
+                donate_argnums=1,
+            )
+            # the gate runs on the LEARNER mesh copy (plain jit)
+            self._gate_act32 = jax.jit(act_fn)
+            self._gate_actq = jax.jit(act_q_fn)
         # lanes is the GLOBAL lane count; each host materialises only its
         # local rows (make_array == device_put when single-process)
         local_zeros = np.zeros(
@@ -176,18 +211,24 @@ class R2D2ApexDriver:
         self.key, k = jax.random.split(self.key)
         return k
 
-    def publish_weights(self) -> int:
-        """Same version-stamped publish contract as ApexDriver (the two
-        drivers must not drift on the staleness-fencing surface)."""
-        p = self.state.params
-        if self.cfg.bf16_weight_sync:
-            p = self._uncast(jax.device_put(self._cast(p), self._rep_a))
-        else:
-            p = jax.device_put(p, self._rep_a)
-        self.actor_params = p
-        self.weights_version += 1
-        self.actor_weights_version = self.weights_version
-        return self.weights_version
+    # publish_weights / attach_obs / wants_calibration and the gated
+    # quantized broadcast live in QuantPublishMixin (shared with
+    # ApexDriver); only the act-signature-shaped hooks are defined here.
+    def set_calibration(self, obs_batch: np.ndarray) -> None:
+        """Calibration frames ([n, H, W, C], replay-drawn) for the gate;
+        compared under a zero LSTM state — the episode-start condition."""
+        n = min(len(obs_batch), max(int(self.cfg.quant_calib_batch), 1))
+        obs = np.asarray(obs_batch[:n], np.uint8)
+        self._calib_obs = jnp.asarray(obs)
+        zeros = jnp.zeros((n, self.cfg.lstm_size), jnp.float32)
+        self._calib_state = (zeros, zeros)
+
+    def _gate_actions(self, params, qparams):
+        a32, _, _ = self._gate_act32(
+            params, self._calib_obs, self._calib_state, self._gate_key)
+        aq, _, _ = self._gate_actq(
+            qparams, self._calib_obs, self._calib_state, self._gate_key)
+        return a32, aq
 
     def load_state(self, state, extra: Optional[Dict[str, Any]] = None) -> None:
         """Place a restored R2D2TrainState onto the learner mesh, pick up
@@ -225,12 +266,13 @@ class R2D2ApexDriver:
         # the sequence replay requires are OBLIGATORY host materializations
         # on the actor half — sanctioned syncs, not learner-hot-path
         # regressions (docs/PERFORMANCE.md inventory)
+        act = self._act_q if self._actor_quant else self._act
         if self._multihost:
             with hostsync.sanctioned():
                 pre_c = _local_rows(self.lstm_state[0])
                 pre_h = _local_rows(self.lstm_state[1])
             x = self._put_lanes(as_actor_input(obs, self.cfg.history_length))
-            a, _q, self.lstm_state = self._act(
+            a, _q, self.lstm_state = act(
                 self.actor_params, x, self.lstm_state, self._next_key()
             )
             with hostsync.sanctioned():
@@ -239,7 +281,7 @@ class R2D2ApexDriver:
             pre_c = np.asarray(self.lstm_state[0])
             pre_h = np.asarray(self.lstm_state[1])
         x = as_actor_input(obs, self.cfg.history_length)
-        a, _q, self.lstm_state = self._act(
+        a, _q, self.lstm_state = act(
             self.actor_params, x, self.lstm_state, self._next_key()
         )
         with hostsync.sanctioned():
@@ -270,7 +312,8 @@ class R2D2ApexDriver:
                 np.zeros((frames.shape[0], h, w, self.cfg.history_length), np.uint8)
             )
         keep = self._put_lanes((~np.asarray(prev_cuts, bool)).astype(np.uint8))
-        a, _q, self.lstm_state, self.actor_stack = self._stack_act(
+        stack_act = self._stack_act_q if self._actor_quant else self._stack_act
+        a, _q, self.lstm_state, self.actor_stack = stack_act(
             self.actor_params,
             self.actor_stack,
             self._put_lanes(np.asarray(frames, np.uint8)),
@@ -391,6 +434,10 @@ def train_apex_r2d2(cfg: Config, max_frames: Optional[int] = None) -> Dict[str, 
     faults.install_from(cfg)
     obs_run = RunObs(cfg, metrics, role="learner")
     sup = TrainSupervisor(cfg, metrics=metrics, registry=obs_run.registry)
+    driver.attach_obs(metrics, obs_run.registry)
+    if driver.quant_disabled_reason is not None:
+        metrics.log("notice", event="quant_fallback_multihost",
+                    reason="multihost: fp32/bf16 publish path retained")
     # lease + staleness-fence wiring, identical to train_apex (the two
     # drivers must not drift on the elastic surface — docs/RESILIENCE.md)
     from rainbow_iqn_apex_tpu.parallel.elastic import (
@@ -516,6 +563,20 @@ def train_apex_r2d2(cfg: Config, max_frames: Optional[int] = None) -> Dict[str, 
                 else len(memory) >= learn_start_seqs
             )
             if warm:
+                if driver.wants_calibration():
+                    # calibration from replay statistics: the first
+                    # history_length consecutive frames of each sampled
+                    # sequence, stacked into the act input shape (paired
+                    # with the zero LSTM state the gate compares under).
+                    # serve_quantize-on only, so the off-mode sampler RNG
+                    # stream is untouched.
+                    calib = memory.sample(
+                        min(cfg.quant_calib_batch, cfg.batch_size),
+                        priority_beta(cfg, frames),
+                    )
+                    h = min(cfg.history_length, calib.obs.shape[1])
+                    driver.set_calibration(
+                        np.moveaxis(calib.obs[:, :h, :, :, 0], 1, -1))
                 if frontier is not None and prefetcher is None:
                     from rainbow_iqn_apex_tpu.utils.prefetch import (
                         SampleAheadPusher,

@@ -331,10 +331,27 @@ class WeightMailbox:
     outside the SPMD program (soak actors, external fleets) instead watch
     this tiny JSON file.  ``publish`` is atomic (tmp + rename) so a reader
     never sees a torn row; the version is monotonically increasing, which is
-    what makes the staleness fence's lag arithmetic meaningful."""
+    what makes the staleness fence's lag arithmetic meaningful.
 
-    def __init__(self, path: str):
+    **Quantized delta payloads** (``publish_params``, utils/quantize.py):
+    the mailbox can additionally carry the weights themselves — a periodic
+    full base snapshot plus int8 per-tensor-scaled deltas against the last
+    reconstruction, one ``.npz`` per publish next to the JSON row.  The row
+    records the chain-from-base, so a late joiner (or a subscriber that
+    missed a delta) replays base+deltas and lands **bit-exact** on the
+    publisher's reconstruction; `MailboxSubscriber` applies only the new
+    tail when it is already in sync.  Payload files older than the
+    previous base are pruned (laggards one base behind still resync).
+    ``publish_compression="off"`` callers simply never call
+    ``publish_params`` — ``publish`` is byte-for-byte the PR-4 behaviour."""
+
+    def __init__(self, path: str, base_interval: int = 10,
+                 compression: str = "int8_delta"):
         self.path = path
+        self.base_interval = int(base_interval)
+        self.compression = compression
+        self._encoder = None  # created on first publish_params
+        self._files: Dict[int, str] = {}  # version -> payload file
 
     def publish(self, version: int, step: int = 0, **extra: Any) -> None:
         os.makedirs(os.path.dirname(self.path) or ".", exist_ok=True)
@@ -352,9 +369,123 @@ class WeightMailbox:
         except (OSError, ValueError):
             return None  # unpublished yet, or a reader racing the rename
 
+    # ------------------------------------------------ quantized delta payloads
+    def _payload_dir(self) -> str:
+        return os.path.splitext(self.path)[0] + "_payload"
+
+    def publish_params(self, params: Any, version: int, step: int = 0,
+                       **extra: Any) -> Dict[str, Any]:
+        """Publish the actual weights as a delta-compressed payload plus the
+        version row.  Monotone: a backward/duplicate version raises (the
+        mailbox mirror of FleetRollout's refused_backward).  Returns the
+        row written, with ``bytes`` = the packet's logical wire size."""
+        from rainbow_iqn_apex_tpu.utils import quantize as quantize_mod
+
+        if self._encoder is None:
+            if self.compression == "int8_delta":
+                self._encoder = quantize_mod.DeltaEncoder(self.base_interval)
+            else:  # "off": full fp32 snapshots, every publish its own base
+                self._encoder = quantize_mod.DeltaEncoder(1)
+        if int(version) <= self._encoder.version:
+            raise ValueError(
+                f"mailbox publishes are monotone: version {version} <= "
+                f"published {self._encoder.version}")
+        directory = self._payload_dir()
+        os.makedirs(directory, exist_ok=True)
+        packet = self._encoder.encode(params, int(version))
+        fname = f"w_v{int(version)}_{packet.kind}.npz"
+        quantize_mod.save_packet(packet, os.path.join(directory, fname))
+        self._files[int(version)] = fname
+        chain_versions = [p.version for p in self._encoder.chain()]
+        # a fresh base starts a new chain; everything before it is
+        # unreachable by any resync (a laggard replays the NEW chain, whose
+        # base resets its state), so the old chain's files are pruned
+        for v in [v for v in self._files if v < chain_versions[0]]:
+            try:
+                os.unlink(os.path.join(directory, self._files.pop(v)))
+            except OSError:
+                self._files.pop(v, None)
+        self.publish(
+            version, step=step,
+            payload_kind=packet.kind,
+            payload_file=fname,
+            base_version=packet.base_version,
+            chain=[[v, self._files[v]] for v in chain_versions
+                   if v in self._files],
+            bytes=packet.nbytes(),
+            compression=self.compression,
+            **extra,
+        )
+        return self.read() or {}
+
+    def read_params(self) -> Optional[Any]:
+        """Stateless full reconstruction (a fresh late joiner): replay the
+        row's chain-from-base.  None when nothing (or no payload) is
+        published or the chain is unreadable — callers retry at the next
+        publish, exactly like a torn `read`."""
+        from rainbow_iqn_apex_tpu.utils import quantize as quantize_mod
+
+        row = self.read()
+        if not row or "chain" not in row:
+            return None
+        directory = self._payload_dir()
+        decoder = quantize_mod.DeltaDecoder()
+        try:
+            for _version, fname in row["chain"]:
+                decoder.apply(quantize_mod.load_packet(
+                    os.path.join(directory, fname)))
+            return decoder.params()
+        except (OSError, ValueError, KeyError,
+                quantize_mod.DeltaChainBroken):
+            return None  # racing a prune/rename; the next poll resolves it
+
     def version(self) -> int:
         row = self.read()
         return int(row["version"]) if row else -1
+
+
+class MailboxSubscriber:
+    """Stateful mailbox reader: applies only the new delta tail when in
+    sync, resyncs through the row's chain-from-base after a gap (dropped
+    delta, late join) — the subscriber half of ``publish_params``."""
+
+    def __init__(self, mailbox: WeightMailbox):
+        self.mailbox = mailbox
+        self.resyncs = 0
+        from rainbow_iqn_apex_tpu.utils import quantize as quantize_mod
+
+        self._quantize = quantize_mod
+        self._decoder = quantize_mod.DeltaDecoder()
+
+    @property
+    def version(self) -> int:
+        return self._decoder.version
+
+    def poll(self) -> Optional[Any]:
+        """Returns the reconstructed fp32 params when a NEW version landed,
+        None otherwise.  Bit-exact with the publisher's reconstruction."""
+        row = self.mailbox.read()
+        if not row or "chain" not in row:
+            return None
+        if int(row["version"]) <= self._decoder.version:
+            return None
+        directory = self.mailbox._payload_dir()
+        chain = row["chain"]
+        try:
+            packets = [self._quantize.load_packet(os.path.join(directory, f))
+                       for _v, f in chain]
+            try:
+                return self._decoder.apply_chain(
+                    [p for p in packets if p.version > self._decoder.version])
+            except self._quantize.DeltaChainBroken:
+                # missed packet(s) beyond the published chain: fresh-base
+                # resync through the full chain (always converges — the
+                # chain starts with its base)
+                self.resyncs += 1
+                self._decoder = self._quantize.DeltaDecoder()
+                return self._decoder.apply_chain(packets)
+        except (OSError, ValueError, KeyError):
+            return None  # racing a prune/rename; retry next poll
 
 
 # ----------------------------------------------------------- staleness fence

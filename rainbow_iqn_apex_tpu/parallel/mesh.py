@@ -2,18 +2,27 @@
 
 The reference couples 1 learner process + N actor processes through Redis TCP
 (SURVEY.md §1).  The TPU-native replacement (north star BASELINE.json:5) makes
-one SPMD program own the whole slice:
+one SPMD program own the whole slice — every dispatch in the tree already
+goes through the modern ``jax.jit`` + ``NamedSharding`` path (there is no
+pmap anywhere; in/out shardings on named meshes, XLA inserts the
+collectives):
 
 - a **learner mesh** with axis ``dp``: the learn step runs batch-sharded over
   it (params replicated, XLA inserts the gradient all-reduce over ICI);
 - an **actor mesh** with axis ``actor``: batched vector-env inference is
   sharded lane-wise across it;
-- weight publish = one device_put of (optionally bf16) params from the
-  learner mesh to the actor mesh — the Redis weight-mailbox replaced by an
-  ICI broadcast.
+- weight publish = one device_put of (optionally bf16, or int8-quantized —
+  utils/quantize.py) params from the learner mesh to the actor mesh — the
+  Redis weight-mailbox replaced by an ICI broadcast.
 
 On a single chip both meshes are the same device and the roles time-multiplex;
 on a pod ``Config.learner_devices`` carves the slice.
+
+Remaining mesh work (ROADMAP "Mesh generality"): both meshes are still 1-D —
+growing them into a logical 2-D ``(batch, model)`` mesh (a ``model`` axis for
+head/embedding sharding, `shard_map` where XLA's sharding inference falls
+short) and running the queued batch-512/1024 scaling sweep are the open
+items; the jit/NamedSharding migration itself is long done.
 """
 
 from __future__ import annotations

@@ -1,0 +1,171 @@
+"""Shared gated quantized-publish surface for the two apex drivers.
+
+`ApexDriver` and `R2D2ApexDriver` must not drift on the publish surface
+(version stamps, the agreement gate, the fallback semantics, the
+`publish`/`quant`/`quant_fallback` rows) — so the surface lives ONCE here
+instead of being copy-pasted into both.  The mixin owns everything that is
+architecture-independent: mode/config state, the calibration handshake with
+the loop, row/gauge emission, byte accounting, and the gated
+`publish_weights` itself.  Each driver supplies only the pieces its act
+signature shapes:
+
+- ``_gate_actions(params, qparams)`` — run the fp32 and quantized policies
+  on the held calibration batch under the SAME key (same taus/noise) and
+  return the two greedy-action device arrays;
+- ``set_calibration(obs_batch)`` — stage the replay-drawn calibration
+  observations (the r2d2 override also builds the zero LSTM state the gate
+  compares under);
+- ``self._rep_a`` — the actor-mesh replicated sharding the publish targets;
+- lane-sharded quantized act twins (``_act_q``/``_stack_act_q``) built
+  against the mode `_init_quant_publish` returns.
+
+Single-host only: an SPMD pod must not diverge on a per-host gate decision,
+so `_init_quant_publish(multihost=True)` declines with
+``quant_disabled_reason = "multihost"`` and the loop logs the notice (the
+cfg is identical on every host, so the whole pod declines together).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import numpy as np
+
+from rainbow_iqn_apex_tpu.utils import hostsync
+from rainbow_iqn_apex_tpu.utils.quantize import (
+    check_mode,
+    greedy_agreement,
+    quantize_for_mode,
+)
+
+
+class QuantPublishMixin:
+    """Gated int8/fp8 weight publish with fp32/bf16 fallback (PR 8)."""
+
+    # ------------------------------------------------------------- lifecycle
+    def _init_quant_publish(self, cfg, multihost: bool) -> str:
+        """Install the common quant-publish state; returns the EFFECTIVE
+        mode ("off" when disabled) so the driver knows whether to build its
+        quantized act twins."""
+        self.quant_mode = "off"
+        self.quant_disabled_reason: Optional[str] = None
+        self._actor_quant = False
+        self.quant_agreement: Optional[float] = None
+        self.quant_fallbacks = 0
+        self._calib_obs = None
+        self._obs_metrics = None
+        self._obs_registry = None
+        mode = check_mode(cfg.serve_quantize)
+        if mode != "off" and multihost:
+            self.quant_disabled_reason = "multihost"
+            return "off"
+        if mode != "off":
+            self.quant_mode = mode
+            self._quantize_pub = jax.jit(
+                lambda p, m=mode: quantize_for_mode(p, m))
+            self._gate_key = jax.random.PRNGKey(cfg.seed + 8221)
+        return self.quant_mode
+
+    def attach_obs(self, metrics=None, registry=None) -> None:
+        """Hand the driver the run's metrics surface (the loop constructs
+        the driver before the logger exists) so publishes can emit
+        `publish`/`quant`/`quant_fallback` rows and gauges."""
+        self._obs_metrics = metrics
+        self._obs_registry = registry
+
+    def wants_calibration(self) -> bool:
+        return self.quant_mode != "off" and self._calib_obs is None
+
+    # ------------------------------------------------------------- emission
+    def _quant_row(self, kind: str, **fields) -> None:
+        if self._obs_metrics is not None:
+            self._obs_metrics.log(kind, **fields)
+        if self._obs_registry is not None:
+            if kind == "quant_fallback":
+                self._obs_registry.counter(
+                    "quant_fallback_total", "learner").inc()
+            if fields.get("agreement") is not None:
+                self._obs_registry.gauge(
+                    "quant_action_agreement", "learner").set(
+                    float(fields["agreement"]))
+
+    def _tree_wire_bytes(self, tree) -> int:
+        """Logical bytes a publish of ``tree`` ships over ICI/DCN — static
+        shape/dtype metadata only, no device sync."""
+        return int(sum(x.size * x.dtype.itemsize
+                       for x in jax.tree.leaves(tree)))
+
+    # ----------------------------------------------------------------- gate
+    def _gate_actions(self, params, qparams):
+        """Driver hook: (fp32 actions, quantized actions) on the held
+        calibration batch, same key for both policies."""
+        raise NotImplementedError
+
+    def _gate_agreement(self, params, qparams) -> float:
+        a32, aq = self._gate_actions(params, qparams)
+        with hostsync.sanctioned():  # publish boundary, ring already drained
+            return greedy_agreement(np.asarray(a32), np.asarray(aq))
+
+    # -------------------------------------------------------------- publish
+    def publish_weights(self) -> int:
+        """Learner -> actor-mesh broadcast (the Redis SET + actor GET pair).
+        Returns the new monotonically increasing weight version; the actor
+        mesh adopts it atomically with the params.
+
+        With ``cfg.serve_quantize`` on (and a calibration batch set), the
+        broadcast ships the int8/fp8 tree instead — gated per publish by
+        greedy-action agreement against the fp32 policy; a failed gate
+        falls back to today's fp32/bf16 broadcast and emits one reasoned
+        ``quant_fallback`` row.  ``serve_quantize="off"`` takes exactly the
+        pre-quant path."""
+        p = self.state.params
+        published_mode = None
+        if self.quant_mode != "off" and self._calib_obs is not None:
+            qp = self._quantize_pub(p)  # int8/fp8 on the learner mesh
+            agreement = self._gate_agreement(p, qp)
+            self.quant_agreement = agreement
+            if agreement >= self.cfg.quant_agreement_min:
+                # only the quantized tree ever crosses to the actor mesh —
+                # a gated publish never pays a second fp32 broadcast
+                self.actor_params = jax.device_put(qp, self._rep_a)
+                self._actor_quant = True
+                published_mode = self.quant_mode
+                published_bytes = self._tree_wire_bytes(qp)
+                self._quant_row(
+                    "quant", event="gate", mode=self.quant_mode, active=True,
+                    agreement=round(agreement, 6),
+                    threshold=self.cfg.quant_agreement_min,
+                )
+            else:
+                self.quant_fallbacks += 1
+                self._quant_row(
+                    "quant_fallback", reason="agreement_below_min",
+                    mode=self.quant_mode, agreement=round(agreement, 6),
+                    threshold=self.cfg.quant_agreement_min,
+                    step=self._host_step or 0,
+                )
+        if published_mode is None:
+            if self.cfg.bf16_weight_sync:
+                p = self._uncast(jax.device_put(self._cast(p), self._rep_a))
+                published_mode = "bf16"
+            else:
+                p = jax.device_put(p, self._rep_a)
+                published_mode = "fp32"
+            self.actor_params = p
+            self._actor_quant = False
+            published_bytes = self._tree_wire_bytes(self.state.params) // (
+                2 if published_mode == "bf16" else 1)
+        self.weights_version += 1
+        self.actor_weights_version = self.weights_version
+        if self._obs_metrics is not None:
+            self._obs_metrics.log(
+                "publish", version=self.weights_version,
+                bytes=published_bytes,
+                bytes_fp32=self._tree_wire_bytes(self.state.params),
+                mode=published_mode, quant_active=self._actor_quant,
+            )
+        if self._obs_registry is not None:
+            self._obs_registry.counter(
+                "publish_bytes_total", "learner").inc(published_bytes)
+        return self.weights_version

@@ -33,6 +33,22 @@ from rainbow_iqn_apex_tpu.config import Config
 from rainbow_iqn_apex_tpu.ops.learn import build_act_step
 from rainbow_iqn_apex_tpu.parallel.mesh import actor_mesh, batch_sharding, replicated
 from rainbow_iqn_apex_tpu.serving.batcher import pick_bucket
+from rainbow_iqn_apex_tpu.utils.quantize import (
+    check_mode,
+    greedy_agreement,
+    quantize_for_mode,
+    wrap_act_quantized,
+)
+
+
+def _quantizer_for(mode: str):
+    """Top-level closure over the (static) quant mode so jit sees one stable
+    callable per engine — `functools.partial` on a lambda would too, but a
+    named def keeps tracebacks readable."""
+    def quantize(params):
+        return quantize_for_mode(params, mode)
+
+    return quantize
 
 
 def fit_buckets(buckets: Sequence[int], n_devices: int) -> List[int]:
@@ -53,6 +69,21 @@ class InferenceEngine:
     "noisy" keeps noise on (exploration-flavoured eval, cfg.eval_noisy
     semantics).  Taus are sampled fresh per dispatch in both modes, as the
     acting path always does.
+
+    Quantized inference (``cfg.serve_quantize`` = "int8"/"fp8",
+    utils/quantize.py): every ``load_params`` additionally stages a
+    quantized copy whose act step dequantizes **inside each bucket's own
+    XLA executable** (weights live int8/fp8 in HBM; the scale multiply
+    fuses into the first use of each tensor), and a greedy-action agreement
+    gate against the fp32 policy on the calibration batch decides which
+    copy serves: agreement >= ``cfg.quant_agreement_min`` activates the
+    quantized path, below-threshold falls back to fp32 and emits one
+    reasoned ``quant_fallback`` row (via ``quant_log``).  The fp32 tree is
+    retained for future gates — the win is per-dispatch bandwidth/compute,
+    not resident memory.  "off" (default) takes exactly the pre-quant code
+    path.  The gate key is fixed (derived from the seed), so fp32 and
+    quantized actions are compared under identical taus/noise and the gate
+    is deterministic per params version.
     """
 
     def __init__(
@@ -63,6 +94,8 @@ class InferenceEngine:
         devices: Optional[Sequence[jax.Device]] = None,
         buckets: Optional[Sequence[int]] = None,
         mode: str = "greedy",
+        calib_obs: Optional[np.ndarray] = None,
+        quant_log: Optional[Any] = None,
     ):
         if mode not in ("greedy", "noisy"):
             raise ValueError(f"unknown serve mode {mode!r}")
@@ -78,16 +111,40 @@ class InferenceEngine:
             buckets if buckets is not None else parse_buckets(cfg.serve_batch_buckets),
             self.n_devices,
         )
+        act_fn = build_act_step(cfg, num_actions, use_noise=(mode == "noisy"))
         self._act = jax.jit(
-            build_act_step(cfg, num_actions, use_noise=(mode == "noisy")),
+            act_fn,
             in_shardings=(self._rep, self._lane_sh, self._rep),
             out_shardings=(self._lane_sh, self._lane_sh),
         )
         self._key = jax.random.PRNGKey(cfg.seed + 4099)
         self._key_lock = threading.Lock()
         self._swap_lock = threading.Lock()
+        # ---- quantized inference mode (docs/PERFORMANCE.md "quantization")
+        self.quant_mode = check_mode(getattr(cfg, "serve_quantize", "off"))
+        self.quant_agreement_min = float(
+            getattr(cfg, "quant_agreement_min", 0.99))
+        self.quant_log = quant_log
+        self.quant_active = False
+        self.quant_agreement: Optional[float] = None
+        self.quant_fallbacks = 0
+        self._qparams = None
+        self._calib_obs = None if calib_obs is None else np.asarray(calib_obs)
+        if self.quant_mode != "off":
+            self._act_q = jax.jit(
+                wrap_act_quantized(act_fn),
+                in_shardings=(self._rep, self._lane_sh, self._rep),
+                out_shardings=(self._lane_sh, self._lane_sh),
+            )
+            self._quantize = jax.jit(
+                _quantizer_for(self.quant_mode),
+                out_shardings=self._rep,
+            )
+            self._gate_key = jax.random.PRNGKey(cfg.seed + 8221)
         self._params = jax.device_put(params, self._rep)
         self.params_version = 0
+        if self.quant_mode != "off":
+            self._stage_quantized(self._params)
         # staleness monitoring (the serving mirror of the training side's
         # weight-version stamp, parallel/elastic.py): when the weights last
         # changed, so healthz can report weights_age_s externally
@@ -101,12 +158,96 @@ class InferenceEngine:
 
         Staging happens UNDER the swap lock: two concurrent swaps (watcher
         poll + direct learner push) must land in call order, or a slow
-        stage of older params could overwrite a fresher swap."""
+        stage of older params could overwrite a fresher swap.  With a
+        quantized mode on, the quantized copy is staged and gated under the
+        same lock, so a dispatch can never pair new fp32 params with a
+        stale quantized tree."""
         with self._swap_lock:
             self._params = jax.device_put(params, self._rep)
+            if self.quant_mode != "off":
+                self._stage_quantized(self._params)
             self.params_version += 1
             self.weights_loaded_at = time.monotonic()
             return self.params_version
+
+    # ------------------------------------------------- quantized inference
+    def set_calibration(self, calib_obs: np.ndarray) -> None:
+        """Provide/replace the calibration observations ([n, H, W, C] u8,
+        ideally drawn from real traffic or replay statistics) and re-run
+        the gate against the currently staged params."""
+        self._calib_obs = np.asarray(calib_obs)
+        if self.quant_mode != "off":
+            with self._swap_lock:
+                self._stage_quantized(self._params)
+
+    def _emit_quant(self, kind: str, **fields: Any) -> None:
+        if self.quant_log is not None:
+            try:
+                self.quant_log(kind, **fields)
+            except Exception:
+                pass  # observability must never block a swap
+
+    def _stage_quantized(self, staged_params: Any) -> None:
+        """Quantize ``staged_params`` and run the agreement gate.  Called
+        under the swap lock.  No calibration batch yet -> the quantized
+        path stays off quietly (not a fallback: the gate is unevaluable,
+        and serving unvetted quantized weights is exactly what the gate
+        exists to prevent).
+
+        The quantized tree stays a LOCAL until the gate has ruled: a
+        dispatch racing this stage must keep serving the previous VETTED
+        quantized tree (merely stale — the load_params in-flight-dispatch
+        semantics), never the new unvetted one.  Only a passed gate
+        publishes the (qparams, active) pair."""
+        qparams = self._quantize(staged_params)
+        if self._calib_obs is None:
+            self.quant_active = False
+            self._qparams = qparams  # unused while inactive; kept fresh
+            return
+        # clamp to the largest bucket: the gate rides the same bucketed
+        # executables live traffic uses, and an over-sized calibration
+        # batch (RUNBOOK suggests 256+) must narrow, not crash the swap
+        obs = self._calib_obs[: self.buckets[-1]]
+        n = obs.shape[0]
+        bucket = self.bucket_for(n)
+        if bucket != n:
+            pad = np.broadcast_to(obs[:1], (bucket - n, *obs.shape[1:]))
+            obs = np.concatenate([obs, pad], axis=0)
+        obs_dev = jnp.asarray(obs)
+        a32, _ = self._act(self._params, obs_dev, self._gate_key)
+        aq, _ = self._act_q(qparams, obs_dev, self._gate_key)
+        agreement = greedy_agreement(
+            np.asarray(a32)[:n], np.asarray(aq)[:n])
+        self.quant_agreement = agreement
+        passed = agreement >= self.quant_agreement_min
+        if passed:
+            self._qparams = qparams
+            self.quant_active = True
+            self._emit_quant(
+                "quant", event="gate", mode=self.quant_mode, active=True,
+                agreement=round(agreement, 6),
+                threshold=self.quant_agreement_min, calib_batch=int(n),
+            )
+        else:
+            was_active = self.quant_active
+            self.quant_active = False
+            self._qparams = qparams  # unused while inactive; kept fresh
+            self.quant_fallbacks += 1
+            self._emit_quant(
+                "quant_fallback", reason="agreement_below_min",
+                mode=self.quant_mode, agreement=round(agreement, 6),
+                threshold=self.quant_agreement_min, calib_batch=int(n),
+                was_active=was_active,
+            )
+
+    def quant_state(self) -> dict:
+        """Live quantization status (healthz / stats surface)."""
+        return {
+            "quant_mode": self.quant_mode,
+            "quant_active": self.quant_active,
+            "quant_agreement": self.quant_agreement,
+            "quant_fallbacks": self.quant_fallbacks,
+        }
 
     def weights_age_s(self) -> float:
         """Seconds since the served weights last changed."""
@@ -137,7 +278,10 @@ class InferenceEngine:
         if bucket != n:
             pad = np.broadcast_to(obs[:1], (bucket - n, *obs.shape[1:]))
             obs = np.concatenate([obs, pad], axis=0)
-        a, q = self._act(self._params, jnp.asarray(obs), self._next_key())
+        if self.quant_active:
+            a, q = self._act_q(self._qparams, jnp.asarray(obs), self._next_key())
+        else:
+            a, q = self._act(self._params, jnp.asarray(obs), self._next_key())
         return np.asarray(a)[:n], np.asarray(q)[:n]
 
     # -------------------------------------------------------- observability

@@ -204,6 +204,51 @@ class FleetEngine:
         self.writer.set_weight_version(version)
         return version
 
+    # delta-compressed rollout (utils/quantize.py; FleetRollout
+    # compression="int8_delta"): the engine holds a DeltaDecoder whose
+    # reconstruction is bit-exact with the controller's encoder, so N
+    # engines adopting the same packet stream all serve identical weights
+    def _packet_decoder(self):
+        if not hasattr(self, "_decoder"):
+            from rainbow_iqn_apex_tpu.utils.quantize import DeltaDecoder
+
+            self._decoder = DeltaDecoder()
+        return self._decoder
+
+    def adopt_packet(self, packet: Any) -> int:
+        """Adopt one delta/base packet.  Backward/duplicate packets are
+        refused (ValueError, same contract as `adopt`); a chain gap raises
+        `DeltaChainBroken` — the rollout counts the adopt failed and
+        ``sync()`` repairs it with the chain-from-base."""
+        version = int(packet.version)
+        if version <= self.transport.version() and self.transport.version() > 0:
+            raise ValueError(
+                f"engine {self.engine_id}: refusing backward/duplicate weight "
+                f"rollout {version} (serving {self.transport.version()})"
+            )
+        params = self._packet_decoder().apply(packet)
+        self.server.load_params(params)
+        self.transport.set_version(version)
+        self.writer.set_weight_version(version)
+        return version
+
+    def adopt_chain(self, packets: Any) -> int:
+        """Catch up through a chain-from-base (late join, missed packets).
+        Idempotent: packets at or below the held version are skipped.  The
+        reload fires whenever the SERVED version trails the decoder — not
+        only when the chain advanced the decoder: a prior adopt whose
+        decode succeeded but whose ``load_params`` failed (dying engine,
+        mid-kill race) leaves the decoder ahead of the transport, and this
+        is sync()'s one retry path for that engine — skipping the reload
+        there would fence it out of routing forever."""
+        decoder = self._packet_decoder()
+        params = decoder.apply_chain(list(packets))
+        if decoder.version > self.transport.version():
+            self.server.load_params(params)
+            self.transport.set_version(decoder.version)
+            self.writer.set_weight_version(decoder.version)
+        return decoder.version
+
 
 class _EngineProc:
     """Adapter making an in-process `FleetEngine` look like a subprocess to

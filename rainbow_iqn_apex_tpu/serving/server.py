@@ -72,6 +72,18 @@ class PolicyServer:
             if metrics_path
             else None
         )
+        self._obs_shape_early = tuple(state_shape or cfg.state_shape)
+        # calibration for the quantization agreement gate: callers with real
+        # traffic/replay frames pass them via engine.set_calibration; the
+        # default synthesizes seeded uniform frames, which exercise the full
+        # numeric path (conv -> taus -> heads) even if they are not the
+        # served distribution (docs/PERFORMANCE.md "quantization")
+        calib_obs = None
+        if getattr(cfg, "serve_quantize", "off") != "off":
+            n = max(int(getattr(cfg, "quant_calib_batch", 64)), 1)
+            calib_obs = np.random.default_rng(cfg.seed + 7).integers(
+                0, 255, (n, *self._obs_shape_early), dtype=np.uint8
+            )
         self.engine = InferenceEngine(
             cfg,
             num_actions,
@@ -79,6 +91,8 @@ class PolicyServer:
             devices=devices,
             buckets=parse_buckets(cfg.serve_batch_buckets),
             mode=cfg.serve_mode,
+            calib_obs=calib_obs,
+            quant_log=self._quant_log,
         )
         self.batcher = MicroBatcher(
             self.engine.buckets,
@@ -108,6 +122,19 @@ class PolicyServer:
             self.obs_http = ObsHTTPServer(
                 self.metrics.registry, self.healthz, port=cfg.obs_http_port
             )
+
+    def _quant_log(self, kind: str, **fields: Any) -> None:
+        """Engine gate events -> the shared metrics surface: schema rows
+        (`quant` / `quant_fallback`) plus registry gauges so /metrics and
+        RunHealth see the same numbers."""
+        reg = self.metrics.registry
+        if kind == "quant_fallback":
+            reg.counter("quant_fallback_total", "serve").inc()
+        if fields.get("agreement") is not None:
+            reg.gauge("quant_action_agreement", "serve").set(
+                float(fields["agreement"]))
+        if self.metrics.logger is not None:
+            self.metrics.logger.log(kind, **fields)
 
     @classmethod
     def from_checkpoint(
@@ -287,6 +314,9 @@ class PolicyServer:
             "weights_age_s": round(self.engine.weights_age_s(), 3),
             "weights_step": None if self.watcher is None
             else self.watcher.last_step,
+            # quantized-inference status (docs/SERVING.md): which numeric
+            # path is live and the last gate's agreement
+            **self.engine.quant_state(),
             **snap,
         }
 
@@ -296,6 +326,7 @@ class PolicyServer:
             "params_version": self.engine.params_version,
             "compiled_executables": self.engine.compiled_executables(),
             "buckets": self.engine.buckets,
+            **self.engine.quant_state(),
             **self.metrics.stats(),
         }
 

@@ -29,9 +29,17 @@ is one ``fleet_soak`` row in the PR-6 budgeted-row convention (no ``status``
 key when healthy; ``"status": "error"/"gate_failed"`` otherwise), plus a
 lint-clean run dir of route/scale/rollout/serve JSONL.
 
+``--quant`` runs the fp32-vs-int8 serving comparison (`make quant-smoke`):
+the same fixed load through a fp32 engine and a quantized one
+(``serve_quantize="int8"``, agreement-gated), one ``quant_serve`` row with
+both modes' req/s + p99 and the gate outcome — the gate MUST activate the
+quantized path and both modes must complete every request (exit 1
+otherwise).
+
 Usage:
     JAX_PLATFORMS=cpu python scripts/bench_serve.py --clients 64 --requests 2000
     JAX_PLATFORMS=cpu python scripts/bench_serve.py --fleet-soak --engines 2
+    JAX_PLATFORMS=cpu python scripts/bench_serve.py --quant --clients 16
 """
 
 import argparse
@@ -163,6 +171,133 @@ class _InProcFleet:
         for engine_id in list(self.engines):
             self.stop_engine(engine_id)
         self.logger.close()
+
+
+def quant_bench(args) -> int:
+    """``--quant``: fp32 vs int8 serving through the REAL stack at fixed
+    load (same clients/requests/buckets), one ``quant_serve`` row with both
+    modes' req/s and p99 plus the gate outcome.  Gates (exit 1): the int8
+    engine's agreement gate must ACTIVATE the quantized path (this is the
+    one real-engine int8 serve `make quant-smoke` requires), and both modes
+    must complete every request.
+
+    Honest-numbers note: on the CPU backend weight-only int8 adds an
+    in-graph dequantize to every dispatch, so ``speedup_vs_fp32`` near (or
+    under) 1.0 here is expected — the capacity win is an accelerator
+    story (HBM bandwidth + smaller broadcasts); what this smoke proves is
+    the gate, the serving correctness, and the row/metrics surface."""
+    import numpy as np
+
+    import jax
+
+    from rainbow_iqn_apex_tpu.config import Config
+    from rainbow_iqn_apex_tpu.ops.learn import init_train_state
+    from rainbow_iqn_apex_tpu.serving import PolicyServer
+
+    out_dir = (args.out if args.out != "results/serve_bench"
+               else "results/quant_bench")
+    os.makedirs(out_dir, exist_ok=True)
+
+    def run_mode(quant_mode, params):
+        cfg = Config(
+            compute_dtype="float32",
+            frame_height=44, frame_width=44, history_length=2,
+            hidden_size=64, num_cosines=16,
+            num_tau_samples=8, num_tau_prime_samples=8,
+            num_quantile_samples=4,
+            serve_batch_buckets=args.buckets,
+            serve_deadline_ms=args.deadline_ms,
+            serve_queue_bound=args.queue_bound,
+            serve_mode=args.mode,
+            serve_metrics_interval_s=1.0,
+            serve_quantize=quant_mode,
+            quant_agreement_min=args.agreement_min,
+            run_id=f"quant_bench_{quant_mode}",
+            seed=args.seed,
+        )
+        server = PolicyServer(
+            cfg, args.num_actions, params,
+            metrics_path=os.path.join(out_dir, f"serve_{quant_mode}.jsonl"),
+        )
+        server.start()
+        rng = np.random.default_rng(args.seed)
+        obs_pool = rng.integers(0, 255, (64, 44, 44, 2), dtype=np.uint8)
+        issued = threading.Semaphore(args.requests)
+        done = [0]
+        lock = threading.Lock()
+        errors = []
+
+        def client(idx):
+            while issued.acquire(blocking=False):
+                try:
+                    server.act(obs_pool[idx % len(obs_pool)], timeout=120)
+                except Exception as e:  # noqa: BLE001
+                    errors.append(f"{type(e).__name__}: {e}")
+                    return
+                with lock:
+                    done[0] += 1
+
+        threads = [threading.Thread(target=client, args=(i,), daemon=True)
+                   for i in range(args.clients)]
+        t0 = time.monotonic()
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        wall = time.monotonic() - t0
+        quant_state = server.engine.quant_state()
+        stats = server.stop()
+        return {
+            "rps": done[0] / max(wall, 1e-9),
+            "p99_ms": stats.get("latency_p99_ms"),
+            "completed": done[0],
+            "errors": len(errors),
+            "quant_active": quant_state["quant_active"],
+            "quant_agreement": quant_state["quant_agreement"],
+            "quant_fallbacks": quant_state["quant_fallbacks"],
+        }
+
+    state = init_train_state(
+        Config(compute_dtype="float32", frame_height=44, frame_width=44,
+               history_length=2, hidden_size=64, num_cosines=16,
+               num_tau_samples=8, num_tau_prime_samples=8,
+               num_quantile_samples=4),
+        args.num_actions, jax.random.PRNGKey(0))
+    row(event="quant_bench_start", clients=args.clients,
+        requests=args.requests, out=out_dir)
+    fp32 = run_mode("off", state.params)
+    row(event="quant_bench_fp32_done", **fp32)
+    int8 = run_mode("int8", state.params)
+    row(event="quant_bench_int8_done", **int8)
+
+    gates = {
+        "int8_gate_activated": bool(int8["quant_active"]),
+        "fp32_completed": fp32["completed"] == args.requests,
+        "int8_completed": int8["completed"] == args.requests,
+        "no_errors": fp32["errors"] == 0 and int8["errors"] == 0,
+    }
+    result = {
+        "path": "quant_serve",
+        "metric": "quant_serve_requests_per_sec",
+        "value": round(int8["rps"], 1),
+        "unit": "req/s (int8 engine; fp32 row alongside)",
+        "rps_fp32": round(fp32["rps"], 1),
+        "rps_int8": round(int8["rps"], 1),
+        "speedup_vs_fp32": round(int8["rps"] / max(fp32["rps"], 1e-9), 3),
+        "p99_fp32_ms": fp32["p99_ms"],
+        "p99_int8_ms": int8["p99_ms"],
+        "agreement": int8["quant_agreement"],
+        "quant_active": int8["quant_active"],
+        "quant_fallbacks": int8["quant_fallbacks"],
+        "requests_per_mode": args.requests,
+        "gates": gates,
+    }
+    if not all(gates.values()):
+        result["status"] = "gate_failed"
+        row(**result)
+        return 1
+    row(**result)
+    return 0
 
 
 def fleet_soak(args) -> int:
@@ -426,6 +561,11 @@ def main() -> int:
     ap.add_argument("--num-actions", type=int, default=6)
     ap.add_argument("--out", default="results/serve_bench",
                     help="directory for the JSONL metrics log")
+    # ---- quantized serving (utils/quantize.py; make quant-smoke) ----
+    ap.add_argument("--quant", action="store_true",
+                    help="run the fp32-vs-int8 serving comparison instead")
+    ap.add_argument("--agreement-min", type=float, default=0.99,
+                    help="greedy-action agreement gate threshold (--quant)")
     # ---- fleet soak (serving/fleet/) ----
     ap.add_argument("--fleet-soak", action="store_true",
                     help="run the router+fleet heavy-traffic soak instead")
@@ -453,6 +593,8 @@ def main() -> int:
     args = ap.parse_args()
     if args.fleet_soak:
         return fleet_soak(args)
+    if args.quant:
+        return quant_bench(args)
 
     import jax
     import numpy as np

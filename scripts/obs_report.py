@@ -130,6 +130,42 @@ def _fleet_section(by_kind: Dict[str, List[Dict[str, Any]]]) -> Dict[str, Any]:
     }
 
 
+def _quant_section(by_kind: Dict[str, List[Dict[str, Any]]]) -> Dict[str, Any]:
+    """Fold quant/publish/quant_fallback rows: is the quantized path live,
+    what did the gate last measure, and how many publish bytes the delta/
+    int8 path saved vs shipping fp32 full (docs/PERFORMANCE.md "quant")."""
+    quant = by_kind.get("quant", [])
+    fallbacks = by_kind.get("quant_fallback", [])
+    publish = by_kind.get("publish", [])
+    # the CURRENT state is whichever gate outcome is newest — 'quant' rows
+    # are emitted only on PASS, so after a run of fallbacks the last quant
+    # row is stale and reporting it as "active" would read the opposite of
+    # the truth exactly when the RUNBOOK triage needs it
+    last_gate = quant[-1] if quant else {}
+    last_fb = fallbacks[-1] if fallbacks else {}
+    # ts ties (same-millisecond rows) break toward the FALLBACK: reporting
+    # not-active errs toward operator attention, never away from it
+    if last_fb and last_fb.get("ts", 0) >= last_gate.get("ts", -1):
+        newest = last_fb
+    else:
+        newest = last_gate
+    bytes_total = sum(int(r.get("bytes") or 0) for r in publish)
+    bytes_fp32 = sum(int(r.get("bytes_fp32") or 0) for r in publish)
+    return {
+        "gates": len(quant),
+        "fallbacks": len(fallbacks),
+        "last_agreement": newest.get("agreement"),
+        "last_mode": newest.get("mode"),
+        "active": (bool(newest.get("active", False))
+                   if (quant or fallbacks) else None),
+        "publishes": len(publish),
+        "publish_bytes_total": bytes_total,
+        "publish_bytes_fp32": bytes_fp32,
+        "bytes_saved_frac": (round(1.0 - bytes_total / bytes_fp32, 4)
+                             if bytes_fp32 else None),
+    }
+
+
 def aggregate(rows: List[Dict[str, Any]]) -> Dict[str, Any]:
     by_kind: Dict[str, List[Dict[str, Any]]] = {}
     for row in rows:
@@ -256,6 +292,9 @@ def aggregate(rows: List[Dict[str, Any]]) -> Dict[str, Any]:
         # serving fleet (docs/SERVING.md "fleet"): per-tenant accept/shed,
         # per-engine depth/version spread, scale events, rollout convergence
         "fleet": _fleet_section(by_kind),
+        # quantized inference + compressed distribution: gate agreement,
+        # fallback count, publish bytes saved vs fp32-full
+        "quant": _quant_section(by_kind),
         "shed_total": shed_total,
         "final_eval": {
             k: v for k, v in last_eval.items()
@@ -331,6 +370,15 @@ def render(report: Dict[str, Any]) -> str:
             lines.append(f"  engine {eid}: depth={snap.get('depth')} "
                          f"version={snap.get('version')} "
                          f"alive={snap.get('alive')}")
+    q = report["quant"]
+    if q["gates"] or q["fallbacks"] or q["publishes"]:
+        lines.append(
+            f"quant:   gates={q['gates']} fallbacks={q['fallbacks']} "
+            f"active={q['active']} agreement={q['last_agreement']} "
+            f"mode={q['last_mode']} publishes={q['publishes']} "
+            f"bytes={q['publish_bytes_total']} "
+            f"(saved_frac={q['bytes_saved_frac']})"
+        )
     e = report["elastic"]
     if any(e.values()):
         lines.append(

@@ -217,6 +217,10 @@ def health_attribution(metrics_glob) -> dict:
     # router/fleet (bench_serve soak) gets its route/scale/rollout activity
     # attributed the same way — sheds and scale churn are the phase's story
     fleet = {"route": 0, "scale": 0, "rollout": 0}
+    # quantization rows (docs/PERFORMANCE.md "quant"): a window that kept
+    # falling back to fp32 is a different finding (accuracy gate refusing)
+    # than one that quantized cleanly — the tally carries it into phase_done
+    quant = {"quant": 0, "quant_fallback": 0, "publish": 0}
     last = None
     for path in sorted(_glob.glob(metrics_glob)):
         try:
@@ -236,13 +240,16 @@ def health_attribution(metrics_glob) -> dict:
                         heals[kind] += 1
                     elif kind in fleet:
                         fleet[kind] += 1
+                    elif kind in quant:
+                        quant[kind] += 1
         except OSError:
             continue
     order = {"ok": 0, "degraded": 1, "failing": 2}
     worst = max((s for s, n in counts.items() if n),
                 key=lambda s: order[s], default=None)
     return {"rows": sum(counts.values()), "counts": counts,
-            "last": last, "worst": worst, "heals": heals, "fleet": fleet}
+            "last": last, "worst": worst, "heals": heals, "fleet": fleet,
+            "quant": quant}
 
 
 def classify_phase(rc: int, tail: str) -> str:
