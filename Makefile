@@ -2,7 +2,7 @@
 
 PY ?= python
 
-.PHONY: test test-fast serve-smoke serve-bench chaos-smoke obs-smoke soak-smoke perf-smoke fleet-smoke quant-smoke
+.PHONY: test test-fast serve-smoke serve-bench chaos-smoke obs-smoke soak-smoke perf-smoke fleet-smoke quant-smoke trace-smoke
 
 # tier-1: fast unit + integration tests on the virtual 8-device CPU mesh
 test-fast:
@@ -85,6 +85,45 @@ perf-smoke:
 	  print('weight_publish: int8-delta %.0f B/publish vs fp32 %d B (%.2fx)' \
 	        % (w['value'], w['fp32_bytes_per_publish'], w['ratio_vs_fp32'])); \
 	  assert w['ratio_vs_fp32'] >= 3.0, 'int8-delta publish under 3x vs fp32'"
+	$(PY) scripts/bench_diff.py /tmp/ria_perf_smoke.jsonl
+
+# trace smoke (docs/OBSERVABILITY.md "tracing"): a tiny TRACED apex run
+# (trace_sample_every=4) must yield span_link/lag rows that (1) lint as
+# strict schema-versioned JSONL, (2) export to VALID Perfetto trace_event
+# JSON (cross-host flow events, schema-checked by trace_export --check),
+# and (3) drive obs_report to a `critical_path:` stage verdict; then the
+# trace_overhead bench row must show the traced learn loop within 3% of
+# the untraced one (the always-on-lag + 1-in-N-span overhead gate)
+trace-smoke:
+	rm -rf /tmp/ria_trace_smoke
+	JAX_PLATFORMS=cpu $(PY) train_agent_apex.py --role apex \
+	  --env-id toy:catch --compute-dtype float32 --history-length 2 \
+	  --hidden-size 64 --num-cosines 16 --num-tau-samples 4 \
+	  --num-tau-prime-samples 4 --num-quantile-samples 4 --batch-size 16 \
+	  --learning-rate 1e-3 --multi-step 3 --gamma 0.9 --memory-capacity 4096 \
+	  --learn-start 512 --replay-ratio 2 --target-update-period 200 \
+	  --num-envs-per-actor 8 --metrics-interval 100 --eval-interval 0 \
+	  --checkpoint-interval 0 --eval-episodes 2 --t-max 3072 \
+	  --trace-sample-every 4 --weight-publish-interval 200 \
+	  --run-id trace_smoke --results-dir /tmp/ria_trace_smoke/results \
+	  --checkpoint-dir /tmp/ria_trace_smoke/ckpt
+	$(PY) scripts/lint_jsonl.py /tmp/ria_trace_smoke/results/trace_smoke
+	$(PY) scripts/trace_export.py /tmp/ria_trace_smoke/results/trace_smoke \
+	  -o /tmp/ria_trace_smoke/trace.json --check
+	$(PY) scripts/obs_report.py /tmp/ria_trace_smoke/results/trace_smoke \
+	  | tee /tmp/ria_trace_smoke/report.txt
+	grep -q "critical_path:" /tmp/ria_trace_smoke/report.txt
+	JAX_PLATFORMS=cpu BENCH_TRACE_ONLY=1 BENCH_WATCHDOG_SECS=240 \
+	  $(PY) bench.py | tee /tmp/ria_trace_smoke/bench.jsonl
+	$(PY) scripts/lint_jsonl.py /tmp/ria_trace_smoke/bench.jsonl
+	$(PY) -c "import json; rows = [json.loads(l) for l in \
+	  open('/tmp/ria_trace_smoke/bench.jsonl') if l.strip()]; \
+	  r = [x for x in rows if x.get('path') == 'trace_overhead'][-1]; \
+	  assert r.get('status') is None, 'trace_overhead row: %s' % r['status']; \
+	  print('trace_overhead: %.2f%% (traced %.2f vs untraced %.2f steps/s)' \
+	        % (100 * r['value'], r['traced_steps_per_sec'], \
+	           r['untraced_steps_per_sec'])); \
+	  assert r['value'] <= 0.03, 'tracing overhead above 3%'"
 
 # quant smoke (docs/PERFORMANCE.md "quantization"): the quantize unit tests
 # (codec bit-exactness, delta resync, gate fallback, off-mode bitwise), one

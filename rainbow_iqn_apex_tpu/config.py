@@ -44,6 +44,12 @@ class Config:
     trace_start_step: int = 50  # past warmup/compile so the capture is steady-state
     trace_num_steps: int = 10
     obs_http_port: int = 0  # serve /metrics + /healthz on this port; 0 = off
+    trace_sample_every: int = 0  # pipeline tracing (obs/pipeline_trace.py):
+    # every Nth unit of work (env tick, learn step, publish, request) emits
+    # causal `span_link` rows — trace_export.py turns them into a Perfetto
+    # timeline, obs_report into a `critical_path:` verdict.  0 (default) =
+    # spans off; the always-on lag_* metrics cost a few histogram writes per
+    # batch either way and change no numerics (off-path stays bitwise).
 
     # ---- resilience (utils/faults.py + parallel/supervisor.py; RESILIENCE.md) ----
     fault_spec: str = ""  # chaos injection, e.g. "nan_loss@5,checkpoint_write@1"

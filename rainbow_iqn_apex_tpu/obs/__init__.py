@@ -29,6 +29,11 @@ from rainbow_iqn_apex_tpu.obs.registry import (
     Histogram,
     MetricRegistry,
 )
+from rainbow_iqn_apex_tpu.obs.pipeline_trace import (
+    PipelineTracer,
+    critical_path,
+    format_critical_path,
+)
 from rainbow_iqn_apex_tpu.obs.registry import get as get_registry
 from rainbow_iqn_apex_tpu.obs.registry import reset_global as reset_global_registry
 from rainbow_iqn_apex_tpu.obs.schema import (
@@ -64,12 +69,15 @@ __all__ = [
     "Histogram",
     "MetricRegistry",
     "ObsHTTPServer",
+    "PipelineTracer",
     "REQUIRED_KEYS",
     "RunHealth",
     "RunObs",
     "SCHEMA_VERSION",
     "TraceWindow",
     "Tracer",
+    "critical_path",
+    "format_critical_path",
     "get_registry",
     "install_compile_counter",
     "prometheus_text",

@@ -60,6 +60,11 @@ class RunHealth:
         # run degraded until they resume.
         self.evicted_hosts: set = set()
         self.fenced_hosts: set = set()
+        # pipeline tracing (obs/pipeline_trace.py): consumers whose
+        # publish->adopt p99 breached the max_weight_lag-derived budget in
+        # the newest `lag` row — the window is degraded and the health row
+        # NAMES the offender; a clean lag row clears the set
+        self.lag_consumers: set = set()
         self.readmits = 0
         self.total_shed = 0
         self._last_strikes = 0
@@ -161,6 +166,31 @@ class RunHealth:
             if b:
                 self.registry.counter("publish_bytes_total", "health").inc(b)
             self.registry.gauge("publish_bytes_last", "health").set(b)
+        elif kind == "lag":
+            # propagation-lag budget check (obs/pipeline_trace.py): the
+            # budget is max_weight_lag publishes' worth of publish cadence —
+            # a consumer whose publish->adopt p99 exceeds it is adopting
+            # weights slower than the staleness fence tolerates, which means
+            # it is about to fence (shed frames) or is already serving
+            # stale-beyond-budget answers.  Degrade the window and NAME it.
+            budget = row.get("publish_adopt_budget_ms")
+            per = row.get("publish_adopt_ms_by_consumer") or {}
+            breached = ([c for c, s in per.items()
+                         if (s or {}).get("p99", 0) > budget]
+                        if budget else [])
+            with self._lock:
+                if breached:
+                    self.lag_consumers.update(breached)
+                    self.fault_counts["propagation_lag"] += len(breached)
+                    self._win_faults["propagation_lag"] += len(breached)
+                elif per:
+                    # a lag row with adopt stats and no breach is the heal
+                    # edge: stop naming consumers that caught back up
+                    self.lag_consumers.clear()
+            if breached:
+                self.registry.counter(
+                    "propagation_lag_breaches_total", "health").inc(
+                    len(breached))
 
     def note_fault(self, event: str, row: Optional[Dict[str, Any]] = None) -> None:
         with self._lock:
@@ -248,6 +278,7 @@ class RunHealth:
                 "hosts_dead": sorted(self.dead_hosts),
                 "hosts_evicted": sorted(self.evicted_hosts),
                 "hosts_fenced": sorted(self.fenced_hosts),
+                "lag_consumers": sorted(self.lag_consumers),
                 "readmits": int(self.readmits),
             }
             self._win_faults.clear()

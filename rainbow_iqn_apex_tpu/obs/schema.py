@@ -64,6 +64,19 @@ REQUIRED_KEYS: Dict[str, frozenset] = {
     # carries agreement/threshold/mode/active)
     "quant_fallback": frozenset({"reason"}),  # the gate REFUSED quantized
     # params (reason e.g. agreement_below_min; carries agreement/threshold)
+    # pipeline tracing rows (obs/pipeline_trace.py; docs/OBSERVABILITY.md
+    # "tracing"):
+    "span_link": frozenset({"stage", "trace_id", "span_id", "parent_id",
+                            "t0", "dur_ms"}),  # one sampled causal span
+    # (trace_id is "<kind><host>-<unit>", identical across processes for the
+    # same logical unit — the cross-host flow key scripts/trace_export.py
+    # turns into Perfetto flow arrows; optional `links` lists other trace
+    # ids this span consumed, e.g. a learn step's sampled append ticks)
+    "lag": frozenset({"step"}),  # periodic lag-attribution row: per-metric
+    # window percentiles of the always-on lag_* histograms (sample age at
+    # learn time, ring retirement, router dispatch, batcher slot wait) plus
+    # publish_adopt_ms_by_consumer and the max_weight_lag-derived
+    # publish_adopt_budget_ms RunHealth folds breaches against
 }
 
 HEALTH_STATUSES = ("ok", "degraded", "failing")

@@ -496,7 +496,18 @@ def train_apex(cfg: Config, max_frames: Optional[int] = None) -> Dict[str, Any]:
     faults.install_from(cfg)
     obs_run = RunObs(cfg, metrics, role="learner")
     memory.attach_registry(obs_run.registry)
-    driver.attach_obs(metrics, obs_run.registry)
+    # pipeline tracing (obs/pipeline_trace.py): always-on lag attribution
+    # (sample age, ring retirement, publish->adopt) + 1-in-N causal span
+    # emission when cfg.trace_sample_every > 0 (off = bitwise seed path)
+    from rainbow_iqn_apex_tpu.obs.pipeline_trace import PipelineTracer
+
+    ptrace = PipelineTracer(
+        metrics, obs_run.registry, cfg.trace_sample_every,
+        host=cfg.process_id,
+    )
+    ptrace.max_weight_lag = cfg.max_weight_lag
+    memory.attach_tracer(ptrace)
+    driver.attach_obs(metrics, obs_run.registry, tracer=ptrace)
     if driver.quant_disabled_reason is not None:
         # mirrors the device_sampling multihost fallback: identical cfg on
         # every host, so the whole pod declines together (lockstep SPMD)
@@ -595,6 +606,7 @@ def train_apex(cfg: Config, max_frames: Optional[int] = None) -> Dict[str, Any]:
         # frontier.update (a jitted scatter) — the priority vector never
         # crosses to host per step; reconcile() syncs the cold path at drains
         materialize_priorities=frontier is None,
+        tracer=ptrace,
     )
     committer = RingCommitter(
         ring,
@@ -627,25 +639,32 @@ def train_apex(cfg: Config, max_frames: Optional[int] = None) -> Dict[str, Any]:
     held = None  # pipelined: completed transition awaiting its Q for append
     try:
         while frames < total_frames:
-            if use_dstack:
-                with obs_run.span("act"):
-                    actions, q = driver.act_frames(obs, prev_cuts)
-            else:
-                stacked = stacker.push(obs)
-                if multihost:
-                    actions, q = driver.act_local(stacked)
-                elif cfg.pipelined_actor:
-                    # Overlap: dispatch inference for THIS obs; execute the
-                    # action computed from the PREVIOUS obs (one-tick
-                    # behaviour lag; the first tick primes the pipe
-                    # synchronously).
-                    nxt = driver.act_async(stacked)
-                    if pending is None:
-                        pending = nxt
-                    actions = np.asarray(pending[0])
+            # causal tracing: this tick's appends land on append tick
+            # append_ticks+1 — sampled ticks carry act/env-step/append spans
+            # under the id the learn span will link back to
+            tick_tid = ptrace.maybe_trace("a", memory.append_ticks + 1)
+            with ptrace.span("act", tick_tid):
+                if use_dstack:
+                    with obs_run.span("act"):
+                        actions, q = driver.act_frames(obs, prev_cuts)
                 else:
-                    actions, q = driver.act(stacked)
-            new_obs, rewards, terminals, truncs, ep_returns = env.step(actions)
+                    stacked = stacker.push(obs)
+                    if multihost:
+                        actions, q = driver.act_local(stacked)
+                    elif cfg.pipelined_actor:
+                        # Overlap: dispatch inference for THIS obs; execute
+                        # the action computed from the PREVIOUS obs
+                        # (one-tick behaviour lag; the first tick primes the
+                        # pipe synchronously).
+                        nxt = driver.act_async(stacked)
+                        if pending is None:
+                            pending = nxt
+                        actions = np.asarray(pending[0])
+                    else:
+                        actions, q = driver.act(stacked)
+            with ptrace.span("env_step", tick_tid):
+                new_obs, rewards, terminals, truncs, ep_returns = env.step(
+                    actions)
             cuts = terminals | truncs  # truncation cuts windows like a terminal
             if cfg.pipelined_actor:
                 # The transition (s_t, a_t, r_t) needs Q(s_t) — that's `nxt`,
@@ -660,14 +679,19 @@ def train_apex(cfg: Config, max_frames: Optional[int] = None) -> Dict[str, Any]:
                         if estimator
                         else None
                     )
-                    memory.append_batch(
-                        h_obs, h_act, h_rew, h_term, pri, truncations=h_trunc
-                    )
+                    # the held transition lands on THIS tick's append seq
+                    # (one append per tick), so tick_tid is its id — the
+                    # trace carries the pipeline's own one-tick lag
+                    with ptrace.span("append", tick_tid):
+                        memory.append_batch(
+                            h_obs, h_act, h_rew, h_term, pri, truncations=h_trunc
+                        )
                 held = (obs, actions, rewards, terminals, truncs, nxt[1])
                 pending = nxt
             else:
                 pri = estimator.push(q, actions, rewards, cuts) if estimator else None
-                memory.append_batch(obs, actions, rewards, terminals, pri, truncations=truncs)
+                with ptrace.span("append", tick_tid):
+                    memory.append_batch(obs, actions, rewards, terminals, pri, truncations=truncs)
             if not use_dstack:
                 stacker.reset_lanes(cuts)
             prev_cuts = cuts
@@ -749,34 +773,59 @@ def train_apex(cfg: Config, max_frames: Optional[int] = None) -> Dict[str, Any]:
                             driver.step,
                             lambda: (host_state(driver.state), driver.key),
                         )
+                    # causal tracing: the step this dispatch creates; its
+                    # span links back to the sampled append ticks its batch
+                    # rows came from (env-step -> learn flow arrows)
+                    ltid = ptrace.maybe_trace("l", driver.step + 1)
                     if multihost:
                         # local sub-batch in; the global batch assembles
                         # across hosts inside, IS weights are re-derived
                         # globally, and the ring extracts this host's local
                         # priority rows at retirement
-                        if prefetcher is not None:
-                            idx, sample = prefetcher.get()
-                        else:
-                            sample = memory.sample(local_batch, priority_beta(cfg, frames))
-                            idx = sample.idx
-                        with obs_run.span("learn_step"):
-                            info = driver.learn_local(
-                                sup.poison_maybe(sample),
-                                global_size=len(memory) * nproc,
-                                beta=priority_beta(cfg, frames),
-                            )
+                        with ptrace.span("gather", ltid):
+                            if prefetcher is not None:
+                                idx, sample = prefetcher.get()
+                            else:
+                                sample = memory.sample(local_batch, priority_beta(cfg, frames))
+                                idx = sample.idx
+                        links = ptrace.link_ids(
+                            "a", memory.trace_ids(idx)) if ltid else ()
+                        with ptrace.span("learn_step", ltid, links=links,
+                                         step=driver.step + 1):
+                            with obs_run.span("learn_step"):
+                                info = driver.learn_local(
+                                    sup.poison_maybe(sample),
+                                    global_size=len(memory) * nproc,
+                                    beta=priority_beta(cfg, frames),
+                                )
                     elif prefetcher is not None:
-                        idx, batch = prefetcher.get()
-                        with obs_run.span("learn_step"):
-                            info = driver.learn_batch(sup.poison_maybe(batch))
+                        with ptrace.span("gather", ltid):
+                            idx, batch = prefetcher.get()
+                        # slot stamps are read at DISPATCH, not at the
+                        # worker's sample: a slot the ring cursor lapped in
+                        # between (<= lanes*depth/capacity odds per batch)
+                        # links one tick late — accepted for sampled
+                        # telemetry rather than threading stamps through
+                        # every prefetcher payload
+                        links = ptrace.link_ids(
+                            "a", memory.trace_ids(idx)) if ltid else ()
+                        with ptrace.span("learn_step", ltid, links=links,
+                                         step=driver.step + 1):
+                            with obs_run.span("learn_step"):
+                                info = driver.learn_batch(sup.poison_maybe(batch))
                     else:
-                        with obs_run.span("replay_sample"):
-                            sample = memory.sample(
-                                local_batch, priority_beta(cfg, frames)
-                            )
+                        with ptrace.span("replay_sample", ltid):
+                            with obs_run.span("replay_sample"):
+                                sample = memory.sample(
+                                    local_batch, priority_beta(cfg, frames)
+                                )
                         idx = sample.idx
-                        with obs_run.span("learn_step"):
-                            info = driver.learn(sup.poison_maybe(sample))
+                        links = ptrace.link_ids(
+                            "a", memory.trace_ids(idx)) if ltid else ()
+                        with ptrace.span("learn_step", ltid, links=links,
+                                         step=driver.step + 1):
+                            with obs_run.span("learn_step"):
+                                info = driver.learn(sup.poison_maybe(sample))
                     sup.maybe_stall()
                     # Dispatch-only hot path: info stays on device; the ring
                     # retires step t-K (write-back + deferred NaN guard)
@@ -840,6 +889,10 @@ def train_apex(cfg: Config, max_frames: Optional[int] = None) -> Dict[str, Any]:
                             weight_version_lag=fence.lag,
                             **pipeline_gauges(ring, obs_run.registry, frontier),
                         )
+                        # lag-attribution row (obs/pipeline_trace.py):
+                        # sample age / retirement / publish->adopt
+                        # percentiles, RunHealth folds budget breaches
+                        ptrace.emit_lag_row(step)
                         if monitor is not None:
                             # a preempted host stops heartbeating; the
                             # host_dead row is the external supervisor's

@@ -434,7 +434,18 @@ def train_apex_r2d2(cfg: Config, max_frames: Optional[int] = None) -> Dict[str, 
     faults.install_from(cfg)
     obs_run = RunObs(cfg, metrics, role="learner")
     sup = TrainSupervisor(cfg, metrics=metrics, registry=obs_run.registry)
-    driver.attach_obs(metrics, obs_run.registry)
+    # pipeline tracing — identical contract to train_apex (the two drivers
+    # must not drift on the obs surface): always-on lag attribution, 1-in-N
+    # span sampling; the r2d2 trace unit for appends is the EMITTED sequence
+    from rainbow_iqn_apex_tpu.obs.pipeline_trace import PipelineTracer
+
+    ptrace = PipelineTracer(
+        metrics, obs_run.registry, cfg.trace_sample_every,
+        host=cfg.process_id,
+    )
+    ptrace.max_weight_lag = cfg.max_weight_lag
+    memory.attach_tracer(ptrace)
+    driver.attach_obs(metrics, obs_run.registry, tracer=ptrace)
     if driver.quant_disabled_reason is not None:
         metrics.log("notice", event="quant_fallback_multihost",
                     reason="multihost: fp32/bf16 publish path retained")
@@ -515,6 +526,7 @@ def train_apex_r2d2(cfg: Config, max_frames: Optional[int] = None) -> Dict[str, 
         registry=obs_run.registry,
         priorities_to_host=_local_rows if multihost else None,
         materialize_priorities=frontier is None,
+        tracer=ptrace,
     )
     committer = RingCommitter(
         ring,
@@ -537,17 +549,24 @@ def train_apex_r2d2(cfg: Config, max_frames: Optional[int] = None) -> Dict[str, 
 
     try:
         while frames < total_frames:
-            if use_dstack:
-                with obs_run.span("act"):
-                    actions, (pre_c, pre_h) = driver.act_frames(obs, prev_cuts)
-            else:
-                with obs_run.span("act"):
-                    actions, (pre_c, pre_h) = driver.act(stacker.push(obs))
-            new_obs, rewards, terminals, truncs, ep_returns = env.step(actions)
+            # causal tracing: ticks feeding the NEXT emitted sequence share
+            # its trace id (sequence builders span many ticks)
+            tick_tid = ptrace.maybe_trace("a", memory.emit_count + 1)
+            with ptrace.span("act", tick_tid):
+                if use_dstack:
+                    with obs_run.span("act"):
+                        actions, (pre_c, pre_h) = driver.act_frames(obs, prev_cuts)
+                else:
+                    with obs_run.span("act"):
+                        actions, (pre_c, pre_h) = driver.act(stacker.push(obs))
+            with ptrace.span("env_step", tick_tid):
+                new_obs, rewards, terminals, truncs, ep_returns = env.step(
+                    actions)
             cuts = terminals | truncs
-            memory.append_batch(
-                obs, actions, rewards, terminals, pre_c, pre_h, truncations=truncs
-            )
+            with ptrace.span("append", tick_tid):
+                memory.append_batch(
+                    obs, actions, rewards, terminals, pre_c, pre_h, truncations=truncs
+                )
             driver.reset_lanes(cuts)
             if not use_dstack:
                 stacker.reset_lanes(cuts)
@@ -632,30 +651,49 @@ def train_apex_r2d2(cfg: Config, max_frames: Optional[int] = None) -> Dict[str, 
                             driver.step,
                             lambda: (host_state(driver.state), driver.key),
                         )
+                    ltid = ptrace.maybe_trace("l", driver.step + 1)
                     if multihost:
-                        if prefetcher is not None:
-                            idx, s = prefetcher.get()
-                        else:
-                            s = memory.sample(local_batch, priority_beta(cfg, frames))
-                            idx = s.idx
-                        with obs_run.span("learn_step"):
-                            info = driver.learn_local(
-                                sup.poison_maybe(s),
-                                global_size=len(memory) * nproc,
-                                beta=priority_beta(cfg, frames),
-                            )
+                        with ptrace.span("gather", ltid):
+                            if prefetcher is not None:
+                                idx, s = prefetcher.get()
+                            else:
+                                s = memory.sample(local_batch, priority_beta(cfg, frames))
+                                idx = s.idx
+                        links = ptrace.link_ids(
+                            "a", memory.trace_ids(idx)) if ltid else ()
+                        with ptrace.span("learn_step", ltid, links=links,
+                                         step=driver.step + 1):
+                            with obs_run.span("learn_step"):
+                                info = driver.learn_local(
+                                    sup.poison_maybe(s),
+                                    global_size=len(memory) * nproc,
+                                    beta=priority_beta(cfg, frames),
+                                )
                     elif prefetcher is not None:
-                        idx, batch = prefetcher.get()
-                        with obs_run.span("learn_step"):
-                            info = driver.learn_batch(sup.poison_maybe(batch))
+                        with ptrace.span("gather", ltid):
+                            idx, batch = prefetcher.get()
+                        # stamps read at dispatch, not the worker's sample —
+                        # a lapped slot links one emit late; accepted for
+                        # sampled telemetry (see apex.py's note)
+                        links = ptrace.link_ids(
+                            "a", memory.trace_ids(idx)) if ltid else ()
+                        with ptrace.span("learn_step", ltid, links=links,
+                                         step=driver.step + 1):
+                            with obs_run.span("learn_step"):
+                                info = driver.learn_batch(sup.poison_maybe(batch))
                     else:
-                        with obs_run.span("replay_sample"):
-                            s = memory.sample(
-                                local_batch, priority_beta(cfg, frames)
-                            )
+                        with ptrace.span("replay_sample", ltid):
+                            with obs_run.span("replay_sample"):
+                                s = memory.sample(
+                                    local_batch, priority_beta(cfg, frames)
+                                )
                         idx, batch = s.idx, to_device_seq_batch(s)
-                        with obs_run.span("learn_step"):
-                            info = driver.learn_batch(sup.poison_maybe(batch))
+                        links = ptrace.link_ids(
+                            "a", memory.trace_ids(idx)) if ltid else ()
+                        with ptrace.span("learn_step", ltid, links=links,
+                                         step=driver.step + 1):
+                            with obs_run.span("learn_step"):
+                                info = driver.learn_batch(sup.poison_maybe(batch))
                     sup.maybe_stall()
                     # dispatch-only hot path; the deferred guard decision is
                     # still lockstep across hosts (all-reduced loss -> same
@@ -706,6 +744,7 @@ def train_apex_r2d2(cfg: Config, max_frames: Optional[int] = None) -> Dict[str, 
                             weight_version_lag=fence.lag,
                             **pipeline_gauges(ring, obs_run.registry, frontier),
                         )
+                        ptrace.emit_lag_row(step)
                         if monitor is not None:
                             # same lease-edge reporting as train_apex: one
                             # host_dead/host_alive row per lease epoch

@@ -346,17 +346,21 @@ class WeightMailbox:
     ``publish_params`` — ``publish`` is byte-for-byte the PR-4 behaviour."""
 
     def __init__(self, path: str, base_interval: int = 10,
-                 compression: str = "int8_delta"):
+                 compression: str = "int8_delta", host: int = 0):
         self.path = path
         self.base_interval = int(base_interval)
         self.compression = compression
+        # stamped into every row as pub_host: subscribers rebuild the
+        # publisher's "w<host>-<version>" trace id from it, which is what
+        # lets trace_export draw the publish->adopt flow across processes
+        self.host = int(host)
         self._encoder = None  # created on first publish_params
         self._files: Dict[int, str] = {}  # version -> payload file
 
     def publish(self, version: int, step: int = 0, **extra: Any) -> None:
         os.makedirs(os.path.dirname(self.path) or ".", exist_ok=True)
         row = {"version": int(version), "step": int(step),
-               "ts": round(time.time(), 3), **extra}
+               "ts": round(time.time(), 3), "pub_host": self.host, **extra}
         tmp = self.path + ".tmp"
         with open(tmp, "w") as f:
             json.dump(row, f)
@@ -449,9 +453,17 @@ class MailboxSubscriber:
     sync, resyncs through the row's chain-from-base after a gap (dropped
     delta, late join) — the subscriber half of ``publish_params``."""
 
-    def __init__(self, mailbox: WeightMailbox):
+    def __init__(self, mailbox: WeightMailbox, tracer=None,
+                 consumer: str = "mailbox"):
         self.mailbox = mailbox
         self.resyncs = 0
+        # pipeline tracing (obs/pipeline_trace.py): adoption lag is measured
+        # against the publish row's OWN wall ts, so it works across
+        # processes that never shared tracer state; the adopt span reuses
+        # the publisher's "w<host>-<version>" trace id, which is what lets
+        # trace_export draw the publish -> adopt flow arrow across hosts.
+        self._tracer = tracer
+        self._consumer = consumer
         from rainbow_iqn_apex_tpu.utils import quantize as quantize_mod
 
         self._quantize = quantize_mod
@@ -461,6 +473,20 @@ class MailboxSubscriber:
     def version(self) -> int:
         return self._decoder.version
 
+    def _note_adopt(self, row: Dict[str, Any], t0: float) -> None:
+        if self._tracer is None:
+            return
+        version = int(row["version"])
+        pub_ts = row.get("ts")
+        lag_ms = (None if pub_ts is None
+                  else max((time.time() - float(pub_ts)) * 1e3, 0.0))
+        self._tracer.note_adopt(self._consumer, version, lag_ms=lag_ms)
+        if self._tracer.sampled(version):
+            self._tracer.emit_span(
+                "adopt", f"w{int(row.get('pub_host', 0))}-{version}", t0,
+                version=version, consumer=self._consumer,
+            )
+
     def poll(self) -> Optional[Any]:
         """Returns the reconstructed fp32 params when a NEW version landed,
         None otherwise.  Bit-exact with the publisher's reconstruction."""
@@ -469,13 +495,14 @@ class MailboxSubscriber:
             return None
         if int(row["version"]) <= self._decoder.version:
             return None
+        t_adopt0 = time.time()
         directory = self.mailbox._payload_dir()
         chain = row["chain"]
         try:
             packets = [self._quantize.load_packet(os.path.join(directory, f))
                        for _v, f in chain]
             try:
-                return self._decoder.apply_chain(
+                out = self._decoder.apply_chain(
                     [p for p in packets if p.version > self._decoder.version])
             except self._quantize.DeltaChainBroken:
                 # missed packet(s) beyond the published chain: fresh-base
@@ -483,9 +510,18 @@ class MailboxSubscriber:
                 # chain starts with its base)
                 self.resyncs += 1
                 self._decoder = self._quantize.DeltaDecoder()
-                return self._decoder.apply_chain(packets)
+                out = self._decoder.apply_chain(packets)
         except (OSError, ValueError, KeyError):
             return None  # racing a prune/rename; retry next poll
+        try:
+            # telemetry AFTER the decode try/except: the decoder has already
+            # advanced, so a tracer/row hiccup here swallowing the params
+            # would silently drop an adopted version forever (the next poll
+            # would see version <= decoder.version and deliver nothing)
+            self._note_adopt(row, t_adopt0)
+        except Exception:
+            pass
+        return out
 
 
 # ----------------------------------------------------------- staleness fence

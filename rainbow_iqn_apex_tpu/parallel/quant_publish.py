@@ -56,6 +56,7 @@ class QuantPublishMixin:
         self._calib_obs = None
         self._obs_metrics = None
         self._obs_registry = None
+        self._obs_tracer = None
         mode = check_mode(cfg.serve_quantize)
         if mode != "off" and multihost:
             self.quant_disabled_reason = "multihost"
@@ -67,12 +68,16 @@ class QuantPublishMixin:
             self._gate_key = jax.random.PRNGKey(cfg.seed + 8221)
         return self.quant_mode
 
-    def attach_obs(self, metrics=None, registry=None) -> None:
+    def attach_obs(self, metrics=None, registry=None, tracer=None) -> None:
         """Hand the driver the run's metrics surface (the loop constructs
         the driver before the logger exists) so publishes can emit
-        `publish`/`quant`/`quant_fallback` rows and gauges."""
+        `publish`/`quant`/`quant_fallback` rows and gauges.  ``tracer`` (a
+        PipelineTracer) additionally anchors publish->adopt lag attribution
+        and, when span sampling is on, emits one `publish` span per
+        broadcast under the weight version's trace id."""
         self._obs_metrics = metrics
         self._obs_registry = registry
+        self._obs_tracer = tracer
 
     def wants_calibration(self) -> bool:
         return self.quant_mode != "off" and self._calib_obs is None
@@ -119,6 +124,9 @@ class QuantPublishMixin:
         falls back to today's fp32/bf16 broadcast and emits one reasoned
         ``quant_fallback`` row.  ``serve_quantize="off"`` takes exactly the
         pre-quant path."""
+        import time as _time
+
+        t_pub0 = _time.time()
         p = self.state.params
         published_mode = None
         if self.quant_mode != "off" and self._calib_obs is not None:
@@ -158,6 +166,23 @@ class QuantPublishMixin:
                 2 if published_mode == "bf16" else 1)
         self.weights_version += 1
         self.actor_weights_version = self.weights_version
+        if self._obs_tracer is not None:
+            # publish->adopt attribution: the fused driver adopts atomically
+            # with the publish, so its in-process consumer measures the
+            # broadcast itself; mailbox/fleet consumers anchor on the same
+            # version.  One `publish` span per broadcast when sampling is on
+            # (publishes are rare — every one is worth a span).
+            tr = self._obs_tracer
+            tr.note_publish(self.weights_version, ts=t_pub0)
+            # sampled like every other stage: emitting a span per publish
+            # while learn steps emit 1-in-N would overweight the publish
+            # stage in critical_path by ~sample_every x
+            if tr.sampled(self.weights_version):
+                tr.emit_span(
+                    "publish", tr.trace_id("w", self.weights_version), t_pub0,
+                    version=self.weights_version, mode=published_mode,
+                )
+            tr.note_adopt("actor_inproc", self.weights_version)
         if self._obs_metrics is not None:
             self._obs_metrics.log(
                 "publish", version=self.weights_version,

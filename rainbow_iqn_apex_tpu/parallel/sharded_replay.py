@@ -13,6 +13,7 @@ single giant tree would give).
 
 from __future__ import annotations
 
+import time
 from typing import List, Optional, Sequence, Tuple
 
 import numpy as np
@@ -53,6 +54,17 @@ class ShardedReplay:
         self._fenced_writes = 0
         self._reg = None  # obs registry (attach_registry); None = untracked
         self._frontier = None  # device sample frontier (attach_frontier)
+        # pipeline tracing (obs/pipeline_trace.py): every written slot is
+        # stamped with the append tick + wall clock it landed on, so sample
+        # time can attribute each batch's AGE (ticks + seconds) and derive
+        # the env-tick trace ids the learn span links back to.  16 bytes per
+        # slot, two scatter writes per append tick — always-on cheap; no
+        # numerics touched, so the untraced path stays bitwise identical.
+        n_slots = len(self.shards) * self.shard_capacity
+        self._append_seq = np.zeros(n_slots, np.int64)
+        self._append_ts = np.zeros(n_slots, np.float64)
+        self.append_ticks = 0  # monotone appends-per-lane counter
+        self._tracer = None
 
     def attach_registry(self, registry, role: str = "replay") -> None:
         """obs/ wiring: appended/sampled row counters + occupancy and
@@ -60,6 +72,34 @@ class ShardedReplay:
         self._reg = registry
         self._role = role
         registry.gauge("replay_shards", role).set(len(self.shards))
+
+    def attach_tracer(self, tracer) -> None:
+        """Pipeline-tracing wiring (obs/pipeline_trace.py): sample/assemble
+        record batch sample-age lags; ``trace_ids`` maps sampled slots back
+        to the append ticks that wrote them (the learn span's flow links)."""
+        self._tracer = tracer
+
+    def _stamp_append(self, k: int, shard: PrioritizedReplay,
+                      pos_before: int) -> None:
+        slots = k * self.shard_capacity + shard._lane_base + pos_before
+        self._append_seq[slots] = self.append_ticks
+        self._append_ts[slots] = time.time()
+
+    def _record_sample_age(self, idx: np.ndarray) -> None:
+        if self._tracer is None or idx.size == 0:
+            return
+        ts = self._append_ts[idx]
+        written = ts > 0  # pre-attach / restored slots carry no stamp
+        if not written.any():
+            return
+        self._tracer.lag("sample_age_ticks", float(
+            (self.append_ticks - self._append_seq[idx][written]).mean()))
+        self._tracer.lag("sample_age_s",
+                         float((time.time() - ts[written]).mean()))
+
+    def trace_ids(self, idx: np.ndarray) -> np.ndarray:
+        """Append tick of each global slot in ``idx`` (0 = never stamped)."""
+        return self._append_seq[np.asarray(idx, np.int64)]
 
     def attach_frontier(self, frontier) -> None:
         """Device-sampling wiring (replay/frontier.py): subsequent appends
@@ -129,6 +169,7 @@ class ShardedReplay:
         Lanes pinned to a dead shard are dropped (their actor host is gone;
         the surviving shards keep absorbing their own lanes)."""
         lps = self.lanes_per_shard
+        self.append_ticks += 1
         for k, shard in enumerate(self.shards):
             if k in self._dead:
                 continue
@@ -142,6 +183,7 @@ class ShardedReplay:
                 None if priorities is None else priorities[sl],
                 None if truncations is None else truncations[sl],
             )
+            self._stamp_append(k, shard, pos_before)
             if self._frontier is not None:
                 self._stage_frontier_delta(k, shard, pos_before)
             if self._reg is not None:
@@ -276,9 +318,11 @@ class ShardedReplay:
         if not self._fence(k, epoch):
             return False
         pos_before = self.shards[k].pos
+        self.append_ticks += 1
         self.shards[k].append_batch(
             frames, actions, rewards, terminals, priorities, truncations
         )
+        self._stamp_append(k, self.shards[k], pos_before)
         if self._frontier is not None:
             self._stage_frontier_delta(k, self.shards[k], pos_before)
         if self._reg is not None:
@@ -347,10 +391,12 @@ class ShardedReplay:
             self._reg.counter("replay_sampled_rows", self._role).inc(batch_size)
         cat = lambda f: np.concatenate([getattr(p, f) for p in parts])  # noqa: E731
         prob = np.concatenate(probs)
+        idx_all = cat("idx")
+        self._record_sample_age(idx_all)
         weight = (n_global * np.maximum(prob, 1e-12)) ** (-beta)
         weight = (weight / weight.max()).astype(np.float32)
         return SampledBatch(
-            idx=cat("idx"),
+            idx=idx_all,
             obs=cat("obs"),
             action=cat("action"),
             reward=cat("reward"),
@@ -432,6 +478,7 @@ class ShardedReplay:
             ))
         if self._reg is not None:
             self._reg.counter("replay_sampled_rows", self._role).inc(B)
+        self._record_sample_age(idx)
         return SampledBatch(
             idx=idx,
             obs=obs,

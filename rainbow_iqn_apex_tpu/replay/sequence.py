@@ -29,6 +29,7 @@ from __future__ import annotations
 
 import dataclasses
 import threading
+import time
 from typing import Optional, Tuple
 
 import numpy as np
@@ -94,6 +95,13 @@ class SequenceReplay:
         # append/sample/update so a prefetch thread never sees partial state
         self._lock = threading.Lock()
         self._frontier = None  # device sample frontier (attach_frontier)
+        # pipeline tracing (obs/pipeline_trace.py): per-slot emit stamps so
+        # sample time can attribute sequence age (emit ticks + seconds) —
+        # always-on telemetry, no numerics touched
+        self._emit_seq = np.zeros(capacity, np.int64)
+        self._emit_ts = np.zeros(capacity, np.float64)
+        self.emit_count = 0
+        self._tracer = None
 
         # ---- per-lane builders: step data + the actor LSTM state BEFORE
         # each buffered step (so any window start has its exact state) ------
@@ -180,6 +188,9 @@ class SequenceReplay:
             self._frontier.stage(
                 np.asarray([slot]), np.asarray([self.max_priority])
             )
+        self.emit_count += 1
+        self._emit_seq[slot] = self.emit_count
+        self._emit_ts[slot] = time.time()
         self.pos = (self.pos + 1) % self.capacity
         self.filled = min(self.filled + 1, self.capacity)
 
@@ -212,6 +223,27 @@ class SequenceReplay:
         stage their slot priority to the HBM mirror."""
         self._frontier = frontier
 
+    def attach_tracer(self, tracer) -> None:
+        """Pipeline-tracing wiring (obs/pipeline_trace.py): sample/assemble
+        record batch sequence-age lags on the shared registry."""
+        self._tracer = tracer
+
+    def trace_ids(self, idx: np.ndarray) -> np.ndarray:
+        """Emit tick of each slot in ``idx`` (0 = never stamped)."""
+        return self._emit_seq[np.asarray(idx, np.int64)]
+
+    def _record_sample_age(self, idx: np.ndarray) -> None:
+        if self._tracer is None or idx.size == 0:
+            return
+        ts = self._emit_ts[idx]
+        written = ts > 0
+        if not written.any():
+            return
+        self._tracer.lag("sample_age_ticks", float(
+            (self.emit_count - self._emit_seq[idx][written]).mean()))
+        self._tracer.lag("sample_age_s",
+                         float((time.time() - ts[written]).mean()))
+
     # -------------------------------------------------------------- sampling
     def sample(self, batch_size: int, beta: float) -> SequenceSample:
         hostsync.check_host_work("replay_sample")
@@ -229,6 +261,7 @@ class SequenceReplay:
         if idx.size and (idx.min() < 0 or idx.max() >= self.capacity):
             raise IndexError(f"assemble idx out of range [0, {self.capacity})")
         with self._lock:
+            self._record_sample_age(idx)
             return SequenceSample(
                 idx=idx,
                 obs=self.frames[idx][..., None],
@@ -244,6 +277,7 @@ class SequenceReplay:
 
     def _sample_locked(self, batch_size: int, beta: float) -> SequenceSample:
         idx, prob = self.tree.sample_stratified(batch_size, self.rng)
+        self._record_sample_age(idx)
         prob = np.maximum(prob, 1e-12)
         weights = (self.filled * prob) ** (-beta)
         weights = (weights / weights.max()).astype(np.float32)

@@ -268,6 +268,15 @@ class MicroBatcher:
                 self.metrics.record_batch(
                     n, pick_bucket(self.buckets, n), depth_after
                 )
+                # queue-to-slot wait (pipeline lag attribution): how long
+                # this batch's requests sat queued before coalescing granted
+                # them a slot — guarded getattr so metrics stand-ins without
+                # the obs surface keep working
+                record_wait = getattr(self.metrics, "record_queue_wait", None)
+                if record_wait is not None:
+                    now = time.monotonic()
+                    record_wait(
+                        sum((now - f.t_enqueue) for f in batch) / n * 1e3)
         return batch
 
     def close(self) -> None:

@@ -57,10 +57,16 @@ class FleetRollout:
 
     def __init__(self, logger=None, obs_registry=None,
                  clock: Callable[[], float] = time.monotonic,
-                 compression: str = "off", base_interval: int = 10):
+                 compression: str = "off", base_interval: int = 10,
+                 tracer=None):
         self.logger = logger
         self.obs_registry = obs_registry
         self.clock = clock
+        # pipeline tracing (obs/pipeline_trace.py): per-engine publish ->
+        # adopt lag lands on the tracer's consumer windows (`lag` row /
+        # RunHealth propagation budget); sampled versions emit adopt spans
+        # under the cross-process "w<host>-<version>" trace id
+        self.tracer = tracer
         self.compression = compression
         self._codec = (DeltaEncoder(base_interval)
                        if compression == "int8_delta" else None)
@@ -128,6 +134,8 @@ class FleetRollout:
         if self.obs_registry is not None:
             self.obs_registry.gauge("rollout_target_version", "rollout").set(
                 self.target_version)
+        if self.tracer is not None:
+            self.tracer.note_publish(new_version)
         adopted, failed = self._fan_out(engines, params, new_version, packet)
         bytes_fp32 = tree_bytes(params)
         shipped = packet.nbytes() if packet is not None else bytes_fp32
@@ -146,11 +154,20 @@ class FleetRollout:
         adopted = failed = 0
         for engine in engines:
             try:
+                t0 = time.time()
                 if packet is not None and hasattr(engine, "adopt_packet"):
                     engine.adopt_packet(packet)
                 else:
                     engine.adopt(params, version)
                 adopted += 1
+                if self.tracer is not None:
+                    eid = int(getattr(engine, "engine_id", -1))
+                    self.tracer.note_adopt(f"engine{eid}", version)
+                    if self.tracer.sampled(version):
+                        self.tracer.emit_span(
+                            "adopt", self.tracer.trace_id("w", version), t0,
+                            version=version, consumer=f"engine{eid}",
+                        )
             except Exception:
                 # a failed adopt (dying engine, mid-kill race, or a
                 # delta-chain gap on an engine that missed packets) is not

@@ -123,7 +123,8 @@ class RoutedFuture(ServeFuture):
     serving the request — possibly not the one it was first dispatched to
     (dead-engine re-dispatch is invisible to the client beyond latency)."""
 
-    __slots__ = ("tenant", "qos", "engine_id", "tried", "_engine_cancel")
+    __slots__ = ("tenant", "qos", "engine_id", "tried", "_engine_cancel",
+                 "trace")
 
     def __init__(self, obs, tenant: str, qos: QoSClass):
         super().__init__(obs)
@@ -132,6 +133,9 @@ class RoutedFuture(ServeFuture):
         self.engine_id: Optional[int] = None
         self.tried: Set[int] = set()
         self._engine_cancel: Optional[Callable[[], bool]] = None
+        # pipeline tracing: (trace_id, wall_t0) when this request was
+        # sampled for span emission, else None
+        self.trace: Optional[tuple] = None
 
     def cancel(self) -> bool:
         # the cancel propagates DOWN to the engine-side future so the
@@ -176,8 +180,13 @@ class FrontRouter:
         poll_interval_s: float = 0.25,
         reroute_window_s: float = 2.0,
         clock: Callable[[], float] = time.monotonic,
+        tracer=None,
     ):
         self.registry = registry
+        # pipeline tracing (obs/pipeline_trace.py): always-on admit->dispatch
+        # lag (`lag_router_dispatch_ms`) + sampled per-request `route` spans
+        self.tracer = tracer
+        self._req_seq = 0
         self.classes = list(qos_classes) or [
             QoSClass("default", 1000.0, 1.0, 0)]
         self._by_name = {c.name: c for c in self.classes}
@@ -338,6 +347,14 @@ class FrontRouter:
                 continue
             rf.engine_id = h.engine_id
             rf.tried.add(h.engine_id)
+            if self.tracer is not None:
+                # admit -> engine dispatch: ~0 on the fast path, the queue
+                # wait of a parked re-route otherwise — the "router queue"
+                # half of the serving lag story (batcher slot wait is the
+                # other half, recorded by ServeMetrics.record_queue_wait)
+                self.tracer.lag(
+                    "router_dispatch_ms",
+                    (time.monotonic() - rf.t_enqueue) * 1e3)
             with self._lock:
                 self._inflight_engine[h.engine_id] = (
                     self._inflight_engine.get(h.engine_id, 0) + 1)
@@ -368,7 +385,11 @@ class FrontRouter:
             # reserve BEFORE dispatch: concurrent submits must see the slot
             self._inflight_total += 1
             self._inflight_class[klass.name] += 1
+            rid = self._req_seq
+            self._req_seq += 1
         rf = RoutedFuture(obs, tenant, klass)
+        if self.tracer is not None and self.tracer.sampled(rid):
+            rf.trace = (self.tracer.trace_id("r", rid), time.time())
         if not self._dispatch(rf):
             with self._lock:
                 self._inflight_total -= 1
@@ -416,6 +437,12 @@ class FrontRouter:
                 self.totals["completed"] += 1
                 self._latency_ms.append(
                     (time.monotonic() - rf.t_enqueue) * 1e3)
+            if self.tracer is not None and rf.trace is not None:
+                tid, t0 = rf.trace
+                self.tracer.emit_span(
+                    "route", tid, t0, tenant=rf.tenant, qos=rf.qos.name,
+                    engine=rf.engine_id,
+                )
             return
         if isinstance(err, (ServerClosed, EngineDead)):
             # the engine died with this ACCEPTED request queued: re-route to

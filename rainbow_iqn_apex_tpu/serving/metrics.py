@@ -52,6 +52,11 @@ class ServeMetrics:
         self._c_padded = self.registry.counter("serve_padded_rows_total", "serve")
         self._g_queue = self.registry.gauge("serve_queue_depth", "serve")
         self._h_latency = self.registry.histogram("serve_latency_ms", "serve")
+        # pipeline lag attribution (obs/pipeline_trace.py naming): how long
+        # requests sat queued before the batcher granted them a batch slot —
+        # the serving path's analogue of the learner's sample-age lag
+        self._h_slot_wait = self.registry.histogram(
+            "lag_batch_slot_wait_ms", "serve")
         self._lock = threading.Lock()
         self._lat_ms: collections.deque = collections.deque(maxlen=latency_window)
         self._reset_window()
@@ -83,6 +88,11 @@ class ServeMetrics:
         self._c_batches.inc()
         self._c_padded.inc(padded)
         self._g_queue.set(queue_depth)
+
+    def record_queue_wait(self, wait_ms: float) -> None:
+        """Mean queued-request wait of one coalesced batch (submit -> batch
+        slot), recorded by MicroBatcher.take."""
+        self._h_slot_wait.observe(wait_ms)
 
     def record_latency_ms(self, latency_ms: float) -> None:
         with self._lock:

@@ -33,6 +33,7 @@ from __future__ import annotations
 
 import collections
 import dataclasses
+import time
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
 import numpy as np
@@ -74,10 +75,15 @@ class WritebackRing:
         role: str = "learner",
         priorities_to_host: Optional[Callable[[Any], np.ndarray]] = None,
         materialize_priorities: bool = True,
+        tracer=None,
     ):
         self.depth = max(int(depth), 0)
         self._q: collections.deque = collections.deque()
         self._to_host = priorities_to_host
+        # pipeline tracing (obs/pipeline_trace.py): dispatch->retire wall lag
+        # is recorded always-on (`lag_ring_retire_ms`); sampled steps emit a
+        # `ring_retire` span under the learn step's own trace id
+        self._tracer = tracer
         # False when the write-back target consumes DEVICE arrays (the HBM
         # priority mirror): retirement then syncs only the finite flag +
         # scalars, and the |TD| vector never crosses to host in the hot path
@@ -102,7 +108,7 @@ class WritebackRing:
     ) -> Optional[RetiredStep]:
         """Enqueue a dispatched step; returns the retired oldest entry when
         the ring was already holding ``depth`` steps (None otherwise)."""
-        self._q.append((int(step), idx, info))
+        self._q.append((int(step), idx, info, time.time()))
         self._last_pushed = int(step)
         retired = self.retire_one() if len(self._q) > self.depth else None
         if self._g_depth is not None:
@@ -111,7 +117,8 @@ class WritebackRing:
 
     def retire_one(self) -> RetiredStep:
         """Materialize and pop the OLDEST in-flight step (sanctioned sync)."""
-        step, idx, info = self._q.popleft()
+        step, idx, info, t_push = self._q.popleft()
+        t_retire = time.time()
         with hostsync.sanctioned():
             finite = bool(info["finite"]) if "finite" in info else True
             pri = info["priorities"]
@@ -130,6 +137,18 @@ class WritebackRing:
         if self._g_depth is not None:
             self._g_depth.set(len(self._q))
             self._g_lag.set(lag)
+        if self._tracer is not None:
+            # the LAG metric is dispatch->retire wall time (how stale the
+            # priorities are when they land — the quantity Ape-X bounds);
+            # the SPAN is only the retirement WORK (sync + materialize) —
+            # the in-flight wait is deliberate pipelining, and billing it to
+            # this stage would misattribute the critical path to the ring
+            self._tracer.lag("ring_retire_ms", (time.time() - t_push) * 1e3)
+            if self._tracer.sampled(step):
+                self._tracer.emit_span(
+                    "ring_retire", self._tracer.trace_id("l", step),
+                    t_retire, step=step, lag_steps=lag,
+                )
         return RetiredStep(
             step=step, idx=idx, priorities=pri, finite=finite,
             scalars=scalars, lag=lag,
@@ -146,7 +165,7 @@ class WritebackRing:
         """Drop every in-flight entry WITHOUT materializing its device info
         (it may be poisoned); returns ``[(step, idx), ...]`` oldest-first for
         quarantine write-back."""
-        out = [(step, idx) for step, idx, _ in self._q]
+        out = [(step, idx) for step, idx, _, _ in self._q]
         self._q.clear()
         if self._g_depth is not None:
             self._g_depth.set(0)

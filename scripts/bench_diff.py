@@ -1,0 +1,187 @@
+#!/usr/bin/env python
+"""bench_diff: bench-trajectory regression gate.
+
+    python scripts/bench_diff.py <current_rows.jsonl> [--baseline BENCH_rN.json]
+                                 [--threshold 0.2]
+
+Compares the current `bench.py` rows against the last committed
+``BENCH_r*.json`` (the per-round bench record; files stopped accruing after
+r05, r09 restarts the series) and exits nonzero when any GATED metric
+regressed by more than ``--threshold`` (default 20%).
+
+What is gated: the machine-portable RATIO metrics, not raw rates — the
+sandbox's host_feed steps/s has historically swung 0.17-0.36 across rounds
+on scheduler noise alone (ROADMAP), so gating absolute rates would fail on
+weather.  The ratios are each row's own A/B on the same box in the same
+minute:
+
+  apex_loop.speedup_vs_depth0       pipelined ring vs per-step-sync loop
+  sample_path.speedup_vs_host       device frontier vs host sum-tree
+  weight_publish.ratio_vs_fp32      int8-delta bytes vs fp32 full
+  trace_overhead (inverted)         traced/untraced — gated ABSOLUTE <= cap
+                                    in `make trace-smoke`, reported here
+
+Raw rates are printed for the record but only WARN.  A row absent from the
+baseline (older baselines predate newer rows) is skipped with a note — the
+diff gates trajectory, it does not require history to be rewritten.  Rows
+carrying ``"status": "timeout"/"error"`` on either side are skipped too: a
+budget overrun is a scheduling finding, not a perf regression.
+
+Exit codes: 0 = no gated regression; 1 = regression; 2 = usage/IO error.
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import re
+import sys
+from typing import Any, Dict, List, Optional
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+# path -> (metric key, larger-is-better) gated at the regression threshold
+GATED = {
+    "apex_loop": "speedup_vs_depth0",
+    "sample_path": "speedup_vs_host",
+    "weight_publish": "ratio_vs_fp32",
+}
+# path -> metric reported (warn-only): raw rates, machine-weather-dependent
+REPORTED = {
+    "host_feed": "value",
+    "apex_loop": "value",
+    "sample_path": "value",
+    "trace_overhead": "value",
+}
+
+
+def newest_baseline(repo: str = _REPO) -> Optional[str]:
+    """The highest-numbered BENCH_r*.json in the repo root."""
+    hits = []
+    for path in glob.glob(os.path.join(repo, "BENCH_r*.json")):
+        m = re.search(r"BENCH_r(\d+)\.json$", path)
+        if m:
+            hits.append((int(m.group(1)), path))
+    return max(hits)[1] if hits else None
+
+
+def rows_from_lines(lines) -> List[Dict[str, Any]]:
+    rows = []
+    for line in lines:
+        line = line.strip()
+        if not line:
+            continue
+        try:
+            row = json.loads(line)
+        except ValueError:
+            continue
+        if isinstance(row, dict) and "metric" in row:
+            rows.append(row)
+    return rows
+
+
+def load_current(path: str) -> List[Dict[str, Any]]:
+    with open(path) as fh:
+        return rows_from_lines(fh)
+
+
+def load_baseline(path: str) -> List[Dict[str, Any]]:
+    """A BENCH_rN.json records the round's stdout ``tail`` (every row line)
+    plus the headline as ``parsed`` — accept either, preferring the tail."""
+    with open(path) as fh:
+        doc = json.load(fh)
+    if isinstance(doc, list):  # permissive: a bare list of rows
+        return [r for r in doc if isinstance(r, dict) and "metric" in r]
+    rows = rows_from_lines(str(doc.get("tail", "")).splitlines())
+    parsed = doc.get("parsed")
+    if isinstance(parsed, dict) and "metric" in parsed and not any(
+            r.get("path") == parsed.get("path") for r in rows):
+        rows.append(parsed)
+    return rows
+
+
+def by_path(rows: List[Dict[str, Any]]) -> Dict[str, Dict[str, Any]]:
+    """Last usable row per ``path`` (a timed-out/errored row is not a
+    measurement and must not shadow an earlier good one)."""
+    out: Dict[str, Dict[str, Any]] = {}
+    for row in rows:
+        if row.get("status") in ("timeout", "error"):
+            continue
+        path = row.get("path")
+        if path:
+            out[str(path)] = row
+    return out
+
+
+def diff(current: List[Dict[str, Any]], baseline: List[Dict[str, Any]],
+         threshold: float) -> "tuple[list, list]":
+    """Returns (failures, report_lines)."""
+    cur, base = by_path(current), by_path(baseline)
+    failures: List[str] = []
+    lines: List[str] = []
+    for path, key in GATED.items():
+        c, b = cur.get(path), base.get(path)
+        if c is None:
+            lines.append(f"GATE  {path}.{key}: no current row (skipped)")
+            continue
+        if b is None or b.get(key) is None:
+            lines.append(f"GATE  {path}.{key}: not in baseline (skipped)")
+            continue
+        cv, bv = float(c.get(key) or 0.0), float(b[key])
+        floor = bv * (1.0 - threshold)
+        verdict = "ok" if cv >= floor else "REGRESSED"
+        lines.append(f"GATE  {path}.{key}: {cv:.3f} vs baseline {bv:.3f} "
+                     f"(floor {floor:.3f}) {verdict}")
+        if cv < floor:
+            failures.append(f"{path}.{key} {cv:.3f} < {floor:.3f} "
+                            f"(baseline {bv:.3f} - {threshold:.0%})")
+    for path, key in REPORTED.items():
+        c, b = cur.get(path), base.get(path)
+        if c is None or b is None or b.get(key) is None:
+            continue
+        lines.append(f"INFO  {path}.{key}: {c.get(key)} vs baseline "
+                     f"{b.get(key)} (not gated)")
+    return failures, lines
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("current", help="jsonl of bench.py rows (e.g. the "
+                                    "perf-smoke tee output)")
+    ap.add_argument("--baseline", default=None,
+                    help="BENCH_rN.json to diff against "
+                         "(default: newest in repo root)")
+    ap.add_argument("--threshold", type=float, default=0.2,
+                    help="max tolerated fractional regression (default 0.2)")
+    args = ap.parse_args(argv)
+
+    baseline_path = args.baseline or newest_baseline()
+    if baseline_path is None:
+        print("bench_diff: no BENCH_r*.json baseline found", file=sys.stderr)
+        return 2
+    try:
+        current = load_current(args.current)
+        baseline = load_baseline(baseline_path)
+    except (OSError, ValueError) as e:
+        print(f"bench_diff: {e}", file=sys.stderr)
+        return 2
+    if not current:
+        print(f"bench_diff: no bench rows in {args.current}", file=sys.stderr)
+        return 2
+    failures, lines = diff(current, baseline, args.threshold)
+    print(f"bench_diff: {args.current} vs {os.path.basename(baseline_path)} "
+          f"(threshold {args.threshold:.0%})")
+    for line in lines:
+        print(f"  {line}")
+    if failures:
+        for f in failures:
+            print(f"bench_diff: REGRESSION {f}", file=sys.stderr)
+        return 1
+    print("bench_diff: no gated regression")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -224,7 +224,11 @@ def soak_main(args) -> int:
     )
     memory.attach_registry(registry)
     ingest = SpoolIngestor(spool_dir, memory)
-    mailbox = WeightMailbox(os.path.join(run_dir, "weights.json"))
+    # host= stamps pub_host into every row: subscribers rebuild the
+    # publisher's "w<host>-<version>" trace id from it, so a non-zero-host
+    # controller must pass its own id or cross-process publish->adopt flow
+    # arrows never join (this soak's controller IS host 0)
+    mailbox = WeightMailbox(os.path.join(run_dir, "weights.json"), host=0)
     monitor = HeartbeatMonitor(hb_dir, args.hb_timeout, self_id=0)
 
     # the first readmission attempt fails (shard_rejoin point) so the

@@ -17,7 +17,9 @@ Exit codes: 0 = clean, 1 = any error (each printed as ``path:line: why``).
 
 The validated kind set includes the elasticity rows (``host_alive``,
 ``shard_readmit``, ``actor_fenced`` — obs/schema.py REQUIRED_KEYS), so a
-chaos-soak run dir lints as strictly as a training run dir.
+chaos-soak run dir lints as strictly as a training run dir, and the
+pipeline-tracing rows (``span_link``/``lag`` — obs/pipeline_trace.py), so a
+traced run dir lints before trace_export/obs_report consume it.
 """
 
 from __future__ import annotations

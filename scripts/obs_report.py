@@ -35,6 +35,10 @@ from typing import Any, Dict, List, Tuple
 _REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, _REPO)
 
+from rainbow_iqn_apex_tpu.obs.pipeline_trace import (  # noqa: E402
+    critical_path,
+    format_critical_path,
+)
 from rainbow_iqn_apex_tpu.obs.schema import validate_row  # noqa: E402
 from scripts.lint_jsonl import lint_line  # noqa: E402
 
@@ -289,6 +293,16 @@ def aggregate(rows: List[Dict[str, Any]]) -> Dict[str, Any]:
             "mirror_reconcile_s": _last_with(rows, "health", "mirror_reconcile_s")
             .get("mirror_reconcile_s"),
         },
+        # critical-path attribution (obs/pipeline_trace.py): which stage
+        # owns the largest exclusive share of traced end-to-end latency —
+        # sampler-starved vs device-bound vs publish-bound in one line.
+        # None when the run was not traced (trace_sample_every = 0).
+        "critical_path": critical_path(rows),
+        # lag attribution: the newest `lag` row's percentiles (sample age at
+        # learn time, ring retirement, publish->adopt per consumer)
+        "lag": {k: v for k, v in _last(rows, "lag").items()
+                if k not in ("t", "ts", "host", "run", "kind", "schema",
+                             "step")},
         # serving fleet (docs/SERVING.md "fleet"): per-tenant accept/shed,
         # per-engine depth/version spread, scale events, rollout convergence
         "fleet": _fleet_section(by_kind),
@@ -306,6 +320,9 @@ def aggregate(rows: List[Dict[str, Any]]) -> Dict[str, Any]:
             "rows": len(health),
             "hosts_dead": last_health.get("hosts_dead", []),
             "hosts_evicted": last_health.get("hosts_evicted", []),
+            # consumers whose publish->adopt p99 breached the propagation
+            # budget in the newest window (obs/pipeline_trace.py)
+            "lag_consumers": last_health.get("lag_consumers", []),
         },
     }
     return report
@@ -351,6 +368,31 @@ def render(report: Dict[str, Any]) -> str:
                 f"mirror_reconcile_s={p['mirror_reconcile_s']}"
             )
         lines.append(line)
+    cp = report.get("critical_path")
+    if cp:
+        lines.append(f"critical_path: {format_critical_path(cp)}")
+        for stage, snap in sorted(cp["stages"].items(),
+                                  key=lambda kv: -kv[1]["share"]):
+            lines.append(f"  stage {stage}: {round(snap['share'] * 100)}% "
+                         f"({snap['ms']}ms exclusive)")
+    lag = report.get("lag") or {}
+    if lag:
+        parts = []
+        for key in ("sample_age_s", "sample_age_ticks", "ring_retire_ms",
+                    "router_dispatch_ms", "batch_slot_wait_ms"):
+            if key in lag:
+                parts.append(f"{key} p50={lag[key].get('p50')} "
+                             f"p99={lag[key].get('p99')}")
+        if parts:
+            lines.append("lag:     " + "  ".join(parts))
+        for consumer, snap in sorted(
+                (lag.get("publish_adopt_ms_by_consumer") or {}).items()):
+            lines.append(f"  publish->adopt {consumer}: "
+                         f"p50={snap.get('p50')}ms p99={snap.get('p99')}ms")
+        if lag.get("publish_adopt_budget_ms") is not None:
+            lines.append(f"  publish->adopt budget: "
+                         f"{lag['publish_adopt_budget_ms']}ms "
+                         "(max_weight_lag x publish cadence)")
     f = report["fleet"]
     if f["accepted"] or f["shed"] or f["rollouts"] or f["engines"]:
         lines.append(
@@ -392,6 +434,8 @@ def render(report: Dict[str, Any]) -> str:
         f"health: last={h['last_status']} worst={h['worst_status']} "
         f"rows={h['rows']} hosts_dead={h['hosts_dead']} "
         f"hosts_evicted={h['hosts_evicted']}"
+        + (f" lag_consumers={h['lag_consumers']}"
+           if h.get("lag_consumers") else "")
     )
     return "\n".join(lines)
 

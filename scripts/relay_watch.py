@@ -221,6 +221,12 @@ def health_attribution(metrics_glob) -> dict:
     # falling back to fp32 is a different finding (accuracy gate refusing)
     # than one that quantized cleanly — the tally carries it into phase_done
     quant = {"quant": 0, "quant_fallback": 0, "publish": 0}
+    # pipeline-tracing rows (docs/OBSERVABILITY.md "tracing"): span_link/lag
+    # volume says whether a phase was traced at all, and the span rows feed
+    # the one-line critical_path echo below — a soak postmortem reads WHICH
+    # stage bounded the phase straight off its phase_done row
+    trace = {"span_link": 0, "lag": 0}
+    span_rows = []
     last = None
     for path in sorted(_glob.glob(metrics_glob)):
         try:
@@ -242,6 +248,14 @@ def health_attribution(metrics_glob) -> dict:
                         fleet[kind] += 1
                     elif kind in quant:
                         quant[kind] += 1
+                    elif kind in trace:
+                        trace[kind] += 1
+                        # bounded retention: the echo needs stage shares,
+                        # not every span of a long traced soak; the tally
+                        # above still counts the dropped tail (no silent cap
+                        # — trace["span_link"] > len(span_rows) says so)
+                        if kind == "span_link" and len(span_rows) < 50_000:
+                            span_rows.append(row)
         except OSError:
             continue
     order = {"ok": 0, "degraded": 1, "failing": 2}
@@ -249,7 +263,24 @@ def health_attribution(metrics_glob) -> dict:
                 key=lambda s: order[s], default=None)
     return {"rows": sum(counts.values()), "counts": counts,
             "last": last, "worst": worst, "heals": heals, "fleet": fleet,
-            "quant": quant}
+            "quant": quant, "trace": trace,
+            "critical_path": _critical_path_echo(span_rows)}
+
+
+def _critical_path_echo(span_rows):
+    """One-line stage attribution from a phase's span_link rows (the shared
+    obs/pipeline_trace analyzer; None when the phase was untraced or the
+    repo module is unimportable in a stripped-down checkout)."""
+    if not span_rows:
+        return None
+    try:
+        sys.path.insert(0, REPO)
+        from rainbow_iqn_apex_tpu.obs.pipeline_trace import (
+            critical_path, format_critical_path,
+        )
+    except Exception:
+        return None
+    return format_critical_path(critical_path(span_rows))
 
 
 def classify_phase(rc: int, tail: str) -> str:
@@ -315,6 +346,11 @@ def run_phase(name: str, argv, out_name: str, extra_env=None,
     health = health_attribution(health_glob) if health_glob else None
     log_event(event="phase_done", phase=name, rc=p.returncode,
               elapsed_s=round(dt, 1), cause=cause, health=health)
+    if health and health.get("critical_path"):
+        # one-line stage attribution next to the phase outcome: "where did
+        # this phase's wall time go" without re-griping the run dirs
+        log_event(event="critical_path", phase=name,
+                  verdict=health["critical_path"])
     git_commit([out_path, err_path, LOG],
                f"relay_watch: {name} captured on live TPU window "
                f"(rc={p.returncode}, {dt:.0f}s, cause={cause})")

@@ -1,0 +1,202 @@
+#!/usr/bin/env python
+"""trace_export: pipeline `span_link` rows -> Perfetto/Chrome trace JSON.
+
+    python scripts/trace_export.py <run_dir | metrics.jsonl> [-o trace.json]
+                                   [--check]
+
+Reads every *.jsonl under the run dir, collects the causal spans the
+pipeline tracer emitted (obs/pipeline_trace.py; `span_link` rows, sampled
+1-in-N by `trace_sample_every`), and writes Chrome `trace_event` JSON that
+loads directly in https://ui.perfetto.dev or chrome://tracing:
+
+  * one PROCESS track per emitting host (pid = host, named "host<N>"), one
+    THREAD track per role on that host (tid per role) — so a multi-host run
+    reads as parallel swimlanes;
+  * one complete ("X") event per span, carrying trace_id/step/version args;
+  * FLOW events ("s"/"f" pairs keyed by trace_id) connecting the spans of
+    one unit of work ACROSS hosts and roles — env-step -> learn -> publish
+    -> adopt arrows are what make the lag story visual.  A span's `links`
+    list joins it to the traces it consumed (a learn step's sampled append
+    ticks), so fan-in flows render too.
+
+`--check` additionally validates the emitted JSON against the trace_event
+requirements (every event has ph/ts/pid/tid; X events carry dur; every flow
+"s" has a matching "f") — `make trace-smoke` gates on it.
+
+Exit codes: 0 = trace written (and check passed); 1 = no span_link rows
+found (run was not traced: set --trace-sample-every > 0); 2 = check failed.
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import sys
+from typing import Any, Dict, List
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO)
+
+
+def find_jsonl(path: str) -> List[str]:
+    if os.path.isfile(path):
+        return [path]
+    return sorted(glob.glob(os.path.join(path, "**", "*.jsonl"),
+                            recursive=True))
+
+
+def load_spans(paths: List[str]) -> List[Dict[str, Any]]:
+    spans = []
+    for path in paths:
+        with open(path) as fh:
+            for line in fh:
+                if not line.strip():
+                    continue
+                try:
+                    row = json.loads(line)
+                except ValueError:
+                    continue  # lint_jsonl's job
+                if isinstance(row, dict) and row.get("kind") == "span_link":
+                    spans.append(row)
+    return spans
+
+
+def build_trace(spans: List[Dict[str, Any]]) -> Dict[str, Any]:
+    """span_link rows -> {"traceEvents": [...]} (Chrome trace_event JSON)."""
+    events: List[Dict[str, Any]] = []
+    # stable (host, role) -> (pid, tid) mapping + metadata naming events
+    hosts = sorted({int(s.get("host", 0)) for s in spans})
+    roles_by_host: Dict[int, List[str]] = {}
+    for s in spans:
+        h = int(s.get("host", 0))
+        r = str(s.get("role", ""))
+        roles_by_host.setdefault(h, [])
+        if r not in roles_by_host[h]:
+            roles_by_host[h].append(r)
+    tid_of: Dict[tuple, int] = {}
+    for h in hosts:
+        events.append({"ph": "M", "name": "process_name", "pid": h, "tid": 0,
+                       "args": {"name": f"host{h}"}})
+        for i, r in enumerate(sorted(roles_by_host[h]), start=1):
+            tid_of[(h, r)] = i
+            events.append({"ph": "M", "name": "thread_name", "pid": h,
+                           "tid": i, "args": {"name": r or "main"}})
+
+    # complete events; remember each trace_id's spans for the flow pass.
+    # Perfetto wants monotone-ish ts in µs; t0 is wall epoch seconds.
+    by_trace: Dict[str, List[Dict[str, Any]]] = {}
+    for s in spans:
+        h = int(s.get("host", 0))
+        tid = tid_of[(h, str(s.get("role", "")))]
+        ts_us = float(s.get("t0", 0.0)) * 1e6
+        dur_us = max(float(s.get("dur_ms", 0.0)) * 1e3, 1.0)
+        args = {k: s[k] for k in ("trace_id", "step", "version", "consumer",
+                                  "tenant", "engine", "lag_steps", "links")
+                if k in s}
+        events.append({
+            "name": str(s.get("stage", "span")),
+            "cat": "pipeline",
+            "ph": "X",
+            "ts": round(ts_us, 3),
+            "dur": round(dur_us, 3),
+            "pid": h,
+            "tid": tid,
+            "args": args,
+        })
+        rec = {"host": h, "tid": tid, "ts": ts_us, "end": ts_us + dur_us,
+               "stage": s.get("stage")}
+        by_trace.setdefault(str(s.get("trace_id")), []).append(rec)
+        # fan-in links: this span also participates in the traces it consumed
+        for linked in s.get("links") or ():
+            by_trace.setdefault(str(linked), []).append(rec)
+
+    # flow arrows: for each trace id, consecutive spans in time order get an
+    # s -> f pair; the id ties arrows of one logical unit together even when
+    # its spans were emitted by different hosts/processes
+    flow_seq = 0
+    for trace_id, recs in sorted(by_trace.items()):
+        if len(recs) < 2:
+            continue
+        recs.sort(key=lambda r: r["ts"])
+        for a, b in zip(recs, recs[1:]):
+            flow_seq += 1
+            fid = f"{trace_id}.{flow_seq}"
+            events.append({"name": "flow", "cat": "pipeline", "ph": "s",
+                           "id": fid, "ts": round(a["end"], 3),
+                           "pid": a["host"], "tid": a["tid"]})
+            events.append({"name": "flow", "cat": "pipeline", "ph": "f",
+                           "bp": "e", "id": fid,
+                           "ts": round(max(b["ts"], a["end"]), 3),
+                           "pid": b["host"], "tid": b["tid"]})
+    return {"traceEvents": events, "displayTimeUnit": "ms"}
+
+
+def check_trace(trace: Dict[str, Any]) -> List[str]:
+    """Schema errors in the emitted trace_event JSON ([] = valid)."""
+    errors = []
+    events = trace.get("traceEvents")
+    if not isinstance(events, list) or not events:
+        return ["traceEvents missing or empty"]
+    open_flows: Dict[str, int] = {}
+    for i, ev in enumerate(events):
+        for key in ("ph", "pid", "tid"):
+            if key not in ev:
+                errors.append(f"event {i}: missing {key!r}")
+        if ev.get("ph") != "M" and "ts" not in ev:
+            errors.append(f"event {i}: missing ts")
+        if ev.get("ph") == "X" and not isinstance(ev.get("dur"), (int, float)):
+            errors.append(f"event {i}: X event without dur")
+        if ev.get("ph") == "s":
+            open_flows[ev.get("id")] = open_flows.get(ev.get("id"), 0) + 1
+        if ev.get("ph") == "f":
+            if open_flows.get(ev.get("id"), 0) <= 0:
+                errors.append(f"event {i}: flow f without matching s")
+            else:
+                open_flows[ev.get("id")] -= 1
+    for fid, n in open_flows.items():
+        if n:
+            errors.append(f"flow {fid!r}: s without matching f")
+    try:
+        json.dumps(trace, allow_nan=False)
+    except ValueError as e:
+        errors.append(f"not strict JSON: {e}")
+    return errors
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("path", help="run dir (or one .jsonl file)")
+    ap.add_argument("-o", "--out", default="trace.json",
+                    help="output trace file (default trace.json)")
+    ap.add_argument("--check", action="store_true",
+                    help="validate the emitted trace_event JSON")
+    args = ap.parse_args(argv)
+
+    paths = find_jsonl(args.path)
+    spans = load_spans(paths)
+    if not spans:
+        print(f"trace_export: no span_link rows under {args.path} "
+              "(run with --trace-sample-every N to enable span emission)",
+              file=sys.stderr)
+        return 1
+    trace = build_trace(spans)
+    with open(args.out, "w") as fh:
+        json.dump(trace, fh)
+    n_flows = sum(1 for e in trace["traceEvents"] if e.get("ph") == "s")
+    hosts = {e["pid"] for e in trace["traceEvents"]}
+    print(f"trace_export: {len(spans)} spans, {n_flows} flows, "
+          f"{len(hosts)} host track(s) -> {args.out}")
+    if args.check:
+        errors = check_trace(trace)
+        if errors:
+            for err in errors[:20]:
+                print(f"CHECK {err}", file=sys.stderr)
+            return 2
+        print("trace_export: trace_event schema check ok")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

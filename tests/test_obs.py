@@ -500,6 +500,33 @@ def test_elastic_row_kinds_schema_and_lint(tmp_path):
                          "ts": 1.0, "host": 0, "run": "r", "lag": 1}) != []
 
 
+def test_trace_row_kinds_schema_and_lint(tmp_path):
+    """The pipeline-tracing kinds (span_link / lag, ISSUE 9) validate with
+    their required keys, reject rows missing them, and pass the strict-JSON
+    linter — the golden-schema contract extended to the tracing surface."""
+    path = str(tmp_path / "trace.jsonl")
+    logger = MetricsLogger(path, "run0", echo=False, host=0)
+    logger.log("span_link", stage="learn_step", trace_id="l0-8", span_id=3,
+               parent_id=0, t0=1234.5, dur_ms=12.25, role="learner",
+               links=["a0-4"], step=8)
+    logger.log("lag", step=8,
+               sample_age_s={"count": 4, "p50": 1.2, "p99": 3.0, "max": 3.1},
+               publish_adopt_ms_by_consumer={
+                   "actor_inproc": {"count": 2, "p50": 1.0, "p99": 2.0,
+                                    "max": 2.0}},
+               publish_adopt_budget_ms=500.0)
+    logger.close()
+    assert lint_file(path) == []
+    for line in open(path):
+        assert validate_row(json.loads(line)) == []
+    # required keys are enforced, not decorative
+    assert validate_row({"kind": "span_link", "schema": SCHEMA_VERSION,
+                         "ts": 1.0, "host": 0, "run": "r",
+                         "stage": "act"}) != []
+    assert validate_row({"kind": "lag", "schema": SCHEMA_VERSION,
+                         "ts": 1.0, "host": 0, "run": "r"}) != []
+
+
 def test_health_heals_on_host_alive_and_eviction():
     """The heal edges close the degradation they opened: host_alive removes
     the host from the dead set, and a permanent eviction stops holding the
