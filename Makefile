@@ -2,7 +2,7 @@
 
 PY ?= python
 
-.PHONY: test test-fast serve-smoke serve-bench chaos-smoke obs-smoke soak-smoke perf-smoke fleet-smoke quant-smoke trace-smoke
+.PHONY: test test-fast serve-smoke serve-bench chaos-smoke obs-smoke soak-smoke perf-smoke fleet-smoke quant-smoke trace-smoke multitask-smoke
 
 # tier-1: fast unit + integration tests on the virtual 8-device CPU mesh
 test-fast:
@@ -137,6 +137,47 @@ quant-smoke:
 	JAX_PLATFORMS=cpu $(PY) scripts/bench_serve.py --quant \
 	  --clients 16 --requests 300 --out /tmp/ria_quant_smoke
 	$(PY) scripts/lint_jsonl.py /tmp/ria_quant_smoke
+
+# multitask smoke (docs/MULTITASK.md): the `multitask`-marked tests, then a
+# seeded 2-game toy apex run that must (1) lint as strict schema-versioned
+# JSONL (games/eval_mt rows included), (2) drive obs_report to a `games:`
+# per-game section, (3) contain a per-game eval row for BOTH games, and
+# (4) record the 2-game-vs-1-game learn-throughput tax as one bench row
+multitask-smoke:
+	JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_multitask.py -q -m multitask
+	rm -rf /tmp/ria_mt_smoke
+	JAX_PLATFORMS=cpu $(PY) train_agent_apex.py --role apex \
+	  --games toy:catch,toy:chain --compute-dtype float32 \
+	  --history-length 2 --hidden-size 64 --num-cosines 16 \
+	  --num-tau-samples 4 --num-tau-prime-samples 4 \
+	  --num-quantile-samples 4 --batch-size 16 --learning-rate 1e-3 \
+	  --multi-step 3 --gamma 0.9 --memory-capacity 4096 --learn-start 512 \
+	  --replay-ratio 2 --target-update-period 200 --num-envs-per-actor 8 \
+	  --metrics-interval 100 --eval-interval 200 --checkpoint-interval 0 \
+	  --eval-episodes 2 --t-max 3072 --run-id mt_smoke \
+	  --results-dir /tmp/ria_mt_smoke/results \
+	  --checkpoint-dir /tmp/ria_mt_smoke/ckpt
+	$(PY) scripts/lint_jsonl.py /tmp/ria_mt_smoke/results/mt_smoke
+	$(PY) scripts/obs_report.py /tmp/ria_mt_smoke/results/mt_smoke \
+	  | tee /tmp/ria_mt_smoke/report.txt
+	grep -q "games:" /tmp/ria_mt_smoke/report.txt
+	$(PY) -c "import json; rows = [json.loads(l) for l in \
+	  open('/tmp/ria_mt_smoke/results/mt_smoke/metrics.jsonl')]; \
+	  games = {r.get('game') for r in rows if r.get('kind') == 'eval'}; \
+	  assert games == {'toy:catch', 'toy:chain'}, games; \
+	  mt = [r for r in rows if r.get('kind') == 'eval_mt']; \
+	  assert mt and mt[-1].get('hn_median') is not None, 'no eval_mt row'; \
+	  print('multitask-smoke: per-game eval rows present for', \
+	        sorted(games), 'hn_median=%s' % mt[-1]['hn_median'])"
+	JAX_PLATFORMS=cpu BENCH_MULTITASK_ONLY=1 BENCH_WATCHDOG_SECS=240 \
+	  $(PY) bench.py | tee /tmp/ria_mt_smoke/bench.jsonl
+	$(PY) scripts/lint_jsonl.py /tmp/ria_mt_smoke/bench.jsonl
+	$(PY) -c "import json; rows = [json.loads(l) for l in \
+	  open('/tmp/ria_mt_smoke/bench.jsonl') if l.strip()]; \
+	  r = [x for x in rows if x.get('path') == 'multitask_throughput'][-1]; \
+	  assert r.get('status') is None, 'multitask_throughput row: %s' % r['status']; \
+	  print('multitask_throughput: %.2f steps/s vs single %.2f (ratio %.3f, report-only)' \
+	        % (r['value'], r['single_steps_per_sec'], r['ratio_vs_single']))"
 
 # obs smoke: a short anakin run must yield a lintable, reportable run dir —
 # obs_report prints per-role throughput / learn-step percentiles / health,

@@ -135,6 +135,15 @@ def measure() -> None:
         ):
             print(json.dumps(row), flush=True)
         return
+    # multitask mode (make multitask-smoke / BENCH_MULTITASK_ONLY=1): only
+    # the 2-game-vs-1-game learner-throughput row
+    if os.environ.get("BENCH_MULTITASK_ONLY") == "1":
+        for row in _run_row_budgeted(
+            "multitask_throughput", "multitask_learn_steps_per_sec",
+            _measure_multitask_throughput, left, share=0.9,
+        ):
+            print(json.dumps(row), flush=True)
+        return
     if os.environ.get("BENCH_APEX_ONLY") == "1":
         for row in _run_row_budgeted(
             "weight_publish", "weight_publish_bytes_per_publish",
@@ -157,6 +166,15 @@ def measure() -> None:
         ):
             print(json.dumps(row), flush=True)
         return
+    # multitask tax row (report-only via bench_diff: the trajectory records
+    # it, machine weather must not gate it): 2-game task-conditioned learn
+    # path vs the single-game one at the same toy net size
+    for row in _run_row_budgeted(
+        "multitask_throughput", "multitask_learn_steps_per_sec",
+        _measure_multitask_throughput, left, share=0.15,
+    ):
+        print(json.dumps(row), flush=True)
+
     cfg = Config()  # reference defaults: 84x84x4, N=N'=64, K=32, batch 32
     num_actions = 18  # SABER full action set
     batch_size = cfg.batch_size
@@ -572,6 +590,115 @@ def _measure_trace_overhead(left=None) -> list:
         "untraced_steps_per_sec": round(best_u, 2),
         "sample_every": sample_every,
         "reps": rep,
+    }]
+
+
+def _measure_multitask_throughput(left=None) -> list:
+    """multitask_throughput: the multi-game tax on the learn path.
+
+    Two arms at the SAME toy net size over the REAL sample->to_device->
+    learn-step path: (a) single-game — ShardedReplay + ops.learn; (b)
+    2-game — MultiGameReplay's interleaved sample + the task-conditioned
+    MultiGameIQN learn step (game-embedding torso, masked double-Q).  The
+    ratio records what running N games in one pod costs per learn step
+    (game embedding add + mask where + interleave bookkeeping — expected a
+    few percent).  Report-only in bench_diff: raw rates swing with machine
+    weather; the ratio is the trajectory record (docs/MULTITASK.md).
+    """
+    import jax
+    import numpy as np
+
+    from rainbow_iqn_apex_tpu.agents.agent import to_device_batch
+    from rainbow_iqn_apex_tpu.config import Config
+    from rainbow_iqn_apex_tpu.multitask.ops import (
+        build_mt_learn_step,
+        init_mt_train_state,
+    )
+    from rainbow_iqn_apex_tpu.multitask.replay import MultiGameReplay
+    from rainbow_iqn_apex_tpu.multitask.spec import MultiGameSpec
+    from rainbow_iqn_apex_tpu.ops.learn import build_learn_step, init_train_state
+    from rainbow_iqn_apex_tpu.parallel.sharded_replay import ShardedReplay
+
+    iters = int(os.environ.get("BENCH_MT_ITERS", "40"))
+    reps = int(os.environ.get("BENCH_MT_REPS", "2"))
+    lanes = int(os.environ.get("BENCH_MT_LANES", "8"))
+    prefill = int(os.environ.get("BENCH_MT_PREFILL", "192"))
+    spec = MultiGameSpec.probe(("toy:catch", "toy:chain"))
+    cfg = Config(
+        compute_dtype="float32", history_length=2, hidden_size=64,
+        num_cosines=16, num_tau_samples=8, num_tau_prime_samples=8,
+        num_quantile_samples=4, batch_size=32, multi_step=3, gamma=0.9,
+        use_native_sumtree=True,
+    )
+    rng = np.random.default_rng(0)
+    h, w = spec.frame_shape
+
+    def prefill_mem(mem):
+        for _ in range(prefill):
+            mem.append_batch(
+                rng.integers(0, 255, (lanes, h, w), np.uint8),
+                rng.integers(0, 2, lanes).astype(np.int32),
+                rng.normal(size=lanes).astype(np.float32),
+                rng.random(lanes) < 0.05,
+                np.abs(rng.normal(size=lanes)) + 0.1,
+            )
+        return mem
+
+    common = dict(history=cfg.history_length, n_step=cfg.multi_step,
+                  gamma=cfg.gamma, seed=3)
+    mem_single = prefill_mem(ShardedReplay.build(
+        2, 4096, lanes, frame_shape=spec.frame_shape, **common))
+    mem_mt = prefill_mem(MultiGameReplay.build_games(
+        spec, 1, 4096, lanes, schedule="uniform", **common))
+
+    state_single = init_train_state(
+        cfg, spec.max_actions, jax.random.PRNGKey(0),
+        state_shape=(h, w, cfg.history_length))
+    state_mt = init_mt_train_state(cfg, spec, jax.random.PRNGKey(0))
+    learn_single = jax.jit(
+        build_learn_step(cfg, spec.max_actions), donate_argnums=0)
+    learn_mt = jax.jit(build_mt_learn_step(cfg, spec), donate_argnums=0)
+    key = jax.random.PRNGKey(1)
+
+    def run(learn, state, mem, n: int) -> "tuple[float, Any]":
+        nonlocal key
+        info = None
+        t0 = time.monotonic()
+        for _ in range(n):
+            batch = to_device_batch(mem.sample(cfg.batch_size, 0.5))
+            key, k = jax.random.split(key)
+            state, info = learn(state, batch, k)
+        jax.block_until_ready(info["loss"])
+        return (time.monotonic() - t0, state)
+
+    # one warmup step per arm (compile), then alternating best-of reps so
+    # scheduler weather hits both arms evenly
+    _dt, state_single = run(learn_single, state_single, mem_single, 1)
+    _dt, state_mt = run(learn_mt, state_mt, mem_mt, 1)
+    best = {"single": float("inf"), "mt": float("inf")}
+    for _rep in range(reps):
+        if left is not None and left() <= 0:
+            break
+        dt, state_single = run(learn_single, state_single, mem_single, iters)
+        best["single"] = min(best["single"], dt)
+        dt, state_mt = run(learn_mt, state_mt, mem_mt, iters)
+        best["mt"] = min(best["mt"], dt)
+    if not all(np.isfinite(v) for v in best.values()):
+        return []
+    single_sps = iters / max(best["single"], 1e-9)
+    mt_sps = iters / max(best["mt"], 1e-9)
+    return [{
+        "metric": "multitask_learn_steps_per_sec",
+        "value": round(mt_sps, 3),
+        "unit": ("learn steps/s, 2-game task-conditioned (interleaved "
+                 "sample + MultiGameIQN) vs single-game at the same size"),
+        "vs_baseline": None,
+        "path": "multitask_throughput",
+        "games": spec.num_games,
+        "schedule": "uniform",
+        "batch_size": cfg.batch_size,
+        "single_steps_per_sec": round(single_sps, 3),
+        "ratio_vs_single": round(mt_sps / max(single_sps, 1e-9), 4),
     }]
 
 

@@ -50,6 +50,7 @@ def put_frames(x: np.ndarray) -> jnp.ndarray:
 
 def to_device_batch(sample: SampledBatch) -> Batch:
     """Host SampledBatch -> device Batch (async transfers via jnp.asarray)."""
+    game = getattr(sample, "game", None)
     return Batch(
         obs=put_frames(sample.obs),
         action=jnp.asarray(sample.action),
@@ -57,6 +58,7 @@ def to_device_batch(sample: SampledBatch) -> Batch:
         next_obs=put_frames(sample.next_obs),
         discount=jnp.asarray(sample.discount),
         weight=jnp.asarray(sample.weight),
+        game=None if game is None else jnp.asarray(game, jnp.int32),
     )
 
 

@@ -81,6 +81,20 @@ class Config:
 
     # ---- environment (SURVEY §2 row 2) -------------------------------------------
     env_id: str = "toy:catch"  # "toy:catch", "toy:chain", or "atari:<Game>"
+    # ---- multi-game Ape-X (multitask/; docs/MULTITASK.md) -------------------------
+    games: str = ""  # comma-separated env ids ("toy:catch,toy:chain" or
+    # "atari:Pong,atari:Breakout"): run N games concurrently in ONE apex pod —
+    # a task-conditioned learner (game-id embedding into the IQN torso, one
+    # jitted dispatch for every game), per-game actor lanes, per-game replay
+    # shard blocks behind a game-interleaved sample schedule, and per-game
+    # eval/obs rows.  "" (default) = single-game `env_id`, bitwise-identical
+    # to the pre-multitask path (tier-1 asserted).  Single-host only.
+    multitask_schedule: str = "uniform"  # per-game learner-batch quota:
+    # "uniform" (equal rows per alive game), "loss" (proportional to each
+    # game's EMA of retired |TD| — games the learner struggles on get more
+    # replay), "mass" (proportional to per-game priority mass — the single
+    # global-tree distribution, and the only schedule the device sample
+    # frontier composes with, since its HBM draw IS mass-proportional)
     history_length: int = 4  # frame-stack depth
     frame_height: int = 84
     frame_width: int = 84

@@ -1,0 +1,59 @@
+"""multitask/ — multi-game Ape-X on one pod (docs/MULTITASK.md).
+
+The original Ape-X scale claim (arXiv:1803.00933) was demonstrated across
+the Atari suite, and `eval.HUMAN_BASELINES` already carries the full
+Atari-57 random/human table — this subsystem runs N games concurrently in
+ONE apex pod instead of one game per run:
+
+  spec.py    MultiGameSpec: the parsed `Config.games` contract (per-game
+             action counts, the padded common frame shape, lane/shard maps)
+  lanes.py   per-game actor lanes: GameLaneEnv pads frames to the common
+             shape + maps out-of-range actions; build_game_lanes pins
+             contiguous lane blocks to games (the lane<->shard alignment
+             the replay relies on)
+  model.py   MultiGameIQN: RainbowIQN with a zero-initialized game-id
+             embedding added to the conv torso output — ONE jitted dispatch
+             for every game (shapes are game-invariant, XLA compiles once),
+             per-game action masks applied at greedy selection
+  ops.py     task-conditioned act/learn step builders (Batch.game threads
+             the game ids through the existing learn pipeline)
+  replay.py  MultiGameReplay: game-pinned ShardedReplay shard blocks behind
+             a game-interleaved sample schedule (uniform / loss / mass)
+  eval.py    vectorized multi-game eval: per-game scores + human-normalized
+             median/mean aggregates over the played suite
+  obs.py     the periodic `games` row (per-game learn share, replay
+             occupancy, latest eval, human-normalized aggregate)
+
+Everything importable from here lazily (PEP 562), and `MultiGameSpec`/
+`parse_games` are jax-free — respawned child processes and offline tools
+pay no device-runtime import tax.
+"""
+
+from __future__ import annotations
+
+import importlib
+
+_LAZY = {
+    "MultiGameSpec": "rainbow_iqn_apex_tpu.multitask.spec",
+    "parse_games": "rainbow_iqn_apex_tpu.multitask.spec",
+    "GameLaneEnv": "rainbow_iqn_apex_tpu.multitask.lanes",
+    "build_game_lanes": "rainbow_iqn_apex_tpu.multitask.lanes",
+    "MultiGameIQN": "rainbow_iqn_apex_tpu.multitask.model",
+    "build_mt_act_step": "rainbow_iqn_apex_tpu.multitask.ops",
+    "build_mt_learn_step": "rainbow_iqn_apex_tpu.multitask.ops",
+    "init_mt_train_state": "rainbow_iqn_apex_tpu.multitask.ops",
+    "InterleaveSchedule": "rainbow_iqn_apex_tpu.multitask.replay",
+    "MultiGameReplay": "rainbow_iqn_apex_tpu.multitask.replay",
+    "aggregate_human_normalized": "rainbow_iqn_apex_tpu.multitask.obs",
+    "evaluate_multigame": "rainbow_iqn_apex_tpu.multitask.eval",
+    "GamesObs": "rainbow_iqn_apex_tpu.multitask.obs",
+}
+
+__all__ = sorted(_LAZY)
+
+
+def __getattr__(name: str):
+    mod = _LAZY.get(name)
+    if mod is None:
+        raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
+    return getattr(importlib.import_module(mod), name)

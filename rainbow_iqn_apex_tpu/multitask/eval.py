@@ -1,0 +1,106 @@
+"""Vectorized multi-game evaluation under the SABER protocol.
+
+Per-game: E greedy episodes (noise off unless cfg.eval_noisy) on the
+game's OWN env behind the suite-common padded surface, same loop shape as
+`eval.evaluate`.  Suite: human-normalized median/mean aggregates — the
+Atari-57 reporting convention the `eval.HUMAN_BASELINES` table exists for
+(Rainbow paper appendix; median human-normalized score is the headline).
+
+The eval act executable is cached per (cfg, spec, noisy) like
+`eval._cached_eval_agent` — retraced on a config change, not per eval
+interval — and is ONE program for the whole suite (game id is data).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Dict, Optional
+
+import numpy as np
+
+from rainbow_iqn_apex_tpu.agents.agent import FrameStacker
+from rainbow_iqn_apex_tpu.config import Config
+from rainbow_iqn_apex_tpu.eval import human_normalized
+from rainbow_iqn_apex_tpu.multitask.lanes import GameLaneEnv
+from rainbow_iqn_apex_tpu.multitask.obs import aggregate_human_normalized
+from rainbow_iqn_apex_tpu.multitask.spec import MultiGameSpec
+
+__all__ = ["aggregate_human_normalized", "evaluate_multigame"]
+
+
+@functools.lru_cache(maxsize=4)
+def _cached_mt_act(cfg: Config, spec: MultiGameSpec, noisy: bool):
+    import jax
+
+    from rainbow_iqn_apex_tpu.multitask.ops import build_mt_act_step
+
+    return jax.jit(build_mt_act_step(cfg, spec, use_noise=noisy))
+
+
+def evaluate_multigame(
+    cfg: Config,
+    spec: MultiGameSpec,
+    params,
+    seed: int = 0,
+    episodes: Optional[int] = None,
+    max_steps_per_episode: int = 200_000,
+) -> Dict[str, Any]:
+    """Evaluate task-conditioned ``params`` on every game in the spec.
+
+    Returns {"games": {env_id: {episodes, score_mean, score_median,
+    score_min, score_max, human_normalized?}}, hn_median, hn_mean,
+    hn_games, score_mean (suite mean of per-game means)}.
+    """
+    import jax
+
+    from rainbow_iqn_apex_tpu.agents.agent import put_frames
+    from rainbow_iqn_apex_tpu.envs import make_env
+
+    episodes = episodes or cfg.eval_episodes
+    act = _cached_mt_act(cfg, spec, bool(cfg.eval_noisy))
+    # fresh key per eval: two evals of the same params draw identical
+    # taus/noise (bit-reproducible curves), matching eval.evaluate_state
+    key = jax.random.PRNGKey(cfg.seed + 1)
+    per_game: Dict[str, Dict[str, Any]] = {}
+    per_game_hn: Dict[str, Optional[float]] = {}
+    for g, name in enumerate(spec.games):
+        env = GameLaneEnv(make_env(name, seed=seed + g), spec, g)
+        game_ids = np.full(1, g, np.int32)
+        scores = []
+        for _ep in range(episodes):
+            stacker = FrameStacker(1, env.frame_shape, cfg.history_length)
+            frame = env.reset()
+            ep_ret = 0.0
+            for _ in range(max_steps_per_episode):
+                stacked = stacker.push(frame[None])
+                key, k = jax.random.split(key)
+                a, _q = act(params, put_frames(stacked), game_ids, k)
+                ts = env.step(int(np.asarray(a)[0]))
+                frame = ts.obs
+                ep_ret += ts.reward
+                if ts.terminal or ts.truncated:
+                    if ts.info and "episode_return" in ts.info:
+                        ep_ret = float(ts.info["episode_return"])
+                    break
+            scores.append(ep_ret)
+        env.close()
+        arr = np.asarray(scores, np.float64)
+        row: Dict[str, Any] = {
+            "episodes": episodes,
+            "score_mean": float(arr.mean()),
+            "score_median": float(np.median(arr)),
+            "score_min": float(arr.min()),
+            "score_max": float(arr.max()),
+        }
+        hn = human_normalized(name, row["score_mean"])
+        per_game_hn[name] = hn
+        if hn is not None:
+            row["human_normalized"] = hn
+        per_game[name] = row
+    out: Dict[str, Any] = {
+        "games": per_game,
+        "score_mean": float(np.mean(
+            [r["score_mean"] for r in per_game.values()])),
+        **aggregate_human_normalized(per_game_hn),
+    }
+    return out

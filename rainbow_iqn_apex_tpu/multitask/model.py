@@ -1,0 +1,149 @@
+"""MultiGameIQN: the task-conditioned flagship model.
+
+RainbowIQN with one addition — a per-game embedding table, zero-initialized
+and ADDED to the conv torso output phi(s) before the tau merge:
+
+    phi(s, g) = ConvTrunk(s) + E[g]          E in R^{G x F}, E_0 = 0
+
+Zero init makes the N=1 (and t=0) forward pass IDENTICAL to the
+single-game RainbowIQN given the same trunk/head params
+(tests/test_multitask.py parity test); training then learns per-game
+feature shifts.  Every other design choice is inherited: taus folded into
+the batch for one [B*N, F] GEMM, static tau counts, uint8 frames
+normalised on-chip.
+
+Shapes are game-INVARIANT — obs padded to the suite-common frame, the
+action dim padded to ``max_actions`` — so XLA compiles ONE executable per
+role for the whole suite (the "bucketed shapes" promise: the bucket is the
+suite).  Per-game action masks are applied at greedy selection
+(`masked_greedy_action`), never inside the quantile head, so Q estimates
+for real actions are untouched.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from flax import linen as nn
+
+from rainbow_iqn_apex_tpu.models.iqn import q_values
+from rainbow_iqn_apex_tpu.models.layers import (
+    ConvTrunk,
+    CosineTauEmbedding,
+    NoisyLinear,
+)
+
+Dtype = Any
+
+# large-negative (not -inf) mask fill: -inf would poison downstream
+# arithmetic (actor-side priority estimates take q.max over the row) with
+# NaNs on an all-masked row instead of degrading gracefully
+MASK_FILL = -1e9
+
+
+class MultiGameIQN(nn.Module):
+    """Task-conditioned dueling noisy-net IQN.
+
+    Call signature:
+        quantiles, taus = model.apply(params, obs, game, num_taus,
+                                      rngs={"taus": k1, "noise": k2})
+
+    obs:       [B, H, W, C] uint8 (suite-common padded frame)
+    game:      [B] int32 game ids in [0, num_games)
+    quantiles: [B, num_taus, max_actions] fp32
+    """
+
+    num_games: int
+    num_actions: int  # padded suite max
+    hidden_size: int = 512
+    num_cosines: int = 64
+    noisy_sigma0: float = 0.5
+    dueling: bool = True
+    use_noise: bool = True
+    compute_dtype: Dtype = jnp.bfloat16
+
+    @nn.compact
+    def __call__(
+        self,
+        obs: jnp.ndarray,
+        game: jnp.ndarray,
+        num_taus: int,
+        taus: Optional[jnp.ndarray] = None,
+    ) -> Tuple[jnp.ndarray, jnp.ndarray]:
+        batch = obs.shape[0]
+        if obs.dtype == jnp.uint8:
+            obs = obs.astype(self.compute_dtype) * (1.0 / 255.0)
+
+        phi = ConvTrunk(compute_dtype=self.compute_dtype)(obs)  # [B, F]
+        feat = phi.shape[-1]
+        # the game conditioning: a learned per-game feature shift, zero at
+        # init so the N=1 path reproduces the single-game network exactly
+        emb = nn.Embed(
+            self.num_games, feat,
+            embedding_init=nn.initializers.zeros,
+            param_dtype=jnp.float32,
+            name="game_embed",
+        )(game.astype(jnp.int32))
+        phi = phi + emb.astype(phi.dtype)
+
+        if taus is None:
+            taus = jax.random.uniform(
+                self.make_rng("taus"), (batch, num_taus), jnp.float32
+            )
+        psi = CosineTauEmbedding(
+            features=feat,
+            num_cosines=self.num_cosines,
+            compute_dtype=self.compute_dtype,
+        )(taus)  # [B, N, F]
+
+        h = phi[:, None, :].astype(self.compute_dtype) * psi
+        h = h.reshape(batch * num_taus, feat)
+
+        def head(name: str, out_dim: int) -> jnp.ndarray:
+            h1 = NoisyLinear(
+                self.hidden_size,
+                sigma0=self.noisy_sigma0,
+                use_noise=self.use_noise,
+                compute_dtype=self.compute_dtype,
+                name=f"{name}_hidden",
+            )(h)
+            h1 = nn.relu(h1)
+            return NoisyLinear(
+                out_dim,
+                sigma0=self.noisy_sigma0,
+                use_noise=self.use_noise,
+                compute_dtype=self.compute_dtype,
+                name=f"{name}_out",
+            )(h1)
+
+        if self.dueling:
+            value = head("value", 1)  # [B*N, 1]
+            adv = head("advantage", self.num_actions)  # [B*N, A]
+            q = value + adv - adv.mean(axis=-1, keepdims=True)
+        else:
+            q = head("q", self.num_actions)
+
+        quantiles = q.reshape(
+            batch, num_taus, self.num_actions
+        ).astype(jnp.float32)
+        return quantiles, taus
+
+
+def masked_q_values(
+    quantiles: jnp.ndarray, game: jnp.ndarray, mask_table: jnp.ndarray
+) -> jnp.ndarray:
+    """[B, N, A] -> [B, A] expected Q with each row's out-of-game action
+    slots dropped to MASK_FILL (mask_table: [G, A] bool)."""
+    q = q_values(quantiles)
+    return jnp.where(mask_table[game], q, MASK_FILL)
+
+
+def masked_greedy_action(
+    quantiles: jnp.ndarray, game: jnp.ndarray, mask_table: jnp.ndarray
+) -> jnp.ndarray:
+    """Greedy action restricted to each row's OWN game's action set."""
+    return jnp.argmax(
+        masked_q_values(quantiles, game, mask_table), axis=-1
+    ).astype(jnp.int32)

@@ -1,0 +1,191 @@
+"""Task-conditioned act/learn steps: ops/learn.py with a game-id input.
+
+Mirrors `build_learn_step`/`build_act_step` exactly — same tau sampling,
+same quantile-Huber loss, same in-graph target copy and finite flag — with
+two multi-game deltas:
+
+- the network is `MultiGameIQN` (game-embedding torso), applied with the
+  batch's per-row game ids;
+- every greedy selection (double-Q a* in the loss, the act step's action)
+  is restricted to each row's own game's action set via the static
+  [G, max_actions] mask table, so a 2-action game never "selects" the pad
+  slot a 3-action sibling owns.
+
+One jitted dispatch serves every game: game ids are DATA, shapes are
+suite-invariant, so XLA compiles once per role for the whole suite.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict, Tuple
+
+import chex
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from rainbow_iqn_apex_tpu.config import Config
+from rainbow_iqn_apex_tpu.multitask.model import (
+    MultiGameIQN,
+    masked_greedy_action,
+    masked_q_values,
+)
+from rainbow_iqn_apex_tpu.multitask.spec import MultiGameSpec
+from rainbow_iqn_apex_tpu.ops.learn import Batch, TrainState, make_optimizer
+from rainbow_iqn_apex_tpu.ops.losses import quantile_huber_loss
+
+
+def action_mask_table(spec: MultiGameSpec) -> np.ndarray:
+    """[G, max_actions] bool: True where the action id is real for the game."""
+    table = np.zeros((spec.num_games, spec.max_actions), bool)
+    for g, n in enumerate(spec.num_actions):
+        table[g, :n] = True
+    return table
+
+
+def make_mt_network(
+    cfg: Config, spec: MultiGameSpec, use_noise: bool = True
+) -> MultiGameIQN:
+    return MultiGameIQN(
+        num_games=spec.num_games,
+        num_actions=spec.max_actions,
+        hidden_size=cfg.hidden_size,
+        num_cosines=cfg.num_cosines,
+        noisy_sigma0=cfg.noisy_sigma0,
+        dueling=cfg.dueling,
+        use_noise=use_noise,
+        compute_dtype=jnp.dtype(cfg.compute_dtype),
+    )
+
+
+def init_mt_train_state(
+    cfg: Config, spec: MultiGameSpec, key: chex.PRNGKey
+) -> TrainState:
+    """TrainState over MultiGameIQN params (suite-common obs shape)."""
+    net = make_mt_network(cfg, spec)
+    k_init, k_taus, k_noise = jax.random.split(key, 3)
+    dummy = jnp.zeros(
+        (1, *spec.frame_shape, cfg.history_length), jnp.uint8
+    )
+    params = net.init(
+        {"params": k_init, "taus": k_taus, "noise": k_noise},
+        dummy,
+        jnp.zeros((1,), jnp.int32),
+        cfg.num_tau_samples,
+    )["params"]
+    opt_state = make_optimizer(cfg).init(params)
+    return TrainState(
+        params=params,
+        target_params=jax.tree.map(jnp.copy, params),
+        opt_state=opt_state,
+        step=jnp.zeros((), jnp.int32),
+    )
+
+
+def build_mt_learn_step(
+    cfg: Config, spec: MultiGameSpec
+) -> Callable[[TrainState, Batch, chex.PRNGKey],
+              Tuple[TrainState, Dict[str, jnp.ndarray]]]:
+    """Un-jitted task-conditioned learn step; callers jit with their own
+    sharding exactly like `ops.learn.build_learn_step`."""
+    net = make_mt_network(cfg, spec)
+    tx = make_optimizer(cfg)
+    mask_table = jnp.asarray(action_mask_table(spec))
+
+    def loss_fn(params, target_params, batch: Batch, key):
+        (k_sel_tau, k_sel_noise, k_tgt_tau, k_tgt_noise,
+         k_on_tau, k_on_noise) = jax.random.split(key, 6)
+        game = batch.game
+        # double-Q a* on s': online net, K acting taus, masked to the
+        # row's own game
+        sel_q, _ = net.apply(
+            {"params": params}, batch.next_obs, game,
+            cfg.num_quantile_samples,
+            rngs={"taus": k_sel_tau, "noise": k_sel_noise},
+        )
+        a_star = masked_greedy_action(sel_q, game, mask_table)  # [B]
+        tgt_q, _ = net.apply(
+            {"params": target_params}, batch.next_obs, game,
+            cfg.num_tau_prime_samples,
+            rngs={"taus": k_tgt_tau, "noise": k_tgt_noise},
+        )
+        z_next = jnp.take_along_axis(
+            tgt_q, a_star[:, None, None], axis=-1)[..., 0]
+        td_target = jax.lax.stop_gradient(
+            batch.reward[:, None] + batch.discount[:, None] * z_next
+        )
+        on_q, taus = net.apply(
+            {"params": params}, batch.obs, game, cfg.num_tau_samples,
+            rngs={"taus": k_on_tau, "noise": k_on_noise},
+        )
+        z_online = jnp.take_along_axis(
+            on_q, batch.action[:, None, None], axis=-1)[..., 0]
+        per_sample, td_abs = quantile_huber_loss(
+            z_online, taus, td_target, cfg.kappa)
+        loss = jnp.mean(batch.weight * per_sample)
+        aux = {
+            "td_abs": td_abs,
+            "q_mean": on_q.mean(),
+            "target_q_mean": z_next.mean(),
+        }
+        return loss, aux
+
+    def learn_step(state: TrainState, batch: Batch, key: chex.PRNGKey):
+        (loss, aux), grads = jax.value_and_grad(loss_fn, has_aux=True)(
+            state.params, state.target_params, batch, key
+        )
+        updates, opt_state = tx.update(grads, state.opt_state, state.params)
+        params = optax.apply_updates(state.params, updates)
+        step = state.step + 1
+        do_copy = (step % cfg.target_update_period == 0).astype(jnp.float32)
+        target_params = jax.tree.map(
+            lambda t, o: do_copy * o + (1.0 - do_copy) * t,
+            state.target_params,
+            params,
+        )
+        grad_norm = optax.global_norm(grads)
+        info = {
+            "loss": loss,
+            "priorities": aux["td_abs"],
+            "q_mean": aux["q_mean"],
+            "target_q_mean": aux["target_q_mean"],
+            "grad_norm": grad_norm,
+            "finite": jnp.isfinite(loss) & jnp.isfinite(grad_norm),
+        }
+        return (
+            TrainState(
+                params=params,
+                target_params=target_params,
+                opt_state=opt_state,
+                step=step,
+            ),
+            info,
+        )
+
+    return learn_step
+
+
+def build_mt_act_step(
+    cfg: Config, spec: MultiGameSpec, use_noise: bool = True
+) -> Callable[[chex.ArrayTree, jnp.ndarray, jnp.ndarray, chex.PRNGKey],
+              Tuple[jnp.ndarray, jnp.ndarray]]:
+    """Batched task-conditioned greedy acting:
+    (params, obs [B,H,W,C] u8, game [B] i32, key) -> (actions [B], q [B,A]).
+
+    The returned q values carry MASK_FILL on out-of-game slots, so
+    downstream max/argmax (the actor-side priority estimator) stays inside
+    the row's real action set."""
+    net = make_mt_network(cfg, spec, use_noise=use_noise)
+    mask_table = jnp.asarray(action_mask_table(spec))
+
+    def act_step(params, obs, game, key):
+        k_tau, k_noise = jax.random.split(key)
+        quantiles, _ = net.apply(
+            {"params": params}, obs, game, cfg.num_quantile_samples,
+            rngs={"taus": k_tau, "noise": k_noise},
+        )
+        q = masked_q_values(quantiles, game, mask_table)
+        return jnp.argmax(q, axis=-1).astype(jnp.int32), q
+
+    return act_step

@@ -72,6 +72,14 @@ REQUIRED_KEYS: Dict[str, frozenset] = {
     # same logical unit — the cross-host flow key scripts/trace_export.py
     # turns into Perfetto flow arrows; optional `links` lists other trace
     # ids this span consumed, e.g. a learn step's sampled append ticks)
+    # multi-game rows (multitask/; docs/MULTITASK.md):
+    "games": frozenset({"step", "games"}),  # periodic per-game breakdown
+    # (per-game learn share / replay occupancy / latest eval score keyed by
+    # env id, plus suite hn_median/hn_mean aggregates; `eval` rows carry a
+    # ``game`` key per game in multi-game runs)
+    "eval_mt": frozenset({"step", "hn_median", "hn_mean"}),  # one suite
+    # aggregate per multi-game eval pass (human-normalized median/mean over
+    # the played games — the Atari-57 reporting convention)
     "lag": frozenset({"step"}),  # periodic lag-attribution row: per-metric
     # window percentiles of the always-on lag_* histograms (sample age at
     # learn time, ring retirement, router dispatch, batcher slot wait) plus

@@ -45,6 +45,9 @@ class Batch:
     next_obs: jnp.ndarray  # [B, H, W, C] uint8
     discount: jnp.ndarray  # [B] f32 — gamma^n * (1 - done)
     weight: jnp.ndarray  # [B] f32 — PER importance-sampling weights
+    game: Optional[jnp.ndarray] = None  # [B] int32 game ids — multi-game
+    # runs only (multitask/ops.py conditions the net on it); None on the
+    # single-game path, an empty pytree node that changes no numerics
 
 
 @struct.dataclass

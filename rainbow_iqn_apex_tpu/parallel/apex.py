@@ -144,9 +144,11 @@ class ApexDriver(QuantPublishMixin):
         num_actions: int,
         devices: Optional[Sequence[jax.Device]] = None,
         state_shape: Optional[Tuple[int, ...]] = None,
+        spec=None,  # multitask.MultiGameSpec: task-conditioned multi-game mode
     ):
         self.cfg = cfg
         self.num_actions = num_actions
+        self.spec = spec
         ldevs, adevs = split_devices(devices, cfg.learner_devices)
         self.lmesh = learner_mesh(ldevs)
         self.amesh = actor_mesh(adevs)
@@ -155,7 +157,25 @@ class ApexDriver(QuantPublishMixin):
         rep_l, rep_a = replicated(self.lmesh), replicated(self.amesh)
         self.key = jax.random.PRNGKey(cfg.seed)
         self.key, k_init = jax.random.split(self.key)
-        state = init_train_state(cfg, num_actions, k_init, state_shape=state_shape)
+        if spec is not None:
+            # task-conditioned learner (multitask/; docs/MULTITASK.md):
+            # MultiGameIQN with a game-id embedding, ONE jitted dispatch for
+            # the whole suite — game ids are data, shapes are suite-common,
+            # so XLA compiles once per role regardless of how many games run
+            from rainbow_iqn_apex_tpu.multitask.ops import (
+                build_mt_act_step,
+                build_mt_learn_step,
+                init_mt_train_state,
+            )
+
+            state = init_mt_train_state(cfg, spec, k_init)
+            learn_fn = build_mt_learn_step(cfg, spec)
+            act_fn = build_mt_act_step(cfg, spec, use_noise=True)
+        else:
+            state = init_train_state(
+                cfg, num_actions, k_init, state_shape=state_shape)
+            learn_fn = build_learn_step(cfg, num_actions)
+            act_fn = build_act_step(cfg, num_actions, use_noise=True)
         self._host_step: Optional[int] = None  # host mirror of state.step
         self.state: TrainState = jax.device_put(state, rep_l)
 
@@ -163,19 +183,16 @@ class ApexDriver(QuantPublishMixin):
         # gradient all-reduce (psum over "dp") from the sharding alone.
         self._batch_sh = batch_sharding(self.lmesh, "dp")
         self._learn = jax.jit(
-            build_learn_step(cfg, num_actions),
+            learn_fn,
             in_shardings=(rep_l, self._batch_sh, rep_l),
             donate_argnums=0,
         )
         # actor step: lanes split over the actor mesh, params replicated.
+        # Multi-game acting threads a lane-sharded [L] game-id vector
+        # (set_lane_games) through the same executable.
         lane_sh = batch_sharding(self.amesh, "actor")
         self._lane_sh = lane_sh
-        act_fn = build_act_step(cfg, num_actions, use_noise=True)
-        self._act = jax.jit(
-            act_fn,
-            in_shardings=(rep_a, lane_sh, rep_a),
-            out_shardings=(lane_sh, lane_sh),
-        )
+        self._lane_games = None  # device [L] i32, mt mode only
 
         # device-resident frame stacking: the stack never leaves the actor
         # mesh; the host ships ONE [L, H, W] frame per tick and lanes cut
@@ -183,18 +200,33 @@ class ApexDriver(QuantPublishMixin):
         # the host FrameStacker (tests/test_parallel.py), 4x less transfer,
         # and none of the strided host shifting that was the measured host
         # bottleneck (~14k frames/s on the build sandbox vs ~130k replay
-        # append).
-        def stack_act(params, stack, frame, keep, key):
-            stack = shift_stack(stack, frame, keep)
-            a, q = act_fn(params, stack, key)
-            return a, q, stack
+        # append).  One wiring for both act flavours: multi-game threads
+        # one extra lane-sharded [L] game-id operand through the same
+        # executables (fp32 and quantized twins alike).
+        def jit_act_pair(fn):
+            game_sh = (lane_sh,) if spec is not None else ()
 
-        self._stack_act = jax.jit(
-            stack_act,
-            in_shardings=(rep_a, lane_sh, lane_sh, lane_sh, rep_a),
-            out_shardings=(lane_sh, lane_sh, lane_sh),
-            donate_argnums=1,
-        )
+            def stack_act(params, stack, frame, keep, *rest):
+                # rest = (game, key) in multi-game mode, (key,) otherwise
+                stack = shift_stack(stack, frame, keep)
+                a, q = fn(params, stack, *rest)
+                return a, q, stack
+
+            act = jax.jit(
+                fn,
+                in_shardings=(rep_a, lane_sh, *game_sh, rep_a),
+                out_shardings=(lane_sh, lane_sh),
+            )
+            stack = jax.jit(
+                stack_act,
+                in_shardings=(
+                    rep_a, lane_sh, lane_sh, lane_sh, *game_sh, rep_a),
+                out_shardings=(lane_sh, lane_sh, lane_sh),
+                donate_argnums=1,
+            )
+            return act, stack
+
+        self._act, self._stack_act = jit_act_pair(act_fn)
         self._put_lanes = lane_put(lane_sh)
         self.actor_stack = None  # created lazily at the first act_frames
         # quantized actor lanes (utils/quantize.py + the shared
@@ -206,23 +238,7 @@ class ApexDriver(QuantPublishMixin):
         if self._init_quant_publish(
                 cfg, multihost=jax.process_count() > 1) != "off":
             act_q_fn = wrap_act_quantized(act_fn)
-            self._act_q = jax.jit(
-                act_q_fn,
-                in_shardings=(rep_a, lane_sh, rep_a),
-                out_shardings=(lane_sh, lane_sh),
-            )
-
-            def stack_act_q(qparams, stack, frame, keep, key):
-                stack = shift_stack(stack, frame, keep)
-                a, q = act_q_fn(qparams, stack, key)
-                return a, q, stack
-
-            self._stack_act_q = jax.jit(
-                stack_act_q,
-                in_shardings=(rep_a, lane_sh, lane_sh, lane_sh, rep_a),
-                out_shardings=(lane_sh, lane_sh, lane_sh),
-                donate_argnums=1,
-            )
+            self._act_q, self._stack_act_q = jit_act_pair(act_q_fn)
             # the gate runs on the LEARNER mesh copy (plain jit)
             self._gate_act32 = jax.jit(act_fn)
             self._gate_actq = jax.jit(act_q_fn)
@@ -250,16 +266,38 @@ class ApexDriver(QuantPublishMixin):
     # publish_weights / attach_obs / wants_calibration and the gated
     # quantized broadcast live in QuantPublishMixin (shared with the r2d2
     # driver); only the act-signature-shaped hooks are defined here.
-    def set_calibration(self, obs_batch: np.ndarray) -> None:
+    def set_lane_games(self, games: np.ndarray) -> None:
+        """Multi-game mode: pin the [L] per-lane game ids (lane-sharded
+        device constant every act dispatch conditions on).  Must match the
+        lane order of `multitask.build_game_lanes`."""
+        self._lane_games = self._put_lanes(np.asarray(games, np.int32))
+
+    @property
+    def _game_args(self) -> tuple:
+        """The extra act-step operand(s): one lane-sharded game-id vector
+        in multi-game mode, nothing otherwise — splatted at every act call
+        site so the two modes share one call shape."""
+        return () if self._lane_games is None else (self._lane_games,)
+
+    def set_calibration(self, obs_batch: np.ndarray,
+                        game: Optional[np.ndarray] = None) -> None:
         """Calibration observations for the agreement gate, drawn from
-        replay statistics (a sampled batch's stacked obs).  Clipped to
-        ``cfg.quant_calib_batch`` so the gate executables compile once."""
+        replay statistics (a sampled batch's stacked obs, plus its game ids
+        in multi-game mode).  Clipped to ``cfg.quant_calib_batch`` so the
+        gate executables compile once."""
         n = min(len(obs_batch), max(int(self.cfg.quant_calib_batch), 1))
         self._calib_obs = jnp.asarray(np.asarray(obs_batch[:n], np.uint8))
+        if self.spec is not None:
+            if game is None:
+                game = np.zeros(n, np.int32)
+            self._calib_game = jnp.asarray(
+                np.asarray(game[:n], np.int32))
 
     def _gate_actions(self, params, qparams):
-        a32, _ = self._gate_act32(params, self._calib_obs, self._gate_key)
-        aq, _ = self._gate_actq(qparams, self._calib_obs, self._gate_key)
+        calib = (self._calib_obs, *(
+            (self._calib_game,) if self.spec is not None else ()))
+        a32, _ = self._gate_act32(params, *calib, self._gate_key)
+        aq, _ = self._gate_actq(qparams, *calib, self._gate_key)
         return a32, aq
 
     # ---------------------------------------------------------------- resume
@@ -301,7 +339,8 @@ class ApexDriver(QuantPublishMixin):
         """Dispatch lane-sharded inference; returns DEVICE arrays immediately
         (JAX async dispatch) so the host can overlap env work."""
         act = self._act_q if self._actor_quant else self._act
-        return act(self.actor_params, put_frames(stacked_obs), self._next_key())
+        return act(self.actor_params, put_frames(stacked_obs),
+                   *self._game_args, self._next_key())
 
     def act(self, stacked_obs: np.ndarray) -> Tuple[np.ndarray, np.ndarray]:
         a, q = self.act_async(stacked_obs)
@@ -330,6 +369,7 @@ class ApexDriver(QuantPublishMixin):
             self.actor_stack,
             self._put_lanes(np.asarray(frames, np.uint8)),
             keep,
+            *self._game_args,
             self._next_key(),
         )
         with hostsync.sanctioned():  # obligatory actor->env hand-off
@@ -435,6 +475,33 @@ def _eval_learner(cfg: Config, env, driver: "ApexDriver") -> Dict[str, Any]:
     return evaluate_state(cfg, env, host_state(driver.state), seed=cfg.seed + 977)
 
 
+def _eval_multigame(cfg: Config, spec, driver: "ApexDriver",
+                    metrics, step: int, games_obs) -> Dict[str, Any]:
+    """Multi-game eval emission (docs/MULTITASK.md): one `eval` row PER
+    GAME (keyed by ``game``) plus one `eval_mt` aggregate row carrying the
+    suite human-normalized median/mean — the Atari-57 reporting convention.
+    Returns the flat aggregate dict for the run summary."""
+    from rainbow_iqn_apex_tpu.multitask.eval import evaluate_multigame
+
+    res = evaluate_multigame(
+        cfg, spec, host_state(driver.state).params, seed=cfg.seed + 977)
+    games_obs.note_eval(res)
+    if metrics is not None:
+        for name, row in res["games"].items():
+            metrics.log("eval", step=step, game=name, **row)
+        metrics.log(
+            "eval_mt", step=step, score_mean=res["score_mean"],
+            hn_median=res["hn_median"], hn_mean=res["hn_mean"],
+            hn_games=res["hn_games"], games=len(res["games"]),
+        )
+    return {
+        "score_mean": res["score_mean"],
+        "hn_median": res["hn_median"],
+        "hn_mean": res["hn_mean"],
+        "hn_games": res["hn_games"],
+    }
+
+
 def train_apex(cfg: Config, max_frames: Optional[int] = None) -> Dict[str, Any]:
     """The full Ape-X loop on one host's slice (SURVEY §3.1 + §3.2 fused).
 
@@ -454,32 +521,85 @@ def train_apex(cfg: Config, max_frames: Optional[int] = None) -> Dict[str, Any]:
     lanes, lane_lo = plan.lanes, plan.lane_lo
     is_main, local_batch = plan.is_main, plan.local_batch
 
-    # per-lane seeds are carved from the GLOBAL lane space so hosts never
-    # duplicate env streams
-    env = make_vector_env(cfg.env_id, lanes, seed=cfg.seed + lane_lo)
+    # multi-game mode (multitask/; docs/MULTITASK.md): N games in one pod —
+    # per-game lane blocks, a task-conditioned learner, game-pinned replay
+    # shards behind the interleave schedule, per-game eval/obs rows.  Unset
+    # games (the default) touches NONE of this: the single-game path below
+    # is bitwise the pre-multitask loop (tier-1 asserted).
+    from rainbow_iqn_apex_tpu.multitask.spec import MultiGameSpec
+
+    spec = MultiGameSpec.from_config(cfg)
+    if spec is not None and multihost:
+        raise ValueError(
+            "multi-game apex (cfg.games) is single-host for now — per-host "
+            "game partitioning of an SPMD pod is the ROADMAP follow-up")
+    games_obs = None
+    if spec is not None:
+        from rainbow_iqn_apex_tpu.multitask.lanes import (
+            build_game_lanes,
+            lane_games,
+        )
+        from rainbow_iqn_apex_tpu.multitask.obs import GamesObs
+
+        if lanes % spec.num_games:
+            raise ValueError(
+                f"total lanes {lanes} must divide across "
+                f"{spec.num_games} games")
+        env = build_game_lanes(
+            spec, lanes // spec.num_games, seed=cfg.seed + lane_lo)
+        games_obs = GamesObs(spec)
+    else:
+        # per-lane seeds are carved from the GLOBAL lane space so hosts
+        # never duplicate env streams
+        env = make_vector_env(cfg.env_id, lanes, seed=cfg.seed + lane_lo)
     driver = ApexDriver(
-        cfg, env.num_actions, state_shape=(*env.frame_shape, cfg.history_length)
+        cfg, env.num_actions,
+        state_shape=(*env.frame_shape, cfg.history_length), spec=spec,
     )
     if lanes_total % driver.n_actor_devices:
         raise ValueError(
             f"total lanes {lanes_total} must divide across "
             f"{driver.n_actor_devices} actor devices"
         )
+    if spec is not None:
+        driver.set_lane_games(lane_games(spec, lanes // spec.num_games))
 
-    shards = cfg.replay_shards // nproc if multihost else cfg.replay_shards
-    memory = ShardedReplay.build(
-        max(shards, 1),
-        cfg.memory_capacity // nproc,
-        lanes,
-        frame_shape=env.frame_shape,
-        history=cfg.history_length,
-        n_step=cfg.multi_step,
-        gamma=cfg.gamma,
-        priority_exponent=cfg.priority_exponent,
-        priority_eps=cfg.priority_eps,
-        seed=cfg.seed + lane_lo,
-        use_native=cfg.use_native_sumtree,
-    )
+    if spec is not None:
+        from rainbow_iqn_apex_tpu.multitask.replay import MultiGameReplay
+
+        # cfg.replay_shards is PER GAME here: each game owns its own shard
+        # block (its per-game priority trees), so one game's drop/readmit
+        # never touches a sibling's sampling distribution
+        shards = max(cfg.replay_shards, 1) * spec.num_games
+        memory = MultiGameReplay.build_games(
+            spec,
+            max(cfg.replay_shards, 1),
+            cfg.memory_capacity,
+            lanes,
+            schedule=cfg.multitask_schedule,
+            history=cfg.history_length,
+            n_step=cfg.multi_step,
+            gamma=cfg.gamma,
+            priority_exponent=cfg.priority_exponent,
+            priority_eps=cfg.priority_eps,
+            seed=cfg.seed + lane_lo,
+            use_native=cfg.use_native_sumtree,
+        )
+    else:
+        shards = cfg.replay_shards // nproc if multihost else cfg.replay_shards
+        memory = ShardedReplay.build(
+            max(shards, 1),
+            cfg.memory_capacity // nproc,
+            lanes,
+            frame_shape=env.frame_shape,
+            history=cfg.history_length,
+            n_step=cfg.multi_step,
+            gamma=cfg.gamma,
+            priority_exponent=cfg.priority_exponent,
+            priority_eps=cfg.priority_eps,
+            seed=cfg.seed + lane_lo,
+            use_native=cfg.use_native_sumtree,
+        )
     learn_start = cfg.learn_start // nproc  # local transitions before learning
     import os
 
@@ -535,6 +655,11 @@ def train_apex(cfg: Config, max_frames: Optional[int] = None) -> Dict[str, Any]:
             # being deduped against the previous incarnation's report
             epoch=next_lease_epoch(heartbeat_dir(cfg), cfg.process_id),
         )
+        if spec is not None:
+            # lease payloads carry the game set this host serves, so an
+            # external controller (RoleSupervisor respawns, fence monitors)
+            # stays game-aware without tailing this process's JSONL
+            heartbeat.update_payload(game=",".join(spec.games))
         heartbeat.set_weight_version(driver.weights_version)
         heartbeat.start()
         if is_main:
@@ -563,6 +688,15 @@ def train_apex(cfg: Config, max_frames: Optional[int] = None) -> Dict[str, Any]:
             # host falls back together (the cfg is identical on all hosts)
             metrics.log("notice", event="device_sampling_fallback",
                         reason="multihost: host sampling path retained")
+        elif spec is not None and cfg.multitask_schedule != "mass":
+            # the frontier's fused HBM draw is proportional to global
+            # priority mass — exactly the "mass" schedule and nothing else;
+            # per-game-quota schedules need the host interleave
+            metrics.log(
+                "notice", event="device_sampling_fallback",
+                reason="multitask: game-interleaved host sampling retained "
+                       "(multitask_schedule=mass composes with the device "
+                       "frontier)")
         else:
             from rainbow_iqn_apex_tpu.replay.frontier import (
                 DeviceSampleFrontier,
@@ -608,9 +742,21 @@ def train_apex(cfg: Config, max_frames: Optional[int] = None) -> Dict[str, Any]:
         materialize_priorities=frontier is None,
         tracer=ptrace,
     )
+    if frontier is not None and spec is not None:
+        # device sampling bypasses memory.update_priorities (the |TD| stays
+        # a device array retiring into the HBM mirror), so the per-game
+        # learn-share counters the `games` row reports are fed from the
+        # host idx vector explicitly
+        def _update_target(idx, td_abs, _f=frontier.update):
+            memory.note_learn_idx(idx)
+            return _f(idx, td_abs)
+    elif frontier is not None:
+        _update_target = frontier.update
+    else:
+        _update_target = memory.update_priorities
     committer = RingCommitter(
         ring,
-        frontier.update if frontier is not None else memory.update_priorities,
+        _update_target,
         sup,
         driver.load_snapshot,
         on_drain=frontier.reconcile if frontier is not None else None,
@@ -716,7 +862,8 @@ def train_apex(cfg: Config, max_frames: Optional[int] = None) -> Dict[str, Any]:
                         min(cfg.quant_calib_batch, cfg.batch_size),
                         priority_beta(cfg, frames),
                     )
-                    driver.set_calibration(calib.obs)
+                    driver.set_calibration(
+                        calib.obs, game=getattr(calib, "game", None))
                 if frontier is not None and prefetcher is None:
                     # sample-ahead pusher: device-drawn index blocks,
                     # host-DRAM frame gather, staged device batches PUSHED
@@ -889,6 +1036,23 @@ def train_apex(cfg: Config, max_frames: Optional[int] = None) -> Dict[str, Any]:
                             weight_version_lag=fence.lag,
                             **pipeline_gauges(ring, obs_run.registry, frontier),
                         )
+                        if spec is not None:
+                            # per-game breakdown (docs/MULTITASK.md): learn
+                            # share, replay occupancy, latest eval score,
+                            # human-normalized aggregate — the row obs_report
+                            # `games:` and relay_watch key on
+                            metrics.log(
+                                "games", step=step, frames=frames,
+                                schedule=cfg.multitask_schedule,
+                                **games_obs.row(
+                                    learn_shares=memory.learn_shares(),
+                                    learn_rows=memory.learn_rows_by_game,
+                                    sampled_rows=memory.sampled_rows_by_game,
+                                    game_sizes=memory.game_sizes(),
+                                    game_occupancy=memory.game_occupancy(),
+                                    dead_games=memory.dead_games(),
+                                ),
+                            )
                         # lag-attribution row (obs/pipeline_trace.py):
                         # sample age / retirement / publish->adopt
                         # percentiles, RunHealth folds budget breaches
@@ -924,7 +1088,10 @@ def train_apex(cfg: Config, max_frames: Optional[int] = None) -> Dict[str, Any]:
                         # itself is main-host work
                         if not _drain():  # evaluate only verified params
                             continue
-                        if is_main:
+                        if is_main and spec is not None:
+                            _eval_multigame(
+                                cfg, spec, driver, metrics, step, games_obs)
+                        elif is_main:
                             metrics.log(
                                 "eval", step=step,
                                 **_eval_learner(cfg, env, driver),
@@ -954,9 +1121,14 @@ def train_apex(cfg: Config, max_frames: Optional[int] = None) -> Dict[str, Any]:
         obs_run.close(driver.step, frames)
         if heartbeat is not None:
             heartbeat.stop()
-    final_eval = _eval_learner(cfg, env, driver) if is_main else {}
-    if is_main:
+    if is_main and spec is not None:
+        final_eval = _eval_multigame(
+            cfg, spec, driver, metrics, driver.step, games_obs)
+    elif is_main:
+        final_eval = _eval_learner(cfg, env, driver)
         metrics.log("eval", step=driver.step, **final_eval)
+    else:
+        final_eval = {}
     sup.save_checkpoint(
         ckpt, driver.step, host_state(driver.state),
         {"frames": frames, "weights_version": driver.weights_version,

@@ -392,6 +392,50 @@ def _eval_r2d2_learner(cfg: Config, env, driver: "R2D2ApexDriver") -> Dict[str, 
     return evaluate_r2d2(cfg, eval_agent, seed=cfg.seed + 977)
 
 
+def _eval_r2d2_multigame(cfg: Config, spec, env, driver: "R2D2ApexDriver",
+                         metrics, step: int, games_obs) -> Dict[str, Any]:
+    """Per-game r2d2 eval (docs/MULTITASK.md): the generalist net evaluated
+    on each game's own padded env — one `eval` row per game (keyed by
+    ``game``) plus the `eval_mt` human-normalized aggregate, the same
+    emission contract as the iqn apex driver."""
+    from rainbow_iqn_apex_tpu.envs import make_env
+    from rainbow_iqn_apex_tpu.eval import human_normalized
+    from rainbow_iqn_apex_tpu.multitask.eval import aggregate_human_normalized
+    from rainbow_iqn_apex_tpu.multitask.lanes import GameLaneEnv
+    from rainbow_iqn_apex_tpu.train_r2d2 import R2D2Agent, evaluate_r2d2
+
+    eval_agent = R2D2Agent(
+        cfg, env.num_actions, env.frame_shape,
+        jax.random.PRNGKey(cfg.seed + 1), train=False,
+    )
+    eval_agent.state = jax.device_put(
+        host_state(driver.state), jax.local_devices()[0])
+    per_game: Dict[str, Dict[str, Any]] = {}
+    per_game_hn: Dict[str, Any] = {}
+    for g, name in enumerate(spec.games):
+        game_env = GameLaneEnv(
+            make_env(name, seed=cfg.seed + 977 + g), spec, g)
+        try:
+            row = evaluate_r2d2(
+                cfg, eval_agent, seed=cfg.seed + 977 + g, env=game_env)
+        finally:
+            game_env.close()  # per-eval envs must not leak (ALE handles)
+        hn = human_normalized(name, row["score_mean"])
+        per_game_hn[name] = hn
+        if hn is not None:
+            row["human_normalized"] = hn
+        per_game[name] = row
+        if metrics is not None:
+            metrics.log("eval", step=step, game=name, **row)
+    agg = aggregate_human_normalized(per_game_hn)
+    score_mean = float(np.mean([r["score_mean"] for r in per_game.values()]))
+    if metrics is not None:
+        metrics.log("eval_mt", step=step, score_mean=score_mean,
+                    games=len(per_game), **agg)
+    games_obs.note_eval({"games": per_game})
+    return {"score_mean": score_mean, **agg}
+
+
 def train_apex_r2d2(cfg: Config, max_frames: Optional[int] = None) -> Dict[str, Any]:
     """Mesh-parallel R2D2 Ape-X; multi-host exactly like apex.train_apex
     (same SPMD shape: local lanes/replay/sub-batches, global collectives).
@@ -409,7 +453,39 @@ def train_apex_r2d2(cfg: Config, max_frames: Optional[int] = None) -> Dict[str, 
     lanes, lane_lo = plan.lanes, plan.lane_lo
     is_main, local_batch = plan.is_main, plan.local_batch
 
-    env = make_vector_env(cfg.env_id, lanes, seed=cfg.seed + lane_lo)
+    # multi-game r2d2 (multitask/; docs/MULTITASK.md): per-game lane blocks
+    # + per-game eval/obs rows around ONE generalist recurrent net (padded
+    # suite-common frames/actions; GameLaneEnv maps out-of-range actions).
+    # Task conditioning and per-game replay shards are the iqn apex
+    # driver's — the sequence replay stays one prioritized tree, with
+    # per-game learn-share attribution via the slot lane stamps.
+    from rainbow_iqn_apex_tpu.multitask.spec import MultiGameSpec
+
+    spec = MultiGameSpec.from_config(cfg)
+    if spec is not None and multihost:
+        raise ValueError(
+            "multi-game apex (cfg.games) is single-host for now — per-host "
+            "game partitioning of an SPMD pod is the ROADMAP follow-up")
+    games_obs = games_of_lane = None
+    mt_learn_rows = None
+    if spec is not None:
+        from rainbow_iqn_apex_tpu.multitask.lanes import (
+            build_game_lanes,
+            lane_games,
+        )
+        from rainbow_iqn_apex_tpu.multitask.obs import GamesObs
+
+        if lanes % spec.num_games:
+            raise ValueError(
+                f"total lanes {lanes} must divide across "
+                f"{spec.num_games} games")
+        env = build_game_lanes(
+            spec, lanes // spec.num_games, seed=cfg.seed + lane_lo)
+        games_obs = GamesObs(spec)
+        games_of_lane = lane_games(spec, lanes // spec.num_games)
+        mt_learn_rows = np.zeros(spec.num_games, np.int64)
+    else:
+        env = make_vector_env(cfg.env_id, lanes, seed=cfg.seed + lane_lo)
     driver = R2D2ApexDriver(cfg, env.num_actions, env.frame_shape, lanes_total)
 
     memory = SequenceReplay(
@@ -466,6 +542,9 @@ def train_apex_r2d2(cfg: Config, max_frames: Optional[int] = None) -> Dict[str, 
             role="apex_r2d2", shard=cfg.process_id,
             epoch=next_lease_epoch(heartbeat_dir(cfg), cfg.process_id),
         )
+        if spec is not None:
+            # lease payloads carry the game set (same contract as apex.py)
+            heartbeat.update_payload(game=",".join(spec.games))
         heartbeat.set_weight_version(driver.weights_version)
         heartbeat.start()
         if is_main:
@@ -695,6 +774,13 @@ def train_apex_r2d2(cfg: Config, max_frames: Optional[int] = None) -> Dict[str, 
                             with obs_run.span("learn_step"):
                                 info = driver.learn_batch(sup.poison_maybe(batch))
                     sup.maybe_stall()
+                    if mt_learn_rows is not None:
+                        # per-game learn share off the sequence slot lane
+                        # stamps (telemetry; the `games` row reports it)
+                        mt_learn_rows += np.bincount(
+                            games_of_lane[memory.lane_of(idx)],
+                            minlength=spec.num_games,
+                        ).astype(np.int64)
                     # dispatch-only hot path; the deferred guard decision is
                     # still lockstep across hosts (all-reduced loss -> same
                     # in-graph finite flag), same argument as apex.py
@@ -744,6 +830,35 @@ def train_apex_r2d2(cfg: Config, max_frames: Optional[int] = None) -> Dict[str, 
                             weight_version_lag=fence.lag,
                             **pipeline_gauges(ring, obs_run.registry, frontier),
                         )
+                        if spec is not None:
+                            # per-game breakdown (the same `games` row the
+                            # iqn apex driver emits; sequence replay is one
+                            # tree, so per-game sizes come off the slot
+                            # lane stamps instead of shard blocks).
+                            # Occupancy is each game's fill of its FAIR
+                            # SHARE (capacity / num_games) so the number
+                            # means the same thing as the iqn driver's
+                            # per-game-capacity fill: a balanced full
+                            # buffer reads 1.0 per game; > 1.0 says the
+                            # game is crowding its siblings out of the
+                            # shared tree.
+                            sizes = np.bincount(
+                                games_of_lane[memory.slot_lanes()],
+                                minlength=spec.num_games,
+                            ).astype(np.int64)
+                            total_rows = max(int(mt_learn_rows.sum()), 1)
+                            fair = max(
+                                memory.capacity / spec.num_games, 1.0)
+                            metrics.log(
+                                "games", step=step, frames=frames,
+                                schedule="sequence",
+                                **games_obs.row(
+                                    learn_shares=mt_learn_rows / total_rows,
+                                    learn_rows=mt_learn_rows,
+                                    game_sizes=sizes,
+                                    game_occupancy=sizes / fair,
+                                ),
+                            )
                         ptrace.emit_lag_row(step)
                         if monitor is not None:
                             # same lease-edge reporting as train_apex: one
@@ -767,7 +882,11 @@ def train_apex_r2d2(cfg: Config, max_frames: Optional[int] = None) -> Dict[str, 
                         # itself stays main-host work
                         if not _drain():  # evaluate only verified params
                             continue
-                        if is_main:
+                        if is_main and spec is not None:
+                            _eval_r2d2_multigame(
+                                cfg, spec, env, driver, metrics, step,
+                                games_obs)
+                        elif is_main:
                             metrics.log(
                                 "eval", step=step,
                                 **_eval_r2d2_learner(cfg, env, driver),
@@ -794,9 +913,14 @@ def train_apex_r2d2(cfg: Config, max_frames: Optional[int] = None) -> Dict[str, 
         if heartbeat is not None:
             heartbeat.stop()
 
-    final_eval = _eval_r2d2_learner(cfg, env, driver) if is_main else {}
-    if is_main:
+    if is_main and spec is not None:
+        final_eval = _eval_r2d2_multigame(
+            cfg, spec, env, driver, metrics, driver.step, games_obs)
+    elif is_main:
+        final_eval = _eval_r2d2_learner(cfg, env, driver)
         metrics.log("eval", step=driver.step, **final_eval)
+    else:
+        final_eval = {}
     sup.save_checkpoint(
         ckpt, driver.step, host_state(driver.state),
         {"frames": frames, "weights_version": driver.weights_version,

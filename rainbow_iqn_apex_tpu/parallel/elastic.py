@@ -105,6 +105,8 @@ class HeartbeatWriter:
         self.payload: Dict = {"role": role, "epoch": int(epoch)}
         if shard is not None:
             self.payload["shard"] = int(shard)
+        # the multi-game lease payload field (`game`, read back as
+        # Lease.game) rides update_payload like every other contract field
         # dynamic lease payload (serving fleet): merged into every renewal so
         # fast-moving fields (queue_depth, weights_version) ride the lease
         # without the owner calling update_payload on its own hot path
@@ -203,6 +205,10 @@ class Lease:
     lanes: int = 0  # engine mesh width (dispatch weight denominator)
     buckets: Tuple[int, ...] = ()  # padded batch sizes the engine compiled
     queue_depth: int = -1  # engine request-queue depth at the last renewal
+    # multi-game payload (multitask/): the game (or comma-joined game set)
+    # this host's lanes are pinned to — RoleSupervisor respawn decisions and
+    # fence monitors stay game-aware without a second discovery channel
+    game: Optional[str] = None
 
 
 # ---------------------------------------------------------- lease monitoring
@@ -271,6 +277,7 @@ class HeartbeatMonitor:
                 lanes=int(payload.get("lanes", 0) or 0),
                 buckets=tuple(int(b) for b in payload.get("buckets") or ()),
                 queue_depth=int(payload.get("queue_depth", -1)),
+                game=payload.get("game"),
             )
         return out
 
@@ -538,11 +545,16 @@ class StalenessFence:
     ``weight_version_lag`` gauge live."""
 
     def __init__(self, max_lag: int, metrics=None, registry=None,
-                 role: str = "actor"):
+                 role: str = "actor", game: Optional[str] = None):
         self.max_lag = int(max_lag)
         self.metrics = metrics
         self.registry = registry
         self.role = role
+        # multi-game attribution (multitask/): a fence episode on a
+        # game-pinned actor lane names WHICH game sheds frames — the
+        # "one game collapsed while others train" triage key
+        # (docs/RUNBOOK.md)
+        self.game = game
         self.fenced = False
         self.fences = 0
         self.shed_frames = 0
@@ -551,6 +563,13 @@ class StalenessFence:
     def _gauge(self, name: str, value: float) -> None:
         if self.registry is not None:
             self.registry.gauge(name, self.role).set(value)
+
+    def _edge(self, action: str, step: int) -> None:
+        if self.metrics is None:
+            return
+        extra = {} if self.game is None else {"game": self.game}
+        self.metrics.log("actor_fenced", action=action, lag=self.lag,
+                         max_lag=self.max_lag, step=int(step), **extra)
 
     def observe(self, held_version: int, published_version: int,
                 step: int = 0, frames_at_stake: int = 0) -> bool:
@@ -562,19 +581,13 @@ class StalenessFence:
             if not self.fenced:
                 self.fenced = True
                 self.fences += 1
-                if self.metrics is not None:
-                    self.metrics.log("actor_fenced", action="fence",
-                                     lag=self.lag, max_lag=self.max_lag,
-                                     step=int(step))
+                self._edge("fence", step)
             self.shed_frames += int(frames_at_stake)
             self._gauge("actor_shed_frames", self.shed_frames)
             return False
         if self.fenced:
             self.fenced = False
-            if self.metrics is not None:
-                self.metrics.log("actor_fenced", action="resume",
-                                 lag=self.lag, max_lag=self.max_lag,
-                                 step=int(step))
+            self._edge("resume", step)
         return True
 
 

@@ -46,6 +46,8 @@ class SampledBatch:
     prob: np.ndarray = None  # [B] float64 — buffer-local sample probability
     # (kept alongside weight so sharded replay can re-derive globally
     # consistent IS weights; see parallel/sharded_replay.py)
+    game: np.ndarray = None  # [B] int32 game ids — multi-game runs only
+    # (multitask/replay.py attaches them; None on the single-game path)
 
 
 class PrioritizedReplay:

@@ -100,6 +100,10 @@ class SequenceReplay:
         # always-on telemetry, no numerics touched
         self._emit_seq = np.zeros(capacity, np.int64)
         self._emit_ts = np.zeros(capacity, np.float64)
+        # producing lane per stored sequence (telemetry, like the emit
+        # stamps): multi-game runs map lane -> game for per-game learn-share
+        # attribution; not persisted in snapshots (restored slots read 0)
+        self._slot_lane = np.zeros(capacity, np.int64)
         self.emit_count = 0
         self._tracer = None
 
@@ -191,6 +195,7 @@ class SequenceReplay:
         self.emit_count += 1
         self._emit_seq[slot] = self.emit_count
         self._emit_ts[slot] = time.time()
+        self._slot_lane[slot] = lane
         self.pos = (self.pos + 1) % self.capacity
         self.filled = min(self.filled + 1, self.capacity)
 
@@ -227,6 +232,15 @@ class SequenceReplay:
         """Pipeline-tracing wiring (obs/pipeline_trace.py): sample/assemble
         record batch sequence-age lags on the shared registry."""
         self._tracer = tracer
+
+    def lane_of(self, idx: np.ndarray) -> np.ndarray:
+        """Producing lane of each stored sequence slot (0 for restored
+        slots — the stamps are telemetry, not persisted)."""
+        return self._slot_lane[np.asarray(idx, np.int64)]
+
+    def slot_lanes(self) -> np.ndarray:
+        """Producing lane of every written slot ([filled])."""
+        return self._slot_lane[: self.filled]
 
     def trace_ids(self, idx: np.ndarray) -> np.ndarray:
         """Emit tick of each slot in ``idx`` (0 = never stamped)."""

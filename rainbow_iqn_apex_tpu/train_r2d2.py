@@ -93,9 +93,12 @@ def _mask_reset(lstm_state, terminals: np.ndarray):
 
 
 def evaluate_r2d2(cfg: Config, agent: R2D2Agent, episodes: Optional[int] = None,
-                  seed: int = 0, max_steps: int = 200_000) -> Dict[str, Any]:
+                  seed: int = 0, max_steps: int = 200_000,
+                  env=None) -> Dict[str, Any]:
+    """``env`` overrides the cfg.env_id default — the multi-game apex path
+    hands in each game's padded GameLaneEnv (docs/MULTITASK.md)."""
     episodes = episodes or cfg.eval_episodes
-    env = make_env(cfg.env_id, seed=seed)
+    env = env if env is not None else make_env(cfg.env_id, seed=seed)
     scores = []
     for _ in range(episodes):
         frame = env.reset()

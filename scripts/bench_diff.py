@@ -54,6 +54,10 @@ REPORTED = {
     "apex_loop": "value",
     "sample_path": "value",
     "trace_overhead": "value",
+    # the multi-game tax ratio is deliberately report-only (ISSUE 10): the
+    # trajectory RECORDS what N-games-per-pod costs per learn step without
+    # weather-gating it — promote to GATED once a few rounds exist
+    "multitask_throughput": "ratio_vs_single",
 }
 
 

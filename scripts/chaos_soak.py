@@ -9,10 +9,24 @@ Topology (everything jax-free, so the soak runs anywhere in seconds):
 
     parent = learner + elastic controller          actor children (one per
       ShardedReplay (one shard per actor host)       host, respawnable)
-      WeightMailbox publish (version-stamped)  --->  adopt + StalenessFence
+      WeightMailbox.publish_params             --->  MailboxSubscriber.poll
+        (int8-delta payloads, PR-8 codec)             (bit-exact adopt +
+                                                       StalenessFence)
       spool ingest (epoch-fenced append_shard) <---  spool JSONL rows
       HeartbeatMonitor.poll (lease edges)      <---  HeartbeatWriter lease
       RoleSupervisor (respawn w/ backoff, FailureBudget eviction)
+
+Weight distribution is the REAL quantized consumer path (utils/quantize.py
+delta codec behind ``--publish-compression int8_delta``, the default):
+every publish ships an int8 delta (periodic full base), children hold a
+stateful `MailboxSubscriber` and log each adoption's version + params
+checksum; the harness asserts every adopted checksum matches the
+publisher's own reconstruction (bit-exactness across processes), that the
+slow adopter applied multi-packet chains (gap adoption), and that the
+REVIVED incarnation's fresh subscriber late-joined through base+delta
+chain replay — the PR-8 follow-up, exercised under kill/revive.  Children
+also carry a per-host ``game`` label in their lease payload and fence rows
+(the multitask game-aware lease contract, docs/MULTITASK.md).
 
 Seeded schedule (`--kill-schedule seeded`): host 1 is killed mid-run via the
 ``actor_exit`` fault point and REVIVED — the supervisor respawns it at lease
@@ -56,6 +70,22 @@ from rainbow_iqn_apex_tpu.utils import faults  # noqa: E402
 
 FRAME = 8  # tiny synthetic frames: the soak exercises plumbing, not learning
 LANES = 2  # env lanes per actor host
+GAMES = ("toy:catch", "toy:chain")  # per-host game labels (round-robin):
+# the lease/fence game-attribution contract, not real envs — the soak
+# exercises plumbing
+
+
+def params_digest(params) -> str:
+    """Deterministic cross-process digest of a {name: ndarray} pytree —
+    the bit-exactness yardstick for publisher vs subscriber reconstruction."""
+    import hashlib
+
+    h = hashlib.sha1()
+    for name in sorted(params):
+        arr = np.ascontiguousarray(np.asarray(params[name], np.float32))
+        h.update(name.encode())
+        h.update(arr.tobytes())
+    return h.hexdigest()[:16]
 
 
 # ---------------------------------------------------------------- actor child
@@ -64,6 +94,7 @@ def actor_main(args) -> int:
     spool production.  Deliberately jax-free (~0.3s cold start)."""
     from rainbow_iqn_apex_tpu.parallel.elastic import (
         HeartbeatWriter,
+        MailboxSubscriber,
         StalenessFence,
         WeightMailbox,
     )
@@ -79,13 +110,22 @@ def actor_main(args) -> int:
     lease = HeartbeatWriter(
         hb_dir, args.host, args.hb_interval, injector=injector,
         role="actor", shard=args.shard, epoch=args.epoch,
-    ).start()
+    )
+    if args.game:  # multi-game lease payload field (Lease.game)
+        lease.update_payload(game=args.game)
+    lease.start()
     metrics = MetricsLogger(
         os.path.join(args.dir, f"actor_h{args.host}_e{args.epoch}.jsonl"),
         run_id=args.run_id, echo=False, host=args.host,
     )
-    fence = StalenessFence(args.max_weight_lag, metrics=metrics)
+    fence = StalenessFence(args.max_weight_lag, metrics=metrics,
+                           game=args.game or None)
     mailbox = WeightMailbox(os.path.join(args.dir, "weights.json"))
+    # the quantized consumer path (PR-8 delta codec): a fresh incarnation's
+    # subscriber late-joins via base+delta chain replay; an in-sync one
+    # tail-applies only the new deltas.  Every adoption logs the
+    # reconstruction digest the harness checks against the publisher's.
+    subscriber = MailboxSubscriber(mailbox)
     spool_path = os.path.join(
         args.dir, "spool", f"h{args.host}_e{args.epoch}.jsonl"
     )
@@ -101,8 +141,25 @@ def actor_main(args) -> int:
                 os._exit(3)  # the kill: no flush, no lease farewell
             published = mailbox.version()
             if held < 0 or tick % args.adopt_every == 0:
-                held = published
-                lease.set_weight_version(held)
+                prev = subscriber.version
+                row = mailbox.read() or {}
+                params = subscriber.poll()
+                if params is not None:
+                    held = subscriber.version
+                    lease.set_weight_version(held)
+                    metrics.log(
+                        "adopt", tick=tick, version=held,
+                        prev_version=prev,
+                        checksum=params_digest(params),
+                        chain_len=len(row.get("chain") or ()),
+                        resyncs=subscriber.resyncs,
+                    )
+                elif "chain" not in row and published >= 0:
+                    # plain version-row mailbox (no payload published):
+                    # fall back to the PR-4 version-only adoption so the
+                    # fence arithmetic still runs
+                    held = published
+                    lease.set_weight_version(held)
             acted = fence.observe(
                 held, published, step=tick, frames_at_stake=LANES
             )
@@ -195,6 +252,7 @@ def soak_main(args) -> int:
     from rainbow_iqn_apex_tpu.obs.registry import MetricRegistry
     from rainbow_iqn_apex_tpu.parallel.elastic import (
         HeartbeatMonitor,
+        MailboxSubscriber,
         RoleSupervisor,
         WeightMailbox,
     )
@@ -228,8 +286,34 @@ def soak_main(args) -> int:
     # publisher's "w<host>-<version>" trace id from it, so a non-zero-host
     # controller must pass its own id or cross-process publish->adopt flow
     # arrows never join (this soak's controller IS host 0)
-    mailbox = WeightMailbox(os.path.join(run_dir, "weights.json"), host=0)
+    mailbox = WeightMailbox(
+        os.path.join(run_dir, "weights.json"), host=0,
+        base_interval=args.publish_base_interval,
+        compression=args.publish_compression,
+    )
     monitor = HeartbeatMonitor(hb_dir, args.hb_timeout, self_id=0)
+    # the published weights: a tiny pytree the parent perturbs per publish.
+    # A REFERENCE subscriber (same decode path the children run) records
+    # each version's reconstruction digest — the bit-exactness ground truth
+    # the children's adopt rows are asserted against.
+    prng = np.random.default_rng(args.seed + 7)
+    learner_params = {
+        "w": prng.standard_normal((8, 8)).astype(np.float32),
+        "b": prng.standard_normal(8).astype(np.float32),
+    }
+    ref_sub = MailboxSubscriber(mailbox)
+    published_digests: dict = {}  # version -> reconstruction digest
+
+    def publish_weights(v: int, step: int) -> None:
+        for name in learner_params:
+            learner_params[name] = (
+                learner_params[name]
+                + 0.01 * prng.standard_normal(
+                    learner_params[name].shape).astype(np.float32))
+        mailbox.publish_params(dict(learner_params), v, step=step)
+        ref = ref_sub.poll()
+        if ref is not None:
+            published_digests[v] = params_digest(ref)
 
     # the first readmission attempt fails (shard_rejoin point) so the
     # retry path is part of every soak, not just the happy path
@@ -260,6 +344,10 @@ def soak_main(args) -> int:
                 "--max-weight-lag", str(args.max_weight_lag),
                 "--adopt-every",
                 str(40 if host == slow_host else 3),
+                # per-host game label (multitask lease contract): rides the
+                # lease payload + fence rows so the controller stays
+                # game-aware without tailing actor JSONL
+                "--game", GAMES[(host - 1) % len(GAMES)],
                 # children tick twice as fast as the throttled ingest, so a
                 # killed host always leaves unconsumed spool rows behind for
                 # the epoch fence to reject after readmission
@@ -297,8 +385,8 @@ def soak_main(args) -> int:
         sup.register(f"actor_h{h}", spawn_host(h), epoch=0,
                      meta={"role_host": h})
 
-    version = 0
-    mailbox.publish(version, step=0)
+    version = 1
+    publish_weights(version, step=0)
     frames = 0
     step = 0
     readmitted: dict = {}  # host -> readmit epoch
@@ -366,7 +454,7 @@ def soak_main(args) -> int:
                         post_readmit_draw = True
                 if step % args.publish_every == 0:
                     version += 1
-                    mailbox.publish(version, step=step)
+                    publish_weights(version, step=step)
                     registry.gauge("weights_version", "soak").set(version)
             # 3. lease edges -> degrade / heal
             dead, alive = monitor.poll()
@@ -459,8 +547,11 @@ def soak_main(args) -> int:
             failures.append("epoch fence never rejected a stale spool row")
 
     # fence law, asserted from the actors' OWN rows: an actor may lag, but
-    # must never ACT past the budget
+    # must never ACT past the budget.  The same sweep collects the
+    # subscriber adoptions (the quantized consumer path's evidence).
     fence_rows = 0
+    fence_rows_with_game = 0
+    adopt_rows = []  # (file, row) for every subscriber adoption
     for name in sorted(os.listdir(run_dir)):
         if not (name.startswith("actor_h") and name.endswith(".jsonl")):
             continue
@@ -477,9 +568,47 @@ def soak_main(args) -> int:
                         f"{args.max_weight_lag}")
             if row.get("kind") == "actor_fenced":
                 fence_rows += 1
+                if row.get("game"):
+                    fence_rows_with_game += 1
+            if row.get("kind") == "adopt":
+                adopt_rows.append((name, row))
     if seeded and fence_rows == 0:
         failures.append("no actor_fenced row: the staleness fence never "
                         "exercised")
+    if seeded and fence_rows_with_game == 0:
+        failures.append("no actor_fenced row carried its game label (the "
+                        "game-aware lease/fence contract broke)")
+
+    # quantized consumer path (PR-8 follow-up): every adoption any child
+    # reported must be BIT-EXACT with the publisher's own reconstruction
+    # for that version, the slow adopter must have applied multi-packet
+    # chains (gap adoption), and the revived incarnation's fresh
+    # subscriber must have late-joined through base+delta chain replay
+    if not adopt_rows:
+        failures.append("no subscriber adoption: the quantized mailbox "
+                        "consumer path never ran")
+    for name, row in adopt_rows:
+        want = published_digests.get(int(row["version"]))
+        if want is None:
+            failures.append(f"{name}: adopted unpublished version "
+                            f"{row['version']}")
+        elif row.get("checksum") != want:
+            failures.append(
+                f"{name}: adoption of v{row['version']} not bit-exact "
+                f"({row.get('checksum')} != {want})")
+    if args.publish_compression == "int8_delta" and adopt_rows:
+        if not any(int(r["version"]) - int(r.get("prev_version", -1)) > 1
+                   for _n, r in adopt_rows):
+            failures.append("no multi-packet chain adoption (every adopt "
+                            "was a single-delta tail apply)")
+        if seeded and revive_host in readmitted:
+            revived = [r for n, r in adopt_rows
+                       if n.startswith(f"actor_h{revive_host}_e")
+                       and not n.endswith("_e0.jsonl")]
+            if not any(int(r.get("prev_version", 0)) < 0 for r in revived):
+                failures.append(
+                    f"revived host {revive_host} never late-joined via "
+                    "base+delta chain replay (no fresh-subscriber adopt)")
     if seeded and registry.counter("actor_fenced_total", "health").get() == 0:
         failures.append("RunHealth never observed a fence episode (the "
                         "lease-carried fence relay broke)")
@@ -505,6 +634,10 @@ def soak_main(args) -> int:
         "evicted": sup.evicted(),
         "fenced_writes": memory.fenced_writes,
         "fence_rows": fence_rows,
+        "adoptions": len(adopt_rows),
+        "adopt_resyncs": max(
+            (int(r.get("resyncs", 0)) for _n, r in adopt_rows), default=0),
+        "publish_compression": args.publish_compression,
         "final_health": last_health.get("status"),
         "failures": failures,
     }
@@ -535,6 +668,13 @@ def parse_args(argv=None):
     ap.add_argument("--deadline-s", type=float, default=90.0)
     ap.add_argument("--learn-start", type=int, default=64)
     ap.add_argument("--publish-every", type=int, default=5)
+    ap.add_argument("--publish-compression", default="int8_delta",
+                    choices=["int8_delta", "off"],
+                    help="weight-payload codec: int8_delta (default) ships "
+                         "the PR-8 base+delta chain; off ships full bases")
+    ap.add_argument("--publish-base-interval", type=int, default=4,
+                    help="publishes between full base snapshots (short, so "
+                         "revive-time chain replay exercises base+deltas)")
     ap.add_argument("--max-weight-lag", type=int, default=2)
     # respawn knobs default to the Config fields (the single source the
     # docs/RESILIENCE.md table names); the backoff base is raised above the
@@ -561,6 +701,7 @@ def parse_args(argv=None):
     ap.add_argument("--epoch", type=int, default=0, help=argparse.SUPPRESS)
     ap.add_argument("--adopt-every", type=int, default=3,
                     help=argparse.SUPPRESS)
+    ap.add_argument("--game", default="", help=argparse.SUPPRESS)
     ap.add_argument("--max-ticks", type=int, default=100000,
                     help=argparse.SUPPRESS)
     ap.add_argument("--poison", action="store_true", help=argparse.SUPPRESS)

@@ -134,6 +134,40 @@ def _fleet_section(by_kind: Dict[str, List[Dict[str, Any]]]) -> Dict[str, Any]:
     }
 
 
+def _games_section(by_kind: Dict[str, List[Dict[str, Any]]]) -> Dict[str, Any]:
+    """Fold multi-game rows (multitask/; docs/MULTITASK.md): the newest
+    `games` row's per-game learn share / replay occupancy, the latest eval
+    score per game (eval rows keyed by ``game``), and the newest suite
+    human-normalized aggregates.  Empty dict for single-game runs."""
+    games_rows = by_kind.get("games", [])
+    eval_mt = by_kind.get("eval_mt", [])
+    per_game_eval: Dict[str, Dict[str, Any]] = {}
+    for row in by_kind.get("eval", []):
+        if row.get("game"):
+            per_game_eval[str(row["game"])] = row
+    if not (games_rows or eval_mt or per_game_eval):
+        return {}
+    last = games_rows[-1] if games_rows else {}
+    games: Dict[str, Dict[str, Any]] = {}
+    for name, snap in (last.get("games") or {}).items():
+        games[name] = dict(snap)
+    for name, row in per_game_eval.items():
+        entry = games.setdefault(name, {})
+        entry.setdefault("score_mean", row.get("score_mean"))
+        if row.get("human_normalized") is not None:
+            entry.setdefault("human_normalized", row["human_normalized"])
+    agg = eval_mt[-1] if eval_mt else last
+    return {
+        "n": len(games),
+        "schedule": last.get("schedule"),
+        "rows": len(games_rows),
+        "evals": len(eval_mt),
+        "hn_median": agg.get("hn_median"),
+        "hn_mean": agg.get("hn_mean"),
+        "games": games,
+    }
+
+
 def _quant_section(by_kind: Dict[str, List[Dict[str, Any]]]) -> Dict[str, Any]:
     """Fold quant/publish/quant_fallback rows: is the quantized path live,
     what did the gate last measure, and how many publish bytes the delta/
@@ -309,6 +343,9 @@ def aggregate(rows: List[Dict[str, Any]]) -> Dict[str, Any]:
         # quantized inference + compressed distribution: gate agreement,
         # fallback count, publish bytes saved vs fp32-full
         "quant": _quant_section(by_kind),
+        # multi-game runs (multitask/): per-game learn share / replay
+        # occupancy / latest eval + suite human-normalized aggregates
+        "games": _games_section(by_kind),
         "shed_total": shed_total,
         "final_eval": {
             k: v for k, v in last_eval.items()
@@ -421,6 +458,21 @@ def render(report: Dict[str, Any]) -> str:
             f"bytes={q['publish_bytes_total']} "
             f"(saved_frac={q['bytes_saved_frac']})"
         )
+    mg = report.get("games") or {}
+    if mg:
+        lines.append(
+            f"games:   n={mg['n']} schedule={mg['schedule']} "
+            f"rows={mg['rows']} evals={mg['evals']} "
+            f"hn_median={mg['hn_median']} hn_mean={mg['hn_mean']}"
+        )
+        for name, snap in sorted(mg["games"].items()):
+            lines.append(
+                f"  game {name}: learn_share={snap.get('learn_share')} "
+                f"occupancy={snap.get('replay_occupancy')} "
+                f"eval={snap.get('score_mean')} "
+                f"hn={snap.get('human_normalized')}"
+                + (" DEAD" if snap.get("dead") else "")
+            )
     e = report["elastic"]
     if any(e.values()):
         lines.append(

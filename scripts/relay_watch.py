@@ -226,6 +226,14 @@ def health_attribution(metrics_glob) -> dict:
     # the one-line critical_path echo below — a soak postmortem reads WHICH
     # stage bounded the phase straight off its phase_done row
     trace = {"span_link": 0, "lag": 0}
+    # multi-game rows (multitask/; docs/MULTITASK.md): a phase that drove a
+    # multi-game run gets its per-game story attributed — how many games
+    # ran, each game's latest eval + human-normalized score, and the suite
+    # aggregate, straight off the phase_done row (the "one game collapsed
+    # while others train" postmortem key)
+    games_tally = {"games": 0, "eval_mt": 0}
+    by_game: dict = {}
+    last_hn = None
     span_rows = []
     last = None
     for path in sorted(_glob.glob(metrics_glob)):
@@ -248,6 +256,18 @@ def health_attribution(metrics_glob) -> dict:
                         fleet[kind] += 1
                     elif kind in quant:
                         quant[kind] += 1
+                    elif kind in games_tally:
+                        games_tally[kind] += 1
+                        if kind == "eval_mt":
+                            last_hn = {"hn_median": row.get("hn_median"),
+                                       "hn_mean": row.get("hn_mean")}
+                    elif kind == "eval" and row.get("game"):
+                        snap = by_game.setdefault(
+                            str(row["game"]), {"evals": 0})
+                        snap["evals"] += 1
+                        snap["score_mean"] = row.get("score_mean")
+                        if row.get("human_normalized") is not None:
+                            snap["human_normalized"] = row["human_normalized"]
                     elif kind in trace:
                         trace[kind] += 1
                         # bounded retention: the echo needs stage shares,
@@ -261,10 +281,14 @@ def health_attribution(metrics_glob) -> dict:
     order = {"ok": 0, "degraded": 1, "failing": 2}
     worst = max((s for s, n in counts.items() if n),
                 key=lambda s: order[s], default=None)
-    return {"rows": sum(counts.values()), "counts": counts,
-            "last": last, "worst": worst, "heals": heals, "fleet": fleet,
-            "quant": quant, "trace": trace,
-            "critical_path": _critical_path_echo(span_rows)}
+    out = {"rows": sum(counts.values()), "counts": counts,
+           "last": last, "worst": worst, "heals": heals, "fleet": fleet,
+           "quant": quant, "trace": trace,
+           "critical_path": _critical_path_echo(span_rows)}
+    if games_tally["games"] or games_tally["eval_mt"] or by_game:
+        out["games"] = {**games_tally, "by_game": by_game,
+                        "aggregate": last_hn}
+    return out
 
 
 def _critical_path_echo(span_rows):
