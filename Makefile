@@ -2,7 +2,7 @@
 
 PY ?= python
 
-.PHONY: test test-fast serve-smoke serve-bench chaos-smoke obs-smoke soak-smoke perf-smoke fleet-smoke quant-smoke trace-smoke multitask-smoke
+.PHONY: test test-fast serve-smoke serve-bench chaos-smoke obs-smoke soak-smoke perf-smoke fleet-smoke quant-smoke trace-smoke multitask-smoke net-smoke
 
 # tier-1: fast unit + integration tests on the virtual 8-device CPU mesh
 test-fast:
@@ -33,6 +33,27 @@ fleet-smoke:
 	JAX_PLATFORMS=cpu $(PY) scripts/bench_serve.py --fleet-soak \
 	  --engines 2 --duration 8 --out /tmp/ria_fleet_smoke
 	$(PY) scripts/lint_jsonl.py /tmp/ria_fleet_smoke
+
+# cross-host serving smoke (docs/SERVING.md "cross-host"): the `net`-marked
+# unit tests (frame codec hardening, transport/registry/gossip/rollout over
+# real loopback sockets — tier-1 too), then the REAL multi-process fleet:
+# 2 shared-nothing routers (gossip-federated) over 3 engine-host processes
+# discovered purely via lease files, one host SIGKILLed mid-load; gates
+# (self-asserted, exit 1): zero lost accepted requests, re-route fired, the
+# int8-delta rollout converged on every survivor with BIT-EXACT
+# reconstruction asserted by digest, and the run dir lints as strict
+# schema-versioned JSONL (route/net/gossip/rollout rows included); then the
+# --net soak variant records the wire-rollout byte ratio as one net_soak row
+net-smoke:
+	JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_net.py -q -m net
+	rm -rf /tmp/ria_net_smoke
+	JAX_PLATFORMS=cpu $(PY) scripts/net_smoke.py --engines 3 --routers 2 \
+	  --duration 6 --out /tmp/ria_net_smoke
+	$(PY) scripts/lint_jsonl.py /tmp/ria_net_smoke
+	rm -rf /tmp/ria_net_soak
+	JAX_PLATFORMS=cpu $(PY) scripts/bench_serve.py --fleet-soak --net \
+	  --engines 2 --duration 8 --out /tmp/ria_net_soak
+	$(PY) scripts/lint_jsonl.py /tmp/ria_net_soak
 
 # chaos smoke: every named fault-injection point exercised end to end
 # (NaN rollback, corrupt-checkpoint fallback, torn-snapshot CRC, retried

@@ -259,6 +259,27 @@ class Config:
     fleet_scale_patience: int = 3  # consecutive breaches before acting
     fleet_scale_cooldown_s: float = 10.0  # hold after any scale action
 
+    # ---- cross-host serving plane (serving/net/; docs/SERVING.md "cross-host") ----
+    serve_net_host: str = ""  # bind address for this engine's framed-socket
+    # TransportServer ("" = cross-host serving OFF, the default: the fleet
+    # stays in-process and every code path is bitwise the pre-net behaviour;
+    # "0.0.0.0" binds all interfaces and advertises serve_net_advertise)
+    serve_net_port: int = 0  # listen port; 0 = ephemeral — the engine's
+    # lease payload advertises whatever was bound, so routers discover the
+    # endpoint through the lease files they already watch
+    serve_net_advertise: str = ""  # address peers dial ("" = the bind host;
+    # set it when binding a wildcard or behind NAT)
+    serve_net_max_frame_mb: int = 64  # frames declaring more than this are
+    # rejected BEFORE allocation with a reasoned error (serving/net/framing)
+    serve_net_probe_timeout_s: float = 0.5  # bounded per-probe budget for
+    # registry transport-liveness pings — one hung remote can never stall
+    # the discovery/eviction sweep past this
+    serve_net_probe_interval_s: float = 1.0  # per-engine probe cadence
+    serve_net_gossip_port: int = 0  # router-federation UDP bind; 0 = ephemeral
+    serve_net_gossip_peers: str = ""  # comma "host:port" list of peer
+    # routers; "" = solo router, federation off (no gossip socket at all)
+    serve_net_gossip_interval_s: float = 1.0  # snapshot broadcast cadence
+
     # ---- evaluation (SURVEY §2 row 9) ---------------------------------------------
     eval_episodes: int = 10
     eval_interval: int = 50_000  # learner steps between in-training evals; 0 = off

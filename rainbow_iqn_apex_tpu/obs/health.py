@@ -133,6 +133,26 @@ class RunHealth:
                     self._win_faults["route_lost"] += lost
             if shed:
                 self.registry.counter("shed_total", "router").inc(shed)
+        elif kind == "net":
+            # cross-host transport flaps (serving/net/): a disconnect /
+            # reconnect / bounded-probe timeout means remote capacity
+            # silently came or went this window — requests survived (the
+            # re-route invariant), but a human should know the wire is
+            # churning; a reconnect STORM holds the run degraded window
+            # after window exactly like a crash-looping actor
+            event = row.get("event")
+            if event in ("disconnect", "reconnect", "probe_timeout",
+                         "bad_frame"):
+                with self._lock:
+                    self.fault_counts["net_flap"] += 1
+                    self._win_faults["net_flap"] += 1
+                self.registry.counter("net_flaps_total", "health").inc()
+        elif kind == "gossip":
+            # federation visibility only: stale peers skew dispatch but the
+            # router stays correct (its own view is authoritative), so the
+            # row feeds gauges, not degradation
+            self.registry.gauge("gossip_peers_fresh", "health").set(
+                int(row.get("fresh", 0) or 0))
         elif kind == "scale":
             # a scale action is a sizing decision, not a degradation; count
             # it and track the fleet size for the health row's gauges

@@ -56,6 +56,17 @@ REQUIRED_KEYS: Dict[str, frozenset] = {
     "scale": frozenset({"action", "engines"}),  # one autoscaler decision
     "rollout": frozenset({"event", "version"}),  # fleet weight rollout
     # (event: publish/sync/converged/refused_backward)
+    # cross-host serving plane rows (serving/net/; docs/SERVING.md
+    # "cross-host"):
+    "net": frozenset({"event"}),  # transport lifecycle + stats (event:
+    # connect/disconnect/reconnect/probe_timeout/bad_frame carry `peer` and
+    # `engine`; event "stats" is the periodic per-peer snapshot with
+    # rtt_ms/reconnects/bytes_sent/bytes_recv — obs_report's `net:` input.
+    # RunHealth folds the flap events as window-degraded: a reconnect storm
+    # is capacity silently coming and going)
+    "gossip": frozenset({"peers"}),  # router-federation health: declared
+    # peers vs fresh/stale snapshot counts + sent/received/bad_frames —
+    # a federated router whose peers all read stale is dispatching blind
     # quantization rows (utils/quantize.py; docs/PERFORMANCE.md "quant"):
     "publish": frozenset({"version", "bytes"}),  # one weight publish
     # (carries bytes_fp32 + mode ("int8"/"fp8"/"bf16"/"fp32") + quant_active

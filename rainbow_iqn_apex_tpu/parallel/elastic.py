@@ -205,6 +205,12 @@ class Lease:
     lanes: int = 0  # engine mesh width (dispatch weight denominator)
     buckets: Tuple[int, ...] = ()  # padded batch sizes the engine compiled
     queue_depth: int = -1  # engine request-queue depth at the last renewal
+    # cross-host serving plane (serving/net/): where this engine's
+    # TransportServer listens.  "" / 0 = in-process only — the registry
+    # attaches no remote transport and the engine is visible-but-unroutable
+    # from other hosts, exactly the pre-net behaviour
+    addr: str = ""
+    port: int = 0
     # multi-game payload (multitask/): the game (or comma-joined game set)
     # this host's lanes are pinned to — RoleSupervisor respawn decisions and
     # fence monitors stay game-aware without a second discovery channel
@@ -278,6 +284,8 @@ class HeartbeatMonitor:
                 buckets=tuple(int(b) for b in payload.get("buckets") or ()),
                 queue_depth=int(payload.get("queue_depth", -1)),
                 game=payload.get("game"),
+                addr=str(payload.get("addr", "") or ""),
+                port=int(payload.get("port", 0) or 0),
             )
         return out
 

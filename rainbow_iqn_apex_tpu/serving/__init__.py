@@ -25,6 +25,11 @@ _EXPORTS = {
     "parse_buckets": "rainbow_iqn_apex_tpu.serving.engine",
     "ServeMetrics": "rainbow_iqn_apex_tpu.serving.metrics",
     "PolicyServer": "rainbow_iqn_apex_tpu.serving.server",
+    # cross-host serving plane (serving/net/): jax-free socket transport
+    "RemoteEngine": "rainbow_iqn_apex_tpu.serving.net.client",
+    "RemoteTransport": "rainbow_iqn_apex_tpu.serving.net.client",
+    "RouterGossip": "rainbow_iqn_apex_tpu.serving.net.gossip",
+    "TransportServer": "rainbow_iqn_apex_tpu.serving.net.server",
     "CheckpointWatcher": "rainbow_iqn_apex_tpu.serving.swap",
     "params_template": "rainbow_iqn_apex_tpu.serving.swap",
     "restore_params": "rainbow_iqn_apex_tpu.serving.swap",
@@ -61,6 +66,12 @@ if TYPE_CHECKING:  # static analyzers see the eager imports
         parse_buckets,
     )
     from rainbow_iqn_apex_tpu.serving.metrics import ServeMetrics  # noqa: F401
+    from rainbow_iqn_apex_tpu.serving.net.client import (  # noqa: F401
+        RemoteEngine,
+        RemoteTransport,
+    )
+    from rainbow_iqn_apex_tpu.serving.net.gossip import RouterGossip  # noqa: F401
+    from rainbow_iqn_apex_tpu.serving.net.server import TransportServer  # noqa: F401
     from rainbow_iqn_apex_tpu.serving.server import PolicyServer  # noqa: F401
     from rainbow_iqn_apex_tpu.serving.swap import (  # noqa: F401
         CheckpointWatcher,

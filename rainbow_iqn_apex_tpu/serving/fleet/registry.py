@@ -50,6 +50,19 @@ class EngineDead(RuntimeError):
     """Raised by a transport whose engine is gone (lease expired / killed)."""
 
 
+def _params_digest(params: Any) -> Optional[str]:
+    """Best-effort sha256 of an adopted fp32 tree (the cross-host rollout's
+    bit-exactness witness).  Module-level, not a method: engine fakes
+    borrow the adopt methods unbound (tests/test_quantize.py), so the
+    digest must not depend on the instance."""
+    from rainbow_iqn_apex_tpu.utils.quantize import tree_digest
+
+    try:
+        return tree_digest(params)
+    except Exception:
+        return None  # a digest failure must never fail the adopt itself
+
+
 class ServerTransport:
     """In-process transport protocol over a `PolicyServer`.
 
@@ -107,6 +120,11 @@ class EngineHandle:
     # engine's final lease stays fresh for up to the timeout, and an aborted
     # queue reads depth 0, so a resurrected corpse would rank FIRST)
     suspect_since: Optional[float] = None
+    # True when the suspicion came from a TRANSPORT probe (serving/net):
+    # the engine process may be alive and beating while its serve plane is
+    # wedged, so heartbeats must NOT rehabilitate it — only a later
+    # successful probe does.  mark_dead suspicion stays beat-clearable.
+    suspect_probe: bool = False
 
     @property
     def routable(self) -> bool:
@@ -154,6 +172,14 @@ class FleetEngine:
         )
         self.writer.update_payload(
             lanes=self.transport.lanes, buckets=list(self.transport.buckets))
+        # the cross-host rollout's bit-exactness witness: sha256 of the
+        # fp32 params this engine currently serves (TransportServer
+        # piggybacks it on pongs; net_smoke gates on it).  Computed LAZILY
+        # on first `served_digest` read per adopted version — an in-process
+        # fleet with no TransportServer never reads it and pays nothing
+        # (hashing a real-size tree per engine per publish is not free)
+        self._served_params: Optional[Any] = None
+        self._served_digest: Optional[str] = None
 
     def _lease_payload(self) -> Dict[str, Any]:
         return {
@@ -202,7 +228,14 @@ class FleetEngine:
         self.server.load_params(params)
         self.transport.set_version(version)
         self.writer.set_weight_version(version)
+        self._served_params, self._served_digest = params, None
         return version
+
+    @property
+    def served_digest(self) -> Optional[str]:
+        if self._served_digest is None and self._served_params is not None:
+            self._served_digest = _params_digest(self._served_params)
+        return self._served_digest
 
     # delta-compressed rollout (utils/quantize.py; FleetRollout
     # compression="int8_delta"): the engine holds a DeltaDecoder whose
@@ -230,6 +263,7 @@ class FleetEngine:
         self.server.load_params(params)
         self.transport.set_version(version)
         self.writer.set_weight_version(version)
+        self._served_params, self._served_digest = params, None
         return version
 
     def adopt_chain(self, packets: Any) -> int:
@@ -247,6 +281,7 @@ class FleetEngine:
             self.server.load_params(params)
             self.transport.set_version(decoder.version)
             self.writer.set_weight_version(decoder.version)
+            self._served_params, self._served_digest = params, None
         return decoder.version
 
 
@@ -276,13 +311,31 @@ class EngineRegistry:
 
     def __init__(self, heartbeat_dir: Optional[str] = None,
                  lease_timeout_s: float = 3.0,
-                 logger=None, obs_registry=None):
+                 logger=None, obs_registry=None,
+                 transport_factory=None,
+                 probe_timeout_s: float = 0.5,
+                 probe_interval_s: float = 1.0,
+                 net_stats_interval_s: float = 5.0):
         self.monitor = (
             HeartbeatMonitor(heartbeat_dir, timeout_s=lease_timeout_s)
             if heartbeat_dir else None
         )
         self.logger = logger
         self.obs_registry = obs_registry
+        # cross-host discovery (serving/net/): when a factory is given, an
+        # engine lease advertising addr:port gets a remote transport built
+        # from it — `lease -> transport` is the whole discovery story, no
+        # second protocol.  None (default) keeps the registry lease-only:
+        # remote leases stay visible-but-unroutable, bitwise the old path.
+        self.transport_factory = transport_factory
+        # transport-liveness probes are BOUNDED per probe: a hung remote
+        # (SYN-accepted, wedged engine) costs the sweep at most
+        # probe_timeout_s, never a stall — and only every probe_interval_s
+        self.probe_timeout_s = float(probe_timeout_s)
+        self.probe_interval_s = float(probe_interval_s)
+        self.net_stats_interval_s = float(net_stats_interval_s)
+        self._t_probe: Dict[int, float] = {}
+        self._t_net_stats = 0.0
         self._lock = threading.Lock()
         self._handles: Dict[int, EngineHandle] = {}
 
@@ -298,6 +351,7 @@ class EngineRegistry:
             handle.transport = transport
             handle.alive = True
             handle.suspect_since = None  # a fresh transport is a new start
+            handle.suspect_probe = False
         self._observe()
         return handle
 
@@ -343,11 +397,46 @@ class EngineRegistry:
                             events.append({"event": "engine_alive",
                                            "engine": hid,
                                            "epoch": lease.epoch})
+                    if (lease.fresh and lease.addr and lease.port
+                            and self.transport_factory is not None):
+                        # cross-host discovery: the lease advertises where
+                        # the engine's TransportServer listens; the factory
+                        # returns a LAZY client (no dial here — the first
+                        # probe/submit connects, bounded).  A FRESH lease
+                        # advertising a NEW endpoint (supervisor respawned
+                        # the host on another ephemeral port) REPLACES the
+                        # old remote transport: keeping it would dial the
+                        # dead port forever, and probe suspicion — which
+                        # only a successful probe clears — would fence the
+                        # healthy respawn out permanently.
+                        old = handle.transport
+                        endpoint_moved = (
+                            old is not None
+                            and hasattr(old, "host") and hasattr(old, "port")
+                            and (old.host, old.port) != (lease.addr,
+                                                         lease.port))
+                        if handle.transport is None or endpoint_moved:
+                            try:
+                                handle.transport = self.transport_factory(
+                                    lease)
+                            except Exception:
+                                pass  # mis-advertised lease: unroutable
+                            else:
+                                handle.suspect_since = None  # new endpoint
+                                handle.suspect_probe = False  # = new start
+                                if endpoint_moved and hasattr(old, "close"):
+                                    try:
+                                        old.close()
+                                    except Exception:
+                                        pass
                     handle.lease = lease
-                    if handle.suspect_since is not None:
+                    if (handle.suspect_since is not None
+                            and not handle.suspect_probe):
                         # only a beat WRITTEN after the mark_dead observation
                         # rehabilitates the engine — the stale-but-fresh
-                        # final lease of a killed process does not
+                        # final lease of a killed process does not.  PROBE
+                        # suspicion is exempt entirely: a wedged serve plane
+                        # keeps beating, so only a good probe clears it.
                         if now - lease.age_s > handle.suspect_since:
                             handle.suspect_since = None
                     handle.alive = (lease.fresh
@@ -375,11 +464,80 @@ class EngineRegistry:
                     elif now and not was:
                         events.append({"event": "engine_alive",
                                        "engine": handle.engine_id})
+        self._probe_remotes()
+        self._emit_net_stats()
         if self.logger is not None:
             for ev in events:
                 self.logger.log("fault", **ev)
         self._observe()
         return events
+
+    def _probe_remotes(self) -> None:
+        """Transport-liveness sweep over remote transports: each probe is
+        bounded at ``probe_timeout_s`` (a hung remote can never stall
+        discovery/eviction), rate-limited to ``probe_interval_s`` per
+        engine.  A failed probe marks the engine suspect exactly like
+        ``mark_dead`` — only a lease beat written AFTER the observation (or
+        a later successful probe) rehabilitates it."""
+        now = time.time()
+        with self._lock:
+            due = [h for h in self._handles.values()
+                   if h.transport is not None
+                   and hasattr(h.transport, "probe")
+                   and (h.lease is None or h.lease.fresh)
+                   and now - self._t_probe.get(h.engine_id, 0.0)
+                   >= self.probe_interval_s]
+        def probe_one(handle: EngineHandle) -> None:
+            rtt = handle.transport.probe(timeout_s=self.probe_timeout_s)
+            with self._lock:
+                if rtt is None:
+                    handle.alive = False
+                    handle.suspect_since = time.time()
+                    handle.suspect_probe = True
+                else:
+                    handle.suspect_since = None
+                    handle.suspect_probe = False
+                    handle.alive = (handle.lease is None
+                                    or handle.lease.fresh)
+            if rtt is not None and self.obs_registry is not None:
+                self.obs_registry.gauge(
+                    f"net_rtt_ms_engine{handle.engine_id}", "net").set(rtt)
+
+        # probes for DISTINCT engines run concurrently: serial probing
+        # would stall the sweep M x timeout during a rack outage — exactly
+        # when fast eviction/re-route matters most.  Each probe is bounded,
+        # so the whole fan-out is ~one probe_timeout_s.
+        threads = []
+        for handle in due:
+            self._t_probe[handle.engine_id] = now
+            if len(due) == 1:
+                probe_one(handle)
+            else:
+                t = threading.Thread(target=probe_one, args=(handle,),
+                                     name="net-probe", daemon=True)
+                t.start()
+                threads.append(t)
+        for t in threads:
+            t.join(timeout=self.probe_timeout_s + 1.0)
+
+    def _emit_net_stats(self) -> None:
+        """One periodic `net` stats row per remote transport (per-peer
+        rtt/reconnects/bytes — obs_report's ``net:`` section input)."""
+        if self.logger is None or self.net_stats_interval_s <= 0:
+            return
+        now = time.time()
+        if now - self._t_net_stats < self.net_stats_interval_s:
+            return
+        self._t_net_stats = now
+        with self._lock:
+            transports = [h.transport for h in self._handles.values()
+                          if h.transport is not None
+                          and hasattr(h.transport, "stats")]
+        for transport in transports:
+            try:
+                self.logger.log("net", event="stats", **transport.stats())
+            except Exception:
+                pass
 
     def mark_dead(self, engine_id: int) -> None:
         """Immediate eviction (a dispatch observed the engine dead) — faster
@@ -392,6 +550,8 @@ class EngineRegistry:
             if handle is not None:
                 handle.alive = False
                 handle.suspect_since = time.time()
+                handle.suspect_probe = False  # death suspicion: a beat
+                # written after the observation DOES rehabilitate
         self._observe()
 
     # ----------------------------------------------------------------- stats

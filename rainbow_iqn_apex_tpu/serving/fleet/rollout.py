@@ -94,6 +94,22 @@ class FleetRollout:
         engine lag against."""
         return self.target_version
 
+    def reconstructed_digest(self) -> Optional[str]:
+        """sha256 of the fp32 tree every in-sync subscriber should hold —
+        compared against each engine's ``served_digest`` to assert the
+        cross-host rollout landed bit-exact (net_smoke's convergence gate).
+        Compressed rollouts digest the encoder's closed-loop reconstruction;
+        uncompressed ones digest the target params directly.  None before
+        the first publish."""
+        from rainbow_iqn_apex_tpu.utils.quantize import tree_digest
+
+        with self._lock:
+            if self._codec is not None and self._codec.version >= 0:
+                return tree_digest(self._codec.reconstructed())
+            if self._target_params is not None:
+                return tree_digest(self._target_params)
+        return None
+
     # ---------------------------------------------------------------- publish
     def _row(self, event: str, **fields: Any) -> Dict[str, Any]:
         row = {"event": event, "version": self.target_version, **fields}

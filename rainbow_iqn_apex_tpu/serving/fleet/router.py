@@ -181,6 +181,8 @@ class FrontRouter:
         reroute_window_s: float = 2.0,
         clock: Callable[[], float] = time.monotonic,
         tracer=None,
+        peer_inflight_fn: Optional[Callable[[int], int]] = None,
+        peer_target_fn: Optional[Callable[[], int]] = None,
     ):
         self.registry = registry
         # pipeline tracing (obs/pipeline_trace.py): always-on admit->dispatch
@@ -206,6 +208,17 @@ class FrontRouter:
         self.poll_interval_s = float(poll_interval_s)
         self.reroute_window_s = float(reroute_window_s)
         self.clock = clock
+        # router federation (serving/net/gossip.py): load OTHER routers
+        # gossiped for an engine joins this router's own inflight in the
+        # least-depth score, so N shared-nothing fronts don't pile onto the
+        # same engine between lease renewals.  None = solo router, the
+        # pre-federation arithmetic bitwise.
+        self.peer_inflight_fn = peer_inflight_fn
+        # federated fence target (RouterGossip.peer_target_version): the
+        # freshest rollout target any peer router claims joins this
+        # router's own via max(), so a router that missed a publish still
+        # fences engines against the fleet's truth.  None = local only.
+        self.peer_target_fn = peer_target_fn
         self._lock = threading.Lock()
         self._closed = False
         self._buckets: Dict[str, TokenBucket] = {}
@@ -322,16 +335,28 @@ class FrontRouter:
                 self._fences[h.engine_id] = fence
             if not fence.observe(h.version(), target, frames_at_stake=1):
                 continue
-            score = (h.depth() + inflight.get(h.engine_id, 0)) / h.lanes
+            peer_load = (self.peer_inflight_fn(h.engine_id)
+                         if self.peer_inflight_fn is not None else 0)
+            score = (h.depth() + inflight.get(h.engine_id, 0)
+                     + peer_load) / h.lanes
             ranked.append((score, h.engine_id, h))
         ranked.sort(key=lambda t: t[:2])
         return [h for _, _, h in ranked]
 
-    def target_version(self) -> int:
+    def _local_target_version(self) -> int:
+        """This router's OWN view of the rollout target — what it gossips.
+        Peers fold it in at READ time (target_version), never re-broadcast
+        it: gossiping the federated max would echo a stale high claim
+        between routers forever, past any staleness expiry."""
         if self._target_version_fn is not None:
             return int(self._target_version_fn())
         versions = [h.version() for h in self.registry.routable()]
         return max(versions, default=0)
+
+    def target_version(self) -> int:
+        peer = (int(self.peer_target_fn())
+                if self.peer_target_fn is not None else 0)
+        return max(self._local_target_version(), peer)
 
     def _dispatch(self, rf: RoutedFuture) -> bool:
         """Try engines least-depth first; bind the first that takes it."""
@@ -571,6 +596,24 @@ class FrontRouter:
     def inflight(self) -> int:
         with self._lock:
             return self._inflight_total
+
+    def engine_inflight(self) -> Dict[int, int]:
+        """This router's own in-flight count per engine (what it gossips)."""
+        with self._lock:
+            return dict(self._inflight_engine)
+
+    def gossip_snapshot(self) -> Dict[str, Any]:
+        """The federation snapshot `RouterGossip.snapshot_fn` broadcasts:
+        per-engine inflight + the rollout target this router fences
+        against.  Peers fold the inflight into their dispatch weights and
+        max() the target into their fences."""
+        with self._lock:
+            inflight = {str(k): v for k, v in self._inflight_engine.items()
+                        if v}
+            accepted = self.totals["accepted"]
+        return {"inflight": inflight,
+                "target_version": self._local_target_version(),
+                "accepted": accepted}
 
     # -------------------------------------------------------------- lifecycle
     def _run(self) -> None:

@@ -1,0 +1,34 @@
+"""serving/net: the cross-host serving plane (docs/SERVING.md "cross-host").
+
+Zero-dependency socket transport — length-prefixed CRC-checked frames over
+stdlib ``socket``/``selectors`` — filling the `ServerTransport` protocol
+seam in serving/fleet/registry.py, so a `FrontRouter` on host A dispatches
+to `FleetEngine`s on hosts B..N:
+
+- `framing`     — the frame codec (torn-read/oversize/checksum hardening)
+- `RemoteTransport` / `RemoteEngine` — the router/rollout-side client
+- `TransportServer` — the engine-side listener (lease advertises addr:port)
+- `RouterGossip` — shared-nothing router federation over UDP snapshots
+
+Everything here is jax-free: router front-ends and gossip daemons own no
+device runtime.  With no ``serve_net_*`` config set nothing in this package
+is constructed and the in-process fleet path is untouched.
+"""
+
+from rainbow_iqn_apex_tpu.serving.net import framing
+from rainbow_iqn_apex_tpu.serving.net.client import (
+    RemoteEngine,
+    RemoteFuture,
+    RemoteTransport,
+)
+from rainbow_iqn_apex_tpu.serving.net.gossip import RouterGossip
+from rainbow_iqn_apex_tpu.serving.net.server import TransportServer
+
+__all__ = [
+    "framing",
+    "RemoteEngine",
+    "RemoteFuture",
+    "RemoteTransport",
+    "RouterGossip",
+    "TransportServer",
+]

@@ -1,0 +1,523 @@
+"""Client half of the cross-host serving plane: `RemoteTransport` fills the
+`ServerTransport` protocol seam in serving/fleet/registry.py with a real
+socket, so a `FrontRouter` on host A dispatches to engines on hosts B..N
+through exactly the surface it already speaks — submit/depth/alive/version/
+lanes — and `RemoteEngine` gives `FleetRollout` the same adopt/adopt_packet/
+adopt_chain surface over the wire.
+
+Design points:
+
+- **one connection, demultiplexed**: a reader thread parses result frames
+  and settles the matching `ServeFuture` by request id; request submission
+  waits only for the engine's ACCEPT/SHED ack (one RTT), so the router's
+  synchronous shed-probe semantics survive the network hop.
+- **connection loss fails fast**: every in-flight future is settled with
+  `EngineDead` the moment the socket dies — the router's re-route path
+  treats that exactly like an in-process engine kill (accepted requests
+  re-dispatch to survivors; zero-loss invariant intact).
+- **reconnect-with-backoff**: re-dials ride the shared `RetryPolicy`
+  schedule (utils/faults.py — the one backoff training IO, respawn and
+  hot-swap already share), driven lazily from ``alive()``/``probe()`` so a
+  dead remote costs the registry scan one bounded attempt per due slot, not
+  a spin.
+- **bounded probes**: every connect/probe carries ``probe_timeout_s`` — a
+  hung remote (SYN-accepted but wedged) can never stall the registry's
+  discovery/eviction sweep past its bound.
+
+State the router polls hot (depth/version) is piggybacked on every frame the
+engine sends and refreshed by probes, so ranking N engines costs zero RPCs.
+jax-free by design (the `serving` package front-end contract).
+"""
+
+from __future__ import annotations
+
+import socket
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from rainbow_iqn_apex_tpu.serving.batcher import (
+    ServeFuture,
+    ServerClosed,
+    ServerOverloaded,
+)
+from rainbow_iqn_apex_tpu.serving.fleet.registry import EngineDead
+from rainbow_iqn_apex_tpu.serving.net import framing
+from rainbow_iqn_apex_tpu.utils import quantize
+from rainbow_iqn_apex_tpu.utils.faults import RetryPolicy
+
+# etype strings on the wire -> the exception the caller expects (the same
+# types the in-process transport raises, so router/rollout error handling
+# is transport-agnostic)
+_ETYPES: Dict[str, Callable[[str], BaseException]] = {
+    "overloaded": ServerOverloaded,
+    "closed": ServerClosed,
+    "dead": EngineDead,
+    "backward": ValueError,
+    "chain_broken": quantize.DeltaChainBroken,
+    "cancelled": ServerClosed,
+    "unsupported": RuntimeError,
+}
+
+
+def _wire_error(etype: str, msg: str) -> BaseException:
+    return _ETYPES.get(str(etype), RuntimeError)(msg)
+
+
+class RemoteFuture(ServeFuture):
+    """A `ServeFuture` whose cancel also tells the remote engine to skip the
+    batch slot (best-effort — a lost cancel frame only costs the engine one
+    padded slot, never correctness)."""
+
+    __slots__ = ("_rid", "_transport")
+
+    def __init__(self, obs, rid: int, transport: "RemoteTransport"):
+        super().__init__(obs)
+        self._rid = rid
+        self._transport = transport
+
+    def cancel(self) -> bool:
+        won = super().cancel()
+        if won:
+            self._transport._send_cancel(self._rid)
+        return won
+
+
+class _PendingAck:
+    __slots__ = ("event", "ok", "error")
+
+    def __init__(self):
+        self.event = threading.Event()
+        self.ok = False
+        self.error: Optional[BaseException] = None
+
+
+class RemoteTransport:
+    """`ServerTransport`-protocol client over one TCP connection.
+
+    Satisfies the full seam the router and registry speak — ``submit``,
+    ``depth``, ``alive``, ``version``/``set_version``, ``lanes``,
+    ``buckets`` — plus the wire-only ``probe``/``request`` surface the
+    registry's liveness sweep and `RemoteEngine`'s adopts ride on.
+    """
+
+    def __init__(self, host: str, port: int, engine_id: Optional[int] = None,
+                 retry: Optional[RetryPolicy] = None,
+                 probe_timeout_s: float = 0.5,
+                 ack_timeout_s: float = 5.0,
+                 max_frame_bytes: int = framing.DEFAULT_MAX_FRAME,
+                 logger=None, obs_registry=None,
+                 connect: bool = True):
+        self.host = str(host)
+        self.port = int(port)
+        self.engine_id = engine_id
+        self.peer = f"{self.host}:{self.port}"
+        self.retry = retry if retry is not None else RetryPolicy(
+            attempts=6, base_delay_s=0.2, max_delay_s=5.0)
+        self.probe_timeout_s = float(probe_timeout_s)
+        self.ack_timeout_s = float(ack_timeout_s)
+        self.max_frame_bytes = int(max_frame_bytes)
+        self.logger = logger
+        self.obs_registry = obs_registry
+        # ServerTransport surface defaults until the first pong teaches us
+        self.lanes = 1
+        self.buckets: Tuple[int, ...] = ()
+        self._version = 0
+        self._depth = 0
+        self.digest: Optional[str] = None
+        # counters (the registry's periodic `net` stats row)
+        self.bytes_sent = 0
+        self.bytes_recv = 0
+        self.reconnects = 0
+        self.probe_timeouts = 0
+        self.rtt_ms: Optional[float] = None
+        self._lock = threading.Lock()  # socket lifecycle + pending maps
+        self._wlock = threading.Lock()  # serialises frame writes
+        self._sock: Optional[socket.socket] = None
+        self._reader: Optional[threading.Thread] = None
+        self._gen = 0  # connection generation (reader threads self-retire)
+        self._rid = 0
+        self._pending: Dict[int, ServeFuture] = {}
+        self._acks: Dict[int, _PendingAck] = {}
+        self._ever_connected = False
+        self._closed = False
+        # reconnect backoff state: the shared RetryPolicy schedule, clamped
+        # at its last delay (a dead remote is retried forever at the ceiling
+        # — eviction is the REGISTRY's call via the lease, not the socket's)
+        self._delays = list(self.retry.delays()) or [self.retry.base_delay_s]
+        self._fail_streak = 0
+        self._next_dial = 0.0
+        if connect:
+            # eager best-effort dial (bounded): callers that want pure-lazy
+            # construction (the registry's discovery factory, built under
+            # its lock) pass connect=False and the first probe/submit dials
+            self.connect()
+
+    # ---------------------------------------------------------- connection
+    def _log(self, event: str, **fields: Any) -> None:
+        if self.logger is not None:
+            try:
+                self.logger.log("net", event=event, peer=self.peer,
+                                engine=self.engine_id, **fields)
+            except Exception:
+                pass  # telemetry must never break the transport
+
+    def _count(self, name: str) -> None:
+        if self.obs_registry is not None:
+            self.obs_registry.counter(name, "net").inc()
+
+    def connect(self, timeout_s: Optional[float] = None) -> bool:
+        """One bounded dial attempt; True when a connection is live."""
+        with self._lock:
+            if self._closed:
+                return False
+            if self._sock is not None:
+                return True
+        try:
+            sock = socket.create_connection(
+                (self.host, self.port),
+                timeout=self.probe_timeout_s if timeout_s is None
+                else timeout_s)
+            sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            sock.settimeout(None)  # reader blocks; writes are sendall
+        except OSError:
+            with self._lock:
+                self._fail_streak += 1
+                delay = self._delays[
+                    min(self._fail_streak - 1, len(self._delays) - 1)]
+                self._next_dial = time.monotonic() + delay
+            return False
+        with self._lock:
+            if self._closed:
+                sock.close()
+                return False
+            self._sock = sock
+            self._gen += 1
+            gen = self._gen
+            self._fail_streak = 0
+            reconnected = self._ever_connected
+            self._ever_connected = True
+            if reconnected:
+                self.reconnects += 1
+        self._reader = threading.Thread(
+            target=self._read_loop, args=(sock, gen),
+            name=f"net-client-{self.peer}", daemon=True)
+        self._reader.start()
+        self._log("reconnect" if reconnected else "connect")
+        if reconnected:
+            self._count("net_reconnects_total")
+        return True
+
+    def _ensure_connected(self) -> bool:
+        """Connected, or one dial attempt if the backoff schedule is due."""
+        with self._lock:
+            if self._sock is not None:
+                return True
+            if self._closed or time.monotonic() < self._next_dial:
+                return False
+        return self.connect()
+
+    def connected(self) -> bool:
+        with self._lock:
+            return self._sock is not None
+
+    def _drop(self, sock: socket.socket, gen: int, why: str) -> None:
+        """Tear the connection down once; fail every in-flight request."""
+        with self._lock:
+            if gen != self._gen or self._sock is not sock:
+                return  # an older generation already replaced
+            self._sock = None
+            pending, self._pending = self._pending, {}
+            acks, self._acks = self._acks, {}
+            self._next_dial = time.monotonic()  # first re-dial is immediate
+        try:
+            sock.close()
+        except OSError:
+            pass
+        err = EngineDead(f"connection to engine {self.peer} lost ({why})")
+        for ack in acks.values():
+            ack.error = err
+            ack.event.set()
+        for fut in pending.values():
+            fut.set_error(err)
+        if not self._closed:
+            self._log("disconnect", why=why, inflight=len(pending))
+            self._count("net_disconnects_total")
+
+    def close(self) -> None:
+        with self._lock:
+            self._closed = True
+            sock, gen = self._sock, self._gen
+        if sock is not None:
+            self._drop(sock, gen, "closed")
+
+    # ---------------------------------------------------------- frame I/O
+    def _send(self, sock: socket.socket, gen: int,
+              header: Dict[str, Any], blob: bytes = b"") -> None:
+        try:
+            with self._wlock:
+                self.bytes_sent += framing.send_frame(sock, header, blob)
+        except OSError as e:
+            self._drop(sock, gen, f"send failed: {e}")
+            raise EngineDead(
+                f"engine {self.peer} unreachable mid-send: {e}") from e
+
+    def _register(self, fut_factory=None):
+        """Allocate a rid and register its ack (and future) ATOMICALLY with
+        the connection-liveness check: a _drop racing an unlocked
+        registration would swap the maps without failing the new entry,
+        stranding the caller until its timeout (and mislabelling a dead
+        link as a probe_timeout).  Returns (sock, gen, rid, ack, fut)."""
+        ack = _PendingAck()
+        with self._lock:
+            if self._sock is None:
+                raise EngineDead(f"no connection to engine {self.peer}")
+            sock, gen = self._sock, self._gen
+            rid = self._rid = self._rid + 1
+            fut = fut_factory(rid) if fut_factory is not None else None
+            self._acks[rid] = ack
+            if fut is not None:
+                self._pending[rid] = fut
+        return sock, gen, rid, ack, fut
+
+    def _send_cancel(self, rid: int) -> None:
+        with self._lock:
+            self._pending.pop(rid, None)
+            sock, gen = self._sock, self._gen
+        if sock is None:
+            return
+        try:
+            self._send(sock, gen, {"op": "cancel", "rid": rid})
+        except EngineDead:
+            pass  # the engine is gone; nothing left to skip
+
+    def _read_loop(self, sock: socket.socket, gen: int) -> None:
+        while True:
+            try:
+                frame = framing.recv_frame(sock, self.max_frame_bytes)
+            except (OSError, framing.FrameError) as e:
+                self._drop(sock, gen, f"{type(e).__name__}: {e}")
+                return
+            if frame is None:
+                self._drop(sock, gen, "peer closed")
+                return
+            header, blob = frame
+            self.bytes_recv += (framing.PREFIX_BYTES + framing.TRAILER_BYTES
+                                + len(blob) + 64)  # header ~estimated
+            try:
+                self._on_frame(header, blob)
+            except Exception:
+                pass  # one malformed-but-framed reply must not kill the link
+
+    def _refresh(self, header: Dict[str, Any]) -> None:
+        """Fold the state every engine frame piggybacks (depth/version)."""
+        if "depth" in header:
+            self._depth = int(header["depth"])
+        if "version" in header:
+            self._version = int(header["version"])
+        if "lanes" in header:
+            self.lanes = max(int(header["lanes"]), 1)
+        if "buckets" in header:
+            self.buckets = tuple(int(b) for b in header["buckets"])
+        if "digest" in header:
+            self.digest = header["digest"]
+
+    def _on_frame(self, header: Dict[str, Any], blob: bytes) -> None:
+        self._refresh(header)
+        op = header.get("op")
+        rid = header.get("rid")
+        if op == "ack":
+            ack = self._acks.pop(rid, None) if rid is not None else None
+            if ack is not None:
+                ack.ok = bool(header.get("ok"))
+                if not ack.ok:
+                    ack.error = _wire_error(
+                        header.get("etype", "overloaded"),
+                        header.get("msg", f"engine {self.peer} shed"))
+                ack.event.set()
+        elif op == "result":
+            fut = self._pending.pop(rid, None) if rid is not None else None
+            if fut is not None:
+                try:
+                    q = framing.decode_ndarray(
+                        {"dtype": header["dtype"], "shape": header["shape"]},
+                        blob)
+                    action = int(header["action"])
+                except Exception as e:
+                    # a malformed result must SETTLE the future — dropping
+                    # it would hang the caller to its outer deadline
+                    fut.set_error(framing.FrameCorrupt(
+                        f"undecodable result from {self.peer}: "
+                        f"{type(e).__name__}: {e}"))
+                else:
+                    fut.set_result(action, q)
+        elif op == "rerr":
+            fut = self._pending.pop(rid, None) if rid is not None else None
+            if fut is not None:
+                fut.set_error(_wire_error(header.get("etype", ""),
+                                          header.get("msg", "engine error")))
+        elif op in ("pong", "adopt_ok", "adopt_err"):
+            ack = self._acks.pop(rid, None) if rid is not None else None
+            if ack is not None:
+                ack.ok = op != "adopt_err"
+                if not ack.ok:
+                    ack.error = _wire_error(header.get("etype", ""),
+                                            header.get("msg", "adopt failed"))
+                ack.event.set()
+
+    # ------------------------------------------------- ServerTransport seam
+    def submit(self, obs) -> ServeFuture:
+        """One request: send, wait for the engine's accept/shed ack (one
+        RTT), return the future the reader thread will settle.  Sheds raise
+        ``ServerOverloaded`` exactly like the in-process transport, so the
+        router's try-next-engine probe loop is transport-agnostic."""
+        if not self._ensure_connected():
+            raise EngineDead(f"engine {self.peer} unreachable")
+        arr = np.asarray(obs)
+        meta, blob = framing.encode_ndarray(arr)
+        sock, gen, rid, ack, fut = self._register(
+            lambda rid: RemoteFuture(arr, rid, self))
+        self._send(sock, gen, {"op": "submit", "rid": rid, **meta}, blob)
+        if not ack.event.wait(self.ack_timeout_s):
+            self._acks.pop(rid, None)
+            self._pending.pop(rid, None)
+            raise EngineDead(
+                f"engine {self.peer} did not ack within "
+                f"{self.ack_timeout_s}s (hung or dying)")
+        if ack.error is not None:
+            self._pending.pop(rid, None)
+            raise ack.error
+        return fut
+
+    def depth(self) -> int:
+        return self._depth
+
+    def alive(self) -> bool:
+        """Connected, or a due (bounded) re-dial succeeded.  The registry's
+        transport-liveness fallback and the router's routable() both land
+        here; a dead remote costs at most one ``probe_timeout_s`` dial per
+        backoff slot."""
+        if self._closed:
+            return False
+        return self._ensure_connected()
+
+    def version(self) -> int:
+        return self._version
+
+    def set_version(self, version: int) -> None:
+        self._version = int(version)
+
+    # --------------------------------------------------------- wire-only ops
+    def request(self, header: Dict[str, Any], blob: bytes = b"",
+                timeout_s: Optional[float] = None) -> _PendingAck:
+        """One synchronous RPC (ping/adopt): send, wait for the matching
+        reply, return the settled ack.  Raises the mapped wire error."""
+        if not self._ensure_connected():
+            raise EngineDead(f"engine {self.peer} unreachable")
+        sock, gen, rid, ack, _fut = self._register()
+        self._send(sock, gen, {**header, "rid": rid}, blob)
+        budget = self.ack_timeout_s if timeout_s is None else timeout_s
+        if not ack.event.wait(budget):
+            self._acks.pop(rid, None)
+            raise TimeoutError(
+                f"engine {self.peer} did not answer {header.get('op')!r} "
+                f"within {budget}s")
+        if ack.error is not None:
+            raise ack.error
+        return ack
+
+    def probe(self, timeout_s: Optional[float] = None) -> Optional[float]:
+        """Bounded liveness probe: ping -> rtt_ms, refreshing the cached
+        depth/version/lanes/digest.  None on timeout or a dead link (the
+        registry marks the engine unroutable) — NEVER blocks past the
+        bound, so one hung remote cannot stall the discovery sweep."""
+        budget = self.probe_timeout_s if timeout_s is None else timeout_s
+        t0 = time.monotonic()
+        try:
+            self.request({"op": "ping"}, timeout_s=budget)
+        except TimeoutError:
+            # connected but not answering: a WEDGED engine — the signal the
+            # RUNBOOK's "probe_timeout with a fresh lease" triage keys on
+            self.probe_timeouts += 1
+            self._log("probe_timeout", budget_s=budget)
+            self._count("net_probe_timeouts_total")
+            return None
+        except EngineDead:
+            # unreachable (refused / mid-backoff): the disconnect row and
+            # the lease expiry already tell THAT story — a probe_timeout
+            # row here would steer triage at the wrong layer
+            return None
+        self.rtt_ms = round((time.monotonic() - t0) * 1e3, 3)
+        return self.rtt_ms
+
+    def stats(self) -> Dict[str, Any]:
+        return {
+            "peer": self.peer,
+            "engine": self.engine_id,
+            "connected": self.connected(),
+            "rtt_ms": self.rtt_ms,
+            "reconnects": self.reconnects,
+            "probe_timeouts": self.probe_timeouts,
+            "bytes_sent": self.bytes_sent,
+            "bytes_recv": self.bytes_recv,
+        }
+
+
+class RemoteEngine:
+    """`FleetRollout`-protocol proxy: adopt/adopt_packet/adopt_chain over a
+    `RemoteTransport` — the controller-side handle for an engine on another
+    host.  Backward refusal stays enforced at BOTH ends: the remote
+    `FleetEngine` refuses locally and the refusal travels back as the same
+    ``ValueError`` the in-process path raises."""
+
+    def __init__(self, engine_id: int, transport: RemoteTransport):
+        self.engine_id = int(engine_id)
+        self.transport = transport
+
+    @classmethod
+    def from_lease(cls, lease, **transport_kwargs: Any) -> "RemoteEngine":
+        """Build from an engine lease that advertises ``addr``/``port``
+        (parallel/elastic.py — the payload grown by TransportServer)."""
+        if not lease.addr or not lease.port:
+            raise ValueError(
+                f"lease for host {lease.host} carries no addr:port "
+                "(engine not serving over the net)")
+        return cls(lease.host, RemoteTransport(
+            lease.addr, lease.port, engine_id=lease.host,
+            **transport_kwargs))
+
+    def _adopt(self, mode: str, blobs: List[bytes],
+               version: Optional[int] = None) -> int:
+        header: Dict[str, Any] = {"op": "adopt", "mode": mode,
+                                  "n": len(blobs)}
+        if version is not None:
+            header["version"] = int(version)
+        ack = self.transport.request(header, framing.pack_blobs(blobs),
+                                     timeout_s=self.transport.ack_timeout_s)
+        # adopt_ok piggybacks version/digest; the refresh already cached them
+        _ = ack
+        return self.transport.version()
+
+    def adopt(self, params: Any, version: int) -> int:
+        """Full uncompressed adopt: ships one fp32 base packet (bit-exact
+        round-trip; no delta state needed on either side)."""
+        packet = quantize.params_packet(params, version)
+        return self._adopt("params", [quantize.packet_to_bytes(packet)],
+                           version=version)
+
+    def adopt_packet(self, packet: Any) -> int:
+        return self._adopt("packet", [quantize.packet_to_bytes(packet)])
+
+    def adopt_chain(self, packets: Any) -> int:
+        return self._adopt(
+            "chain", [quantize.packet_to_bytes(p) for p in packets])
+
+    def served_digest(self, timeout_s: Optional[float] = None
+                      ) -> Optional[str]:
+        """The digest of the params the engine currently serves (refreshed
+        by a bounded ping) — the cross-host bit-exactness witness."""
+        if self.transport.probe(timeout_s=timeout_s) is None:
+            return None
+        return self.transport.digest

@@ -1,0 +1,421 @@
+"""Engine half of the cross-host serving plane: `TransportServer` listens on
+a stdlib socket and speaks the framed protocol to N `RemoteTransport`
+clients, translating frames onto the SAME seams the in-process fleet uses —
+``try_submit`` on the policy server, ``adopt``/``adopt_packet``/
+``adopt_chain`` on the `FleetEngine`.  Nothing below the socket changes:
+batching, shedding, hot-swap, the monotonicity guards all run exactly the
+in-process code paths.
+
+One selectors-driven daemon thread owns accepts and reads (the obs/export.py
+no-deps style); replies are written directly by whichever thread settles the
+future (the serve worker, an adopt caller), serialised by a per-connection
+lock — the event loop never blocks on a slow peer's inference.
+
+Piggyback contract: every reply frame carries the engine's live
+``depth``/``version`` (and ``digest`` on pongs/adopts), so clients rank
+engines without dedicated RPCs.
+
+``for_engine`` is the deployment shape: wrap a `FleetEngine`, bind, and
+advertise ``addr:port`` in the engine's lease payload — the router's
+`EngineRegistry` then discovers the remote engine through the SAME lease
+files that already carry its depth/version, no second discovery protocol.
+"""
+
+from __future__ import annotations
+
+import queue
+import selectors
+import socket
+import threading
+from typing import Any, Dict, Optional, Tuple
+
+from rainbow_iqn_apex_tpu.serving.batcher import ServerClosed, ServerOverloaded
+from rainbow_iqn_apex_tpu.serving.net import framing
+from rainbow_iqn_apex_tpu.utils import quantize
+
+# bound on one reply write: a peer that stalls reading for this long is
+# dropped (its requests re-route) instead of wedging the writing thread
+_SEND_TIMEOUT_S = 5.0
+
+
+class _Conn:
+    """One accepted client connection: its socket, incremental frame
+    reader, the request ids with live engine futures, and a bounded
+    outbound queue drained by this connection's OWN writer thread — so
+    neither the selector loop nor another connection's worker can ever
+    block on this peer's full send buffer."""
+
+    __slots__ = ("sock", "reader", "rids", "peer", "outq")
+
+    def __init__(self, sock: socket.socket, max_frame_bytes: int):
+        self.sock = sock
+        self.reader = framing.FrameReader(max_frame_bytes)
+        self.rids: Dict[int, Any] = {}
+        # bounded: a peer stalled past ~this many un-sent replies is dead
+        # weight — the enqueue failure drops the connection instead of
+        # growing reply memory without bound
+        self.outq: "queue.Queue" = queue.Queue(maxsize=4096)
+        try:
+            self.peer = "%s:%s" % sock.getpeername()[:2]
+        except OSError:
+            self.peer = "?"
+
+
+class TransportServer:
+    """Serve the framed protocol for one engine.
+
+    ``server`` needs the `PolicyServer` surface the in-process
+    `ServerTransport` already drives (``try_submit``, a queue depth); the
+    optional ``engine`` (a `FleetEngine`, or any object with
+    ``adopt``/``adopt_packet``/``adopt_chain`` + a versioned ``transport``)
+    enables the wire rollout ops.  ``port=0`` binds an ephemeral port (read
+    ``.port``); ``stop()`` closes the listener and every connection but
+    leaves the engine itself running (the engine's own lifecycle is its
+    owner's job).
+    """
+
+    def __init__(self, server: Any, engine: Any = None,
+                 host: str = "127.0.0.1", port: int = 0,
+                 advertise: Optional[str] = None,
+                 max_frame_bytes: int = framing.DEFAULT_MAX_FRAME,
+                 logger=None):
+        self.server = server
+        self.engine = engine
+        self.max_frame_bytes = int(max_frame_bytes)
+        self.logger = logger
+        self._listener = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._listener.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._listener.bind((host, int(port)))
+        self._listener.listen(64)
+        self._listener.setblocking(False)
+        self.port = self._listener.getsockname()[1]
+        # what peers should dial: an explicit advertise address, else the
+        # bind host unless it is a wildcard (peers cannot dial 0.0.0.0)
+        self.advertise = advertise or (
+            "127.0.0.1" if host in ("", "0.0.0.0") else host)
+        self._selector = selectors.DefaultSelector()
+        self._selector.register(self._listener, selectors.EVENT_READ, None)
+        self._conns: Dict[int, _Conn] = {}  # fd -> conn
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self.frames_in = 0
+        self.bytes_out = 0
+
+    @classmethod
+    def for_engine(cls, engine: Any, host: str = "127.0.0.1", port: int = 0,
+                   advertise: Optional[str] = None,
+                   max_frame_bytes: int = framing.DEFAULT_MAX_FRAME,
+                   logger=None) -> "TransportServer":
+        """Wrap a `FleetEngine` and advertise ``addr:port`` in its lease
+        payload, so routers discover this engine's wire endpoint through
+        the lease files they already watch.  Call BEFORE ``engine.start()``
+        so the very first beat carries the address."""
+        ts = cls(engine.server, engine=engine, host=host, port=port,
+                 advertise=advertise, max_frame_bytes=max_frame_bytes,
+                 logger=logger)
+        engine.writer.update_payload(addr=ts.advertise, port=ts.port)
+        return ts
+
+    @classmethod
+    def from_config(cls, cfg, engine: Any, logger=None) -> Optional["TransportServer"]:
+        """The config seam: ``serve_net_host`` unset (default) returns None
+        — the fleet stays in-process, bitwise the pre-net path."""
+        if not getattr(cfg, "serve_net_host", ""):
+            return None
+        return cls.for_engine(
+            engine, host=cfg.serve_net_host, port=cfg.serve_net_port,
+            advertise=cfg.serve_net_advertise or None,
+            max_frame_bytes=int(cfg.serve_net_max_frame_mb) << 20,
+            logger=logger)
+
+    # -------------------------------------------------------------- lifecycle
+    def start(self) -> "TransportServer":
+        if self._thread is None:
+            self._thread = threading.Thread(
+                target=self._run, name=f"net-server-{self.port}", daemon=True)
+            self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        """Close the listener and every connection.  Clients see the drop as
+        `EngineDead` and re-route — the wire analog of an engine kill."""
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=10)
+            self._thread = None
+        with self._lock:
+            conns = list(self._conns.values())
+            self._conns.clear()
+        for conn in conns:
+            self._close_conn(conn, unregister=False)
+        try:
+            self._selector.close()
+        except (OSError, RuntimeError):
+            pass
+        try:
+            self._listener.close()
+        except OSError:
+            pass
+
+    # -------------------------------------------------------------- event loop
+    def _run(self) -> None:
+        while not self._stop.is_set():
+            try:
+                events = self._selector.select(timeout=0.1)
+            except OSError:
+                return
+            for key, _mask in events:
+                if key.fileobj is self._listener:
+                    self._accept()
+                else:
+                    self._read(key.data)
+
+    def _accept(self) -> None:
+        try:
+            sock, _addr = self._listener.accept()
+        except OSError:
+            return
+        # blocking with a bound, NOT non-blocking: replies go out via
+        # sendall from whatever thread settles the future, and sendall on a
+        # non-blocking socket raises the moment the kernel buffer fills —
+        # a client merely slow to READ would be torn down mid-frame.  With
+        # a timeout, sendall loops through partial writes and only a peer
+        # stalled past the bound is dropped.  Reads stay selector-driven
+        # (recv after a readiness event returns promptly).
+        sock.settimeout(_SEND_TIMEOUT_S)
+        try:
+            sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        except OSError:
+            pass
+        conn = _Conn(sock, self.max_frame_bytes)
+        with self._lock:
+            self._conns[sock.fileno()] = conn
+        threading.Thread(target=self._write_loop, args=(conn,),
+                         name=f"net-writer-{self.port}", daemon=True).start()
+        self._selector.register(sock, selectors.EVENT_READ, conn)
+
+    def _close_conn(self, conn: _Conn, unregister: bool = True) -> None:
+        if unregister:
+            try:
+                self._selector.unregister(conn.sock)
+            except (KeyError, OSError, ValueError):
+                pass
+            with self._lock:
+                self._conns.pop(conn.sock.fileno(), None)
+        try:
+            conn.outq.put_nowait(None)  # stop the writer thread
+        except queue.Full:
+            pass  # writer will exit on the closed socket's send error
+        try:
+            conn.sock.close()
+        except OSError:
+            pass
+        # the client is gone: cancel its queued requests so abandoned slots
+        # don't burn batch capacity (the slow-client story, wire edition)
+        rids, conn.rids = dict(conn.rids), {}
+        for fut in rids.values():
+            try:
+                fut.cancel()
+            except Exception:
+                pass
+
+    def _read(self, conn: _Conn) -> None:
+        try:
+            data = conn.sock.recv(1 << 16)
+        except (BlockingIOError, socket.timeout):
+            return  # spurious readiness; nothing to read this round
+        except OSError:
+            self._close_conn(conn)
+            return
+        if not data:
+            self._close_conn(conn)
+            return
+        try:
+            frames = conn.reader.feed(data)
+        except framing.FrameError as e:
+            # a peer that breaks framing (oversize, corrupt, wrong protocol)
+            # is dropped with one reasoned row — stream state past a framing
+            # error is unrecoverable by contract
+            self._log("bad_frame", peer=conn.peer,
+                      why=f"{type(e).__name__}: {e}")
+            self._close_conn(conn)
+            return
+        for header, blob in frames:
+            self.frames_in += 1
+            try:
+                self._handle(conn, header, blob)
+            except Exception as e:
+                self._reply(conn, {"op": "rerr",
+                                   "rid": header.get("rid"),
+                                   "etype": "closed",
+                                   "msg": f"{type(e).__name__}: {e}"})
+
+    # ---------------------------------------------------------------- replies
+    def _log(self, event: str, **fields: Any) -> None:
+        if self.logger is not None:
+            try:
+                self.logger.log("net", event=event, **fields)
+            except Exception:
+                pass
+
+    def _depth(self) -> int:
+        batcher = getattr(self.server, "batcher", None)
+        if batcher is not None:
+            return int(batcher.depth())
+        depth = getattr(self.server, "depth", None)
+        return int(depth()) if callable(depth) else 0
+
+    def _state(self) -> Dict[str, Any]:
+        out: Dict[str, Any] = {"depth": self._depth()}
+        if self.engine is not None:
+            t = self.engine.transport
+            out["version"] = int(t.version())
+            out["lanes"] = int(getattr(t, "lanes", 1))
+            out["buckets"] = list(getattr(t, "buckets", ()) or ())
+            digest = getattr(self.engine, "served_digest", None)
+            if digest:
+                out["digest"] = digest
+        return out
+
+    def _reply(self, conn: _Conn, header: Dict[str, Any],
+               blob: bytes = b"") -> None:
+        """Enqueue one reply for the connection's writer thread.  Callers
+        (the selector loop, serve workers, adopt threads) never touch the
+        socket, so a peer with a full send buffer can only stall its OWN
+        writer — a full queue means the peer is long stalled and the
+        connection is dropped instead."""
+        header = {**header, **self._state()}
+        try:
+            conn.outq.put_nowait((header, blob))
+        except queue.Full:
+            self._close_conn(conn)
+
+    def _write_loop(self, conn: _Conn) -> None:
+        while True:
+            item = conn.outq.get()
+            if item is None:  # close sentinel
+                return
+            header, blob = item
+            try:
+                self.bytes_out += framing.send_frame(conn.sock, header, blob)
+            except (OSError, ValueError):
+                self._close_conn(conn)
+                return
+
+    # ---------------------------------------------------------------- handlers
+    def _handle(self, conn: _Conn, header: Dict[str, Any],
+                blob: bytes) -> None:
+        op = header.get("op")
+        rid = header.get("rid")
+        if op == "ping":
+            self._reply(conn, {"op": "pong", "rid": rid, "alive": True})
+        elif op == "submit":
+            self._handle_submit(conn, rid, header, blob)
+        elif op == "cancel":
+            fut = conn.rids.get(rid)
+            if fut is not None:
+                fut.cancel()
+        elif op == "adopt":
+            # OFF the event loop: a real-size adopt (npz decode + device
+            # transfer + digest) runs long past the probe budget, and
+            # blocking the loop here would make every weight rollout read
+            # as a wedged engine (probe-suspect eviction fleet-wide).
+            # Adopts are publish-cadence rare; controller-side RPCs are
+            # sequential per connection, so ordering is preserved.
+            threading.Thread(
+                target=self._handle_adopt, args=(conn, rid, header, blob),
+                name=f"net-adopt-{self.port}", daemon=True).start()
+        else:
+            self._reply(conn, {"op": "rerr", "rid": rid,
+                               "etype": "unsupported",
+                               "msg": f"unknown op {op!r}"})
+
+    def _handle_submit(self, conn: _Conn, rid: Any,
+                       header: Dict[str, Any], blob: bytes) -> None:
+        try:
+            obs = framing.decode_ndarray(header, blob)
+            fut = self.server.try_submit(obs)
+        except ServerClosed as e:
+            self._reply(conn, {"op": "ack", "rid": rid, "ok": False,
+                               "etype": "closed", "msg": str(e)})
+            return
+        except (framing.FrameError, TypeError, ValueError) as e:
+            self._reply(conn, {"op": "ack", "rid": rid, "ok": False,
+                               "etype": "unsupported",
+                               "msg": f"{type(e).__name__}: {e}"})
+            return
+        if fut is None:  # engine queue full: the CLIENT router owns the shed
+            self._reply(conn, {"op": "ack", "rid": rid, "ok": False,
+                               "etype": "overloaded",
+                               "msg": "engine queue full"})
+            return
+        conn.rids[rid] = fut
+        self._reply(conn, {"op": "ack", "rid": rid, "ok": True})
+        fut.add_done_callback(
+            lambda f, conn=conn, rid=rid: self._on_done(conn, rid, f))
+
+    def _on_done(self, conn: _Conn, rid: Any, fut: Any) -> None:
+        """Runs on whichever thread settled the engine future (the serve
+        worker on results, abort_pending on kills)."""
+        conn.rids.pop(rid, None)
+        if fut.cancelled():
+            return  # the client cancelled; it is not waiting for a reply
+        err = fut._error  # settled: no race left (batcher contract)
+        if err is None:
+            meta, blob = framing.encode_ndarray(fut._q)
+            self._reply(conn, {"op": "result", "rid": rid,
+                               "action": int(fut._action), **meta}, blob)
+        else:
+            etype = ("closed" if isinstance(err, ServerClosed)
+                     else "overloaded" if isinstance(err, ServerOverloaded)
+                     else "dead")
+            self._reply(conn, {"op": "rerr", "rid": rid, "etype": etype,
+                               "msg": str(err)})
+
+    def _handle_adopt(self, conn: _Conn, rid: Any,
+                      header: Dict[str, Any], blob: bytes) -> None:
+        if self.engine is None:
+            self._reply(conn, {"op": "adopt_err", "rid": rid,
+                               "etype": "unsupported",
+                               "msg": "this endpoint serves no FleetEngine "
+                                      "(adopt ops unavailable)"})
+            return
+        mode = header.get("mode")
+        try:
+            packets = [quantize.packet_from_bytes(b)
+                       for b in framing.unpack_blobs(blob)]
+            if mode == "params":
+                # one fp32 base packet = the uncompressed rollout payload
+                params = quantize.unflatten_tree({
+                    p: data for p, (data, _s) in packets[0].leaves.items()})
+                version = self.engine.adopt(
+                    params, int(header.get("version", packets[0].version)))
+            elif mode == "packet":
+                version = self.engine.adopt_packet(packets[0])
+            elif mode == "chain":
+                version = self.engine.adopt_chain(packets)
+            else:
+                raise RuntimeError(f"unknown adopt mode {mode!r}")
+        except ValueError as e:  # backward/duplicate: refused at THIS end too
+            self._reply(conn, {"op": "adopt_err", "rid": rid,
+                               "etype": "backward", "msg": str(e)})
+            return
+        except quantize.DeltaChainBroken as e:
+            self._reply(conn, {"op": "adopt_err", "rid": rid,
+                               "etype": "chain_broken", "msg": str(e)})
+            return
+        except Exception as e:
+            self._reply(conn, {"op": "adopt_err", "rid": rid,
+                               "etype": "dead",
+                               "msg": f"{type(e).__name__}: {e}"})
+            return
+        self._reply(conn, {"op": "adopt_ok", "rid": rid,
+                           "version": int(version)})
+
+    # ---------------------------------------------------------------- stats
+    def stats(self) -> Dict[str, Any]:
+        with self._lock:
+            n = len(self._conns)
+        return {"port": self.port, "connections": n,
+                "frames_in": self.frames_in, "bytes_out": self.bytes_out}

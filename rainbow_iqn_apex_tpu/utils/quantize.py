@@ -276,11 +276,10 @@ class WeightPacket:
         return int(total)
 
 
-def save_packet(packet: WeightPacket, path: str) -> None:
-    """One .npz per packet (WeightMailbox's payload files).  Written via
-    tmp + rename so a reader never sees a torn file."""
-    import os
-
+def _packet_arrays(packet: WeightPacket) -> Dict[str, np.ndarray]:
+    """The npz array dict for one packet (shared by the file and wire
+    serialisations, so a packet saved to disk and one framed over a socket
+    are byte-identical payloads)."""
     arrays: Dict[str, np.ndarray] = {}
     for leaf_path, (data, scale) in packet.leaves.items():
         if HAVE_ML_DTYPES and data.dtype == np.dtype(ml_dtypes.bfloat16):
@@ -294,32 +293,89 @@ def save_packet(packet: WeightPacket, path: str) -> None:
     arrays["__meta__"] = np.array(
         [packet.version, packet.prev_version, packet.base_version,
          1 if packet.kind == "base" else 0], np.int64)
-    tmp = path + ".tmp.npz"
-    with open(tmp, "wb") as fh:
-        np.savez(fh, **arrays)
-    os.replace(tmp, path)
+    return arrays
 
 
-def load_packet(path: str) -> WeightPacket:
-    with np.load(path, allow_pickle=False) as z:
-        meta = z["__meta__"]
-        leaves: Dict[str, Tuple[np.ndarray, Optional[np.ndarray]]] = {}
-        for key in z.files:
-            if not key.startswith(("d::", "b::")):
-                continue
-            leaf_path = key[3:]
-            data = z[key]
-            if key.startswith("b::"):
-                data = data.view(np.dtype(ml_dtypes.bfloat16))
-            scale_key = f"s::{leaf_path}"
-            leaves[leaf_path] = (
-                data, z[scale_key] if scale_key in z.files else None
-            )
+def _packet_from_npz(z) -> WeightPacket:
+    meta = z["__meta__"]
+    leaves: Dict[str, Tuple[np.ndarray, Optional[np.ndarray]]] = {}
+    for key in z.files:
+        if not key.startswith(("d::", "b::")):
+            continue
+        leaf_path = key[3:]
+        data = z[key]
+        if key.startswith("b::"):
+            data = data.view(np.dtype(ml_dtypes.bfloat16))
+        scale_key = f"s::{leaf_path}"
+        leaves[leaf_path] = (
+            data, z[scale_key] if scale_key in z.files else None
+        )
     return WeightPacket(
         kind="base" if int(meta[3]) else "delta",
         version=int(meta[0]), prev_version=int(meta[1]),
         base_version=int(meta[2]), leaves=leaves,
     )
+
+
+def save_packet(packet: WeightPacket, path: str) -> None:
+    """One .npz per packet (WeightMailbox's payload files).  Written via
+    tmp + rename so a reader never sees a torn file."""
+    import os
+
+    tmp = path + ".tmp.npz"
+    with open(tmp, "wb") as fh:
+        np.savez(fh, **_packet_arrays(packet))
+    os.replace(tmp, path)
+
+
+def load_packet(path: str) -> WeightPacket:
+    with np.load(path, allow_pickle=False) as z:
+        return _packet_from_npz(z)
+
+
+def packet_to_bytes(packet: WeightPacket) -> bytes:
+    """In-memory npz serialisation — the wire payload the cross-host
+    rollout frames over serving/net (same bytes `save_packet` writes)."""
+    import io
+
+    buf = io.BytesIO()
+    np.savez(buf, **_packet_arrays(packet))
+    return buf.getvalue()
+
+
+def packet_from_bytes(data: bytes) -> WeightPacket:
+    import io
+
+    with np.load(io.BytesIO(data), allow_pickle=False) as z:
+        return _packet_from_npz(z)
+
+
+def params_packet(params: Any, version: int) -> WeightPacket:
+    """An UNCOMPRESSED full-fp32 base packet for ``params`` — the wire shape
+    of a compression="off" rollout (`RemoteEngine.adopt`): the decode is a
+    plain fp32 round-trip, so the remote engine adopts bit-exact params
+    without holding any delta-chain state."""
+    flat = {p: np.asarray(leaf, np.float32)
+            for p, leaf in flatten_tree(params).items()}
+    return WeightPacket(
+        kind="base", version=int(version), prev_version=-1,
+        base_version=int(version),
+        leaves={p: (leaf, None) for p, leaf in flat.items()},
+    )
+
+
+def tree_digest(tree: Any) -> str:
+    """Order-stable sha256 over a param pytree's fp32 leaf bytes — the
+    bit-exactness witness for cross-host rollouts (publisher reconstruction
+    vs every engine's adopted params).  jax arrays are pulled to host."""
+    import hashlib
+
+    h = hashlib.sha256()
+    for path in sorted(flat := flatten_tree(tree)):
+        arr = np.ascontiguousarray(np.asarray(flat[path], np.float32))
+        h.update(path.encode("utf-8"))
+        h.update(arr.tobytes())
+    return h.hexdigest()
 
 
 def _base_dtype():

@@ -29,6 +29,13 @@ is one ``fleet_soak`` row in the PR-6 budgeted-row convention (no ``status``
 key when healthy; ``"status": "error"/"gate_failed"`` otherwise), plus a
 lint-clean run dir of route/scale/rollout/serve JSONL.
 
+``--fleet-soak --net`` runs the SAME scenario with a real loopback socket on
+every hop (serving/net/): engines behind `TransportServer`s, the router
+dispatching through `RemoteTransport`s, rollouts shipped as int8-delta
+packets over the wire with bit-exact adoption gated per engine.  Emits one
+``net_soak`` row (aggregate rps, p99, rollout bytes over the wire vs fp32)
+for the BENCH_r*.json trajectory.
+
 ``--quant`` runs the fp32-vs-int8 serving comparison (`make quant-smoke`):
 the same fixed load through a fp32 engine and a quantized one
 (``serve_quantize="int8"``, agreement-gated), one ``quant_serve`` row with
@@ -68,9 +75,15 @@ class _InProcFleet:
     """The soak's in-process fleet: N PolicyServers wrapped as FleetEngines
     (lease self-registration in a shared heartbeat dir), one EngineRegistry +
     FrontRouter over them, a RoleSupervisor-backed Autoscaler, and a
-    FleetRollout — the full serving/fleet composition on one host."""
+    FleetRollout — the full serving/fleet composition on one host.
 
-    def __init__(self, cfg, num_actions, params, out_dir):
+    ``net=True`` (the ``--net`` soak variant) keeps the same topology but
+    puts a REAL loopback socket on every hop: each engine serves behind a
+    `TransportServer`, the router dispatches through `RemoteTransport`s,
+    and the rollout ships int8-delta packets to `RemoteEngine` proxies —
+    the full serving/net wire path under the same bursty load and kill."""
+
+    def __init__(self, cfg, num_actions, params, out_dir, net=False):
         import jax
 
         from rainbow_iqn_apex_tpu.obs.registry import MetricRegistry
@@ -90,6 +103,7 @@ class _InProcFleet:
         self.num_actions = num_actions
         self.params = params
         self.out_dir = out_dir
+        self.net = bool(net)
         self._jax = jax
         self._PolicyServer = PolicyServer
         self._FleetEngine = FleetEngine
@@ -100,8 +114,16 @@ class _InProcFleet:
         self.hb_dir = os.path.join(out_dir, "heartbeats")
         self.registry = EngineRegistry(
             self.hb_dir, lease_timeout_s=cfg.fleet_lease_timeout_s,
-            logger=self.logger, obs_registry=self.obs)
-        self.rollout = FleetRollout(logger=self.logger, obs_registry=self.obs)
+            logger=self.logger, obs_registry=self.obs,
+            probe_timeout_s=cfg.serve_net_probe_timeout_s,
+            probe_interval_s=cfg.serve_net_probe_interval_s,
+            net_stats_interval_s=2.0)
+        # --net ships every rollout as int8-delta packets over the wire —
+        # the QuaRL byte win is only real once weights actually cross one
+        self.rollout = FleetRollout(
+            logger=self.logger, obs_registry=self.obs,
+            compression="int8_delta" if self.net else "off",
+            base_interval=cfg.publish_base_interval)
         self.router = FrontRouter.from_config(
             cfg, self.registry, target_version_fn=self.rollout.version,
             logger=self.logger, obs_registry=self.obs)
@@ -116,6 +138,8 @@ class _InProcFleet:
             supervisor=self.supervisor,
             logger=self.logger, obs_registry=self.obs)
         self.engines = {}
+        self.tservers = {}
+        self.transports = {}
 
     def spawn_engine(self, engine_id, epoch):
         """Boot one engine (fresh PolicyServer + lease at ``epoch``), attach
@@ -129,10 +153,36 @@ class _InProcFleet:
         engine = self._FleetEngine(
             server, engine_id, self.hb_dir,
             interval_s=self.cfg.fleet_lease_interval_s, epoch=epoch)
-        engine.start(warmup=True)
-        self.engines[engine_id] = engine
-        self.registry.attach(engine_id, engine.transport)
-        self.rollout.track(engine)
+        if self.net:
+            from rainbow_iqn_apex_tpu.serving.net import (
+                RemoteEngine,
+                RemoteTransport,
+                TransportServer,
+            )
+
+            # the config seam is the on-switch: serve_net_host set by --net
+            ts = TransportServer.from_config(self.cfg, engine,
+                                             logger=self.logger)
+            assert ts is not None, "--net requires serve_net_host"
+            ts.start()
+            engine.start(warmup=True)
+            old = self.transports.get(engine_id)
+            if old is not None:  # respawn after a kill: retire the corpse's
+                old.close()      # client before attaching the new one
+            transport = RemoteTransport(
+                "127.0.0.1", ts.port, engine_id=engine_id,
+                probe_timeout_s=self.cfg.serve_net_probe_timeout_s,
+                logger=self.logger, obs_registry=self.obs)
+            self.tservers[engine_id] = ts
+            self.transports[engine_id] = transport
+            self.engines[engine_id] = engine
+            self.registry.attach(engine_id, transport)
+            self.rollout.track(RemoteEngine(engine_id, transport))
+        else:
+            engine.start(warmup=True)
+            self.engines[engine_id] = engine
+            self.registry.attach(engine_id, engine.transport)
+            self.rollout.track(engine)
         self.rollout.sync()
         return engine.proc()
 
@@ -142,11 +192,23 @@ class _InProcFleet:
             self.rollout.untrack(engine_id)
             self.registry.detach(engine_id)
             engine.stop()
+        ts = self.tservers.pop(engine_id, None)
+        if ts is not None:
+            ts.stop()
+        transport = self.transports.pop(engine_id, None)
+        if transport is not None:
+            transport.close()
 
     def kill_engine(self, engine_id):
         """The mid-soak SIGKILL analog: heartbeats stop cold, queued
         requests fail NOW (the router re-routes them), the lease expires on
-        the monitor's clock and the supervisor respawns with backoff."""
+        the monitor's clock and the supervisor respawns with backoff.  In
+        --net mode the transport listener drops FIRST — clients see the
+        connection die exactly like a host death, before any engine-side
+        cleanup could leak a polite goodbye."""
+        ts = self.tservers.pop(engine_id, None)
+        if ts is not None:
+            ts.stop()
         engine = self.engines.get(engine_id)
         if engine is not None:
             engine.kill()
@@ -310,7 +372,8 @@ def fleet_soak(args) -> int:
     from rainbow_iqn_apex_tpu.serving import ServerOverloaded
 
     out_dir = (args.out if args.out != "results/serve_bench"
-               else "results/fleet_soak")
+               else ("results/net_soak" if args.net
+                     else "results/fleet_soak"))
     os.makedirs(out_dir, exist_ok=True)
     cfg = Config(
         compute_dtype="float32",
@@ -335,12 +398,18 @@ def fleet_soak(args) -> int:
         max_weight_lag=1,  # a respawned engine serves only after it is
         # caught up to within one publish of the rollout target
         respawn_base_s=0.2, respawn_max_s=1.0,
-        run_id="fleet_soak",
+        publish_base_interval=2,  # --net: v1 base + v2 delta, so the wire
+        # rollout exercises BOTH packet kinds and the late-joiner chain
+        serve_net_host="127.0.0.1" if args.net else "",  # the cross-host
+        # on-switch: engines serve behind TransportServer.from_config
+        run_id="net_soak" if args.net else "fleet_soak",
         seed=args.seed,
     )
     state = init_train_state(cfg, args.num_actions, jax.random.PRNGKey(0))
-    fleet = _InProcFleet(cfg, args.num_actions, state.params, out_dir)
-    row(event="fleet_soak_start", engines=args.engines,
+    fleet = _InProcFleet(cfg, args.num_actions, state.params, out_dir,
+                         net=args.net)
+    row(event="net_soak_start" if args.net else "fleet_soak_start",
+        engines=args.engines,
         max_engines=args.max_engines, duration_s=args.duration,
         rate=args.rate, out=out_dir)
     t0 = time.monotonic()
@@ -490,6 +559,20 @@ def fleet_soak(args) -> int:
     versions = fleet.rollout.engine_versions()
     wall_s = time.monotonic() - t0_load
     stats = fleet.router.stats()
+    net_capture = None
+    if args.net:  # captured BEFORE stop() tears the engine/transport maps down
+        net_capture = {
+            "target_digest": fleet.rollout.reconstructed_digest(),
+            "digests": {str(eid): e.served_digest
+                        for eid, e in fleet.engines.items()
+                        if e.transport.alive()},
+            "rollout_bytes_wire": fleet.rollout.bytes_total,
+            "publishes": fleet.rollout.publishes,
+            "transport_bytes_sent": sum(
+                t.bytes_sent for t in fleet.transports.values()),
+            "transport_reconnects": sum(
+                t.reconnects for t in fleet.transports.values()),
+        }
     fleet.stop()
 
     lat = sorted(latencies)
@@ -517,11 +600,33 @@ def fleet_soak(args) -> int:
         "cancel_worked": counts["slow_cancelled"] == 0
         or stats["cancelled"] > 0,
     }
+    soak_path = "net_soak" if args.net else "fleet_soak"
+    net_fields = {}
+    if net_capture is not None:
+        # wire weight-rollout economics: bytes the int8-delta packets
+        # actually shipped vs what fp32-full would have — the QuaRL/PR-8
+        # ratio measured ACROSS a socket, for the BENCH_r*.json trajectory
+        from rainbow_iqn_apex_tpu.utils.quantize import tree_bytes
+
+        fp32_total = tree_bytes(state.params) * net_capture["publishes"]
+        gates["wire_rollout_bit_exact"] = (
+            bool(net_capture["digests"])
+            and all(d == net_capture["target_digest"]
+                    for d in net_capture["digests"].values()))
+        net_fields = {
+            "rollout_bytes_wire": net_capture["rollout_bytes_wire"],
+            "rollout_bytes_fp32": fp32_total,
+            "rollout_bytes_ratio_vs_fp32": round(
+                fp32_total / max(net_capture["rollout_bytes_wire"], 1), 3),
+            "transport_bytes_sent": net_capture["transport_bytes_sent"],
+            "transport_reconnects": net_capture["transport_reconnects"],
+        }
     result = {
-        "path": "fleet_soak",
-        "metric": "fleet_soak_requests_per_sec",
+        "path": soak_path,
+        "metric": f"{soak_path}_requests_per_sec",
         "value": round(stats["completed"] / max(wall_s, 1e-9), 1),
         "unit": "req/s",
+        **net_fields,
         "wall_s": round(wall_s, 2),
         "submitted": counts["submitted"] + counts["slow_submitted"],
         "accepted": accepted,
@@ -569,6 +674,11 @@ def main() -> int:
     # ---- fleet soak (serving/fleet/) ----
     ap.add_argument("--fleet-soak", action="store_true",
                     help="run the router+fleet heavy-traffic soak instead")
+    ap.add_argument("--net", action="store_true",
+                    help="with --fleet-soak: put a real loopback socket on "
+                         "every hop (TransportServer/RemoteTransport) and "
+                         "ship rollouts as int8-delta packets over the "
+                         "wire; emits one net_soak row")
     ap.add_argument("--engines", type=int, default=2,
                     help="initial engine count (fleet soak)")
     ap.add_argument("--max-engines", type=int, default=3,
@@ -591,6 +701,8 @@ def main() -> int:
                     help="max tolerated shed fraction of submissions")
     ap.add_argument("--seed", type=int, default=0)
     args = ap.parse_args()
+    if args.net and not args.fleet_soak:
+        ap.error("--net is a --fleet-soak variant")
     if args.fleet_soak:
         return fleet_soak(args)
     if args.quant:

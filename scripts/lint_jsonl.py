@@ -19,7 +19,9 @@ The validated kind set includes the elasticity rows (``host_alive``,
 ``shard_readmit``, ``actor_fenced`` — obs/schema.py REQUIRED_KEYS), so a
 chaos-soak run dir lints as strictly as a training run dir, and the
 pipeline-tracing rows (``span_link``/``lag`` — obs/pipeline_trace.py), so a
-traced run dir lints before trace_export/obs_report consume it.
+traced run dir lints before trace_export/obs_report consume it, and the
+cross-host serving rows (``net``/``gossip`` — serving/net/), so a net-smoke
+run dir lints before its `net:` report section is read.
 """
 
 from __future__ import annotations

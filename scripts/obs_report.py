@@ -168,6 +168,47 @@ def _games_section(by_kind: Dict[str, List[Dict[str, Any]]]) -> Dict[str, Any]:
     }
 
 
+def _net_section(by_kind: Dict[str, List[Dict[str, Any]]]) -> Dict[str, Any]:
+    """Fold cross-host serving rows (serving/net/): per-peer transport
+    health — newest rtt/bytes from the periodic stats rows, flap counts
+    (disconnects/reconnects/probe timeouts) from the lifecycle events —
+    plus the newest gossip freshness.  Empty dict for in-process runs."""
+    net = by_kind.get("net", [])
+    gossip = by_kind.get("gossip", [])
+    if not (net or gossip):
+        return {}
+    peers: Dict[str, Dict[str, Any]] = {}
+    flaps = 0
+    for row in net:
+        peer = str(row.get("peer", "?"))
+        snap = peers.setdefault(peer, {
+            "reconnects": 0, "disconnects": 0, "probe_timeouts": 0})
+        event = row.get("event")
+        if event == "stats":
+            # newest stats row wins: these are lifetime counters/gauges
+            snap["rtt_ms"] = row.get("rtt_ms")
+            snap["bytes_sent"] = row.get("bytes_sent")
+            snap["bytes_recv"] = row.get("bytes_recv")
+            snap["connected"] = row.get("connected")
+            snap["reconnects"] = int(row.get("reconnects", 0) or 0)
+            snap["probe_timeouts"] = int(row.get("probe_timeouts", 0) or 0)
+        elif event == "disconnect":
+            snap["disconnects"] += 1
+            flaps += 1
+        elif event in ("reconnect", "probe_timeout", "bad_frame"):
+            flaps += 1
+    last_gossip = gossip[-1] if gossip else {}
+    return {
+        "rows": len(net),
+        "flaps": flaps,
+        "peers": peers,
+        "gossip_rows": len(gossip),
+        "gossip_peers": last_gossip.get("peers"),
+        "gossip_fresh": last_gossip.get("fresh"),
+        "gossip_stale": last_gossip.get("stale"),
+    }
+
+
 def _quant_section(by_kind: Dict[str, List[Dict[str, Any]]]) -> Dict[str, Any]:
     """Fold quant/publish/quant_fallback rows: is the quantized path live,
     what did the gate last measure, and how many publish bytes the delta/
@@ -340,6 +381,9 @@ def aggregate(rows: List[Dict[str, Any]]) -> Dict[str, Any]:
         # serving fleet (docs/SERVING.md "fleet"): per-tenant accept/shed,
         # per-engine depth/version spread, scale events, rollout convergence
         "fleet": _fleet_section(by_kind),
+        # cross-host serving plane (serving/net/): per-peer transport
+        # rtt/reconnects/bytes + router-gossip freshness
+        "net": _net_section(by_kind),
         # quantized inference + compressed distribution: gate agreement,
         # fallback count, publish bytes saved vs fp32-full
         "quant": _quant_section(by_kind),
@@ -449,6 +493,23 @@ def render(report: Dict[str, Any]) -> str:
             lines.append(f"  engine {eid}: depth={snap.get('depth')} "
                          f"version={snap.get('version')} "
                          f"alive={snap.get('alive')}")
+    n = report.get("net") or {}
+    if n:
+        lines.append(
+            f"net:     rows={n['rows']} flaps={n['flaps']} "
+            f"gossip_rows={n['gossip_rows']} "
+            f"gossip_fresh={n['gossip_fresh']}/{n['gossip_peers']} "
+            f"(stale={n['gossip_stale']})"
+        )
+        for peer, snap in sorted(n["peers"].items()):
+            lines.append(
+                f"  peer {peer}: rtt_ms={snap.get('rtt_ms')} "
+                f"reconnects={snap.get('reconnects')} "
+                f"probe_timeouts={snap.get('probe_timeouts')} "
+                f"bytes_sent={snap.get('bytes_sent')} "
+                f"bytes_recv={snap.get('bytes_recv')}"
+                + ("" if snap.get("connected", True) else " DISCONNECTED")
+            )
     q = report["quant"]
     if q["gates"] or q["fallbacks"] or q["publishes"]:
         lines.append(

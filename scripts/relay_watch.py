@@ -217,6 +217,12 @@ def health_attribution(metrics_glob) -> dict:
     # router/fleet (bench_serve soak) gets its route/scale/rollout activity
     # attributed the same way — sheds and scale churn are the phase's story
     fleet = {"route": 0, "scale": 0, "rollout": 0}
+    # cross-host serving rows (serving/net/; docs/SERVING.md "cross-host"):
+    # a phase that drove remote engines gets its wire story attributed —
+    # transport flaps vs clean stats windows, and whether router gossip
+    # actually flowed (a net soak with zero gossip rows ran solo-router)
+    net = {"net": 0, "gossip": 0}
+    net_flaps = 0
     # quantization rows (docs/PERFORMANCE.md "quant"): a window that kept
     # falling back to fp32 is a different finding (accuracy gate refusing)
     # than one that quantized cleanly — the tally carries it into phase_done
@@ -254,6 +260,12 @@ def health_attribution(metrics_glob) -> dict:
                         heals[kind] += 1
                     elif kind in fleet:
                         fleet[kind] += 1
+                    elif kind in net:
+                        net[kind] += 1
+                        if kind == "net" and row.get("event") in (
+                                "disconnect", "reconnect", "probe_timeout",
+                                "bad_frame"):
+                            net_flaps += 1
                     elif kind in quant:
                         quant[kind] += 1
                     elif kind in games_tally:
@@ -285,6 +297,8 @@ def health_attribution(metrics_glob) -> dict:
            "last": last, "worst": worst, "heals": heals, "fleet": fleet,
            "quant": quant, "trace": trace,
            "critical_path": _critical_path_echo(span_rows)}
+    if net["net"] or net["gossip"]:
+        out["net"] = {**net, "flaps": net_flaps}
     if games_tally["games"] or games_tally["eval_mt"] or by_game:
         out["games"] = {**games_tally, "by_game": by_game,
                         "aggregate": last_hn}
