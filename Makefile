@@ -80,12 +80,15 @@ soak-smoke:
 # beat the host sum-tree sample path by >= 1.5x on the sample_path micro
 # row, the int8-delta weight publish (utils/quantize.py) must ship >= 3x
 # fewer bytes/publish than fp32 full on the weight_publish row (decoder
-# verified bit-exact inside the row), and the bench rows must lint as
-# strict JSON.  Small watchdog: the toy harnesses finish in well under a
-# minute per mode.
+# verified bit-exact inside the row), the fused K-pass clipped replay reuse
+# (ops/learn.py, cfg.replay_ratio) must deliver >= 2x learn_steps/s at K=4
+# over the emulated actor-bound loop WITH matched-env-frames toy eval
+# parity (replay_reuse row — the r05 lesson status guards apply), and the
+# bench rows must lint as strict JSON.  Small watchdog: the toy harnesses
+# finish in well under a minute per mode.
 perf-smoke:
 	rm -f /tmp/ria_perf_smoke.jsonl
-	JAX_PLATFORMS=cpu BENCH_APEX_ONLY=1 BENCH_WATCHDOG_SECS=300 \
+	JAX_PLATFORMS=cpu BENCH_APEX_ONLY=1 BENCH_WATCHDOG_SECS=420 \
 	  $(PY) bench.py | tee /tmp/ria_perf_smoke.jsonl
 	$(PY) scripts/lint_jsonl.py /tmp/ria_perf_smoke.jsonl
 	$(PY) -c "import json; rows = [json.loads(l) for l in \
@@ -105,7 +108,16 @@ perf-smoke:
 	  assert w.get('status') is None, 'weight_publish row: %s' % w['status']; \
 	  print('weight_publish: int8-delta %.0f B/publish vs fp32 %d B (%.2fx)' \
 	        % (w['value'], w['fp32_bytes_per_publish'], w['ratio_vs_fp32'])); \
-	  assert w['ratio_vs_fp32'] >= 3.0, 'int8-delta publish under 3x vs fp32'"
+	  assert w['ratio_vs_fp32'] >= 3.0, 'int8-delta publish under 3x vs fp32'; \
+	  u = [x for x in rows if x.get('path') == 'replay_reuse'][-1]; \
+	  assert u.get('status') is None, 'replay_reuse row: %s' % u['status']; \
+	  print('replay_reuse: K=%s %.1f steps/s vs K=1 %.1f (speedup %.3f, ' \
+	        'eval %s vs %s, parity=%s)' \
+	        % (u['k'], u['value'], u['k1_steps_per_sec'], \
+	           u['speedup_vs_k1'], u['eval_k'], u['eval_k1'], \
+	           u['eval_parity'])); \
+	  assert u['speedup_vs_k1'] >= 2.0, 'replay reuse under 2x at K=4'; \
+	  assert u['eval_parity'] is True, 'replay reuse eval parity not shown'"
 	$(PY) scripts/bench_diff.py /tmp/ria_perf_smoke.jsonl
 
 # trace smoke (docs/OBSERVABILITY.md "tracing"): a tiny TRACED apex run
@@ -122,7 +134,7 @@ trace-smoke:
 	  --hidden-size 64 --num-cosines 16 --num-tau-samples 4 \
 	  --num-tau-prime-samples 4 --num-quantile-samples 4 --batch-size 16 \
 	  --learning-rate 1e-3 --multi-step 3 --gamma 0.9 --memory-capacity 4096 \
-	  --learn-start 512 --replay-ratio 2 --target-update-period 200 \
+	  --learn-start 512 --frames-per-learn 2 --target-update-period 200 \
 	  --num-envs-per-actor 8 --metrics-interval 100 --eval-interval 0 \
 	  --checkpoint-interval 0 --eval-episodes 2 --t-max 3072 \
 	  --trace-sample-every 4 --weight-publish-interval 200 \
@@ -173,7 +185,7 @@ multitask-smoke:
 	  --num-tau-samples 4 --num-tau-prime-samples 4 \
 	  --num-quantile-samples 4 --batch-size 16 --learning-rate 1e-3 \
 	  --multi-step 3 --gamma 0.9 --memory-capacity 4096 --learn-start 512 \
-	  --replay-ratio 2 --target-update-period 200 --num-envs-per-actor 8 \
+	  --frames-per-learn 2 --target-update-period 200 --num-envs-per-actor 8 \
 	  --metrics-interval 100 --eval-interval 200 --checkpoint-interval 0 \
 	  --eval-episodes 2 --t-max 3072 --run-id mt_smoke \
 	  --results-dir /tmp/ria_mt_smoke/results \
@@ -211,7 +223,7 @@ obs-smoke:
 	  --hidden-size 64 --num-cosines 16 --num-tau-samples 4 \
 	  --num-tau-prime-samples 4 --num-quantile-samples 4 --batch-size 16 \
 	  --learning-rate 1e-3 --multi-step 3 --gamma 0.9 --memory-capacity 4096 \
-	  --learn-start 512 --replay-ratio 2 --target-update-period 200 \
+	  --learn-start 512 --frames-per-learn 2 --target-update-period 200 \
 	  --num-envs-per-actor 8 --metrics-interval 200 --eval-interval 0 \
 	  --checkpoint-interval 0 --eval-episodes 4 --t-max 2048 \
 	  --run-id obs_smoke --results-dir /tmp/ria_obs_smoke/results \

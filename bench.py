@@ -147,17 +147,22 @@ def measure() -> None:
     if os.environ.get("BENCH_APEX_ONLY") == "1":
         for row in _run_row_budgeted(
             "weight_publish", "weight_publish_bytes_per_publish",
-            _measure_weight_publish, left, share=0.2,
+            _measure_weight_publish, left, share=0.15,
         ):
             print(json.dumps(row), flush=True)
         for row in _run_row_budgeted(
             "trace_overhead", "pipeline_trace_overhead_frac",
-            _measure_trace_overhead, left, share=0.3,
+            _measure_trace_overhead, left, share=0.25,
         ):
             print(json.dumps(row), flush=True)
         for row in _run_row_budgeted(
             "apex_loop", "apex_loop_steps_per_sec",
-            _measure_apex_loop, left, share=0.5,
+            _measure_apex_loop, left, share=0.4,
+        ):
+            print(json.dumps(row), flush=True)
+        for row in _run_row_budgeted(
+            "replay_reuse", "replay_reuse_learn_steps_per_sec",
+            _measure_replay_reuse, left, share=0.6,
         ):
             print(json.dumps(row), flush=True)
         for row in _run_row_budgeted(
@@ -281,6 +286,11 @@ def measure() -> None:
             for row in _run_row_budgeted(
                 "apex_loop", "apex_loop_steps_per_sec",
                 _measure_apex_loop, left, share=0.45,
+            ):
+                print(json.dumps(row), flush=True)
+            for row in _run_row_budgeted(
+                "replay_reuse", "replay_reuse_learn_steps_per_sec",
+                _measure_replay_reuse, left, share=0.5,
             ):
                 print(json.dumps(row), flush=True)
             for row in _run_row_budgeted(
@@ -699,6 +709,203 @@ def _measure_multitask_throughput(left=None) -> list:
         "batch_size": cfg.batch_size,
         "single_steps_per_sec": round(single_sps, 3),
         "ratio_vs_single": round(mt_sps / max(single_sps, 1e-9), 4),
+    }]
+
+
+def _measure_replay_reuse(left=None) -> list:
+    """replay_reuse row (ISSUE 12 tentpole gate): replay-ratio K=4 vs K=1
+    over the REAL sample -> to_device -> fused-learn -> ring-write-back
+    loop, in the regime the knob exists for — an ACTOR-BOUND pipeline,
+    emulated as a fixed per-sample scarcity stall (``BENCH_RR_SAMPLE_US``,
+    the sample-supply analogue of apex_loop's emulated env IPC): the replay
+    can only hand the learner one fresh batch every so often, exactly the
+    PR-9 `actor-bound` critical_path verdict.  K=4 takes four clipped SGD
+    passes per batch inside ONE fori_loop'd executable (ops/learn.py), so
+    learn_steps/s should approach 4x the K=1 loop minus the per-pass
+    compute that no longer hides under the stall; `make perf-smoke` gates
+    ``speedup_vs_k1`` >= 2 at this toy size and bench_diff regresses it
+    across rounds.
+
+    The same row carries the MATCHED-ENV-FRAMES eval-parity check: two real
+    ``train()`` runs on toy:chain at identical seeds/frames, K=1 vs K=4 —
+    ``eval_parity`` requires both final evals finite, zero NaN-guard
+    rollbacks under reuse (the IMPACT clip's job), and the K=4 score within
+    1.0 of K=1 on the toy's [-1, 1]-ish scale (reuse must not trade speed
+    for a destabilized policy)."""
+    if left is None:
+        left = lambda: float("inf")  # noqa: E731
+    import shutil
+    import tempfile
+
+    import jax
+    import numpy as np
+
+    from rainbow_iqn_apex_tpu.agents.agent import to_device_batch
+    from rainbow_iqn_apex_tpu.config import Config
+    from rainbow_iqn_apex_tpu.ops.learn import build_learn_step, init_train_state
+    from rainbow_iqn_apex_tpu.replay.buffer import PrioritizedReplay
+    from rainbow_iqn_apex_tpu.utils.writeback import WritebackRing
+
+    platform = jax.devices()[0].platform
+    h = w = int(os.environ.get("BENCH_RR_FRAME", "44"))
+    lanes = int(os.environ.get("BENCH_RR_LANES", "64"))
+    iters_k1 = int(os.environ.get("BENCH_RR_ITERS", "60"))
+    reps = int(os.environ.get("BENCH_RR_REPS", "2"))
+    max_reps = int(os.environ.get("BENCH_RR_MAX_REPS", "4"))
+    reuse_k = int(os.environ.get("BENCH_RR_K", "4"))
+    # per-sample scarcity stall: the actor fleet can only refill the replay
+    # so fast, so a fresh batch is only WORTH drawing this often — sized so
+    # the K=1 loop is clearly sample-bound at the toy step time (the
+    # operating point where the PR-9 analyzer says `actor-bound`)
+    sample_us = int(os.environ.get("BENCH_RR_SAMPLE_US", "60000"))
+    parity_frames = int(os.environ.get("BENCH_RR_PARITY_FRAMES", "320"))
+    num_actions = 6
+    cfg = Config().replace(
+        compute_dtype="float32", frame_height=h, frame_width=w,
+        history_length=2, hidden_size=32, num_cosines=8,
+        num_tau_samples=4, num_tau_prime_samples=4, num_quantile_samples=4,
+        batch_size=16, multi_step=3,
+    )
+
+    rng = np.random.default_rng(0)
+    memory = PrioritizedReplay(
+        1 << 14, (h, w), history=2, n_step=3, gamma=0.99, lanes=lanes,
+        priority_exponent=0.5, seed=0,
+    )
+    for t in range(4096 // lanes + 8):
+        memory.append_batch(
+            rng.integers(0, 255, (lanes, h, w), dtype=np.uint8),
+            rng.integers(0, num_actions, lanes).astype(np.int64),
+            rng.normal(size=lanes).astype(np.float32),
+            (rng.random(lanes) < 0.01),
+        )
+
+    # undonated jit on CPU (donated dispatch runs synchronously there —
+    # same note as the apex_loop row)
+    learns = {
+        k: jax.jit(build_learn_step(
+            cfg.replace(replay_ratio=k), num_actions))
+        for k in (1, reuse_k)
+    }
+
+    def run(k: int, n_samples: int) -> "tuple[float, int]":
+        learn = learns[k]
+        state = init_train_state(cfg, num_actions, jax.random.PRNGKey(0))
+        key = jax.random.PRNGKey(1)
+        ring = WritebackRing(cfg.writeback_depth)
+        for _ in range(2):  # compile + warm
+            batch = to_device_batch(memory.sample(cfg.batch_size, 0.6))
+            key, kk = jax.random.split(key)
+            state, info = learn(state, batch, kk)
+        jax.block_until_ready(info["loss"])
+        n = 0
+        t0 = time.perf_counter()
+        for i in range(n_samples):
+            if sample_us:  # the emulated actor-bound sample supply
+                time.sleep(sample_us / 1e6)
+            sample = memory.sample(cfg.batch_size, 0.6)
+            batch = to_device_batch(sample)
+            key, kk = jax.random.split(key)
+            state, info = learn(state, batch, kk)
+            retired = ring.push((i + 1) * k, sample.idx, info)
+            if retired is not None:
+                memory.update_priorities(retired.idx, retired.priorities)
+            n = i + 1
+            if left() < 20:
+                break
+        for retired in ring.drain():
+            memory.update_priorities(retired.idx, retired.priorities)
+        jax.block_until_ready(info["loss"])
+        return n * k / (time.perf_counter() - t0), n
+
+    best = {1: 0.0, reuse_k: 0.0}
+    rep = 0
+    while rep < max_reps and left() > 30:
+        prev = dict(best)
+        order = (1, reuse_k) if rep % 2 == 0 else (reuse_k, 1)
+        for k in order:
+            # matched WALL budgets, not matched samples: the K arm takes
+            # ~K-fold fewer samples through the same stall per learn step
+            sps, _ = run(k, iters_k1 if k == 1 else max(iters_k1 // 2, 8))
+            best[k] = max(best[k], sps)
+            if left() < 25:
+                break
+        rep += 1
+        if rep >= reps and all(best.values()):
+            if all(best[k] <= prev[k] * 1.02 for k in best):
+                break
+    if not all(best.values()):
+        return []
+
+    # matched-env-frames eval parity: two REAL toy train() runs, K=1 vs K
+    eval_k1 = eval_kn = float("nan")
+    rollbacks = -1
+    parity = None  # None = parity arm never completed (vs False = failed)
+    if left() > 30:
+        from rainbow_iqn_apex_tpu.train import train
+
+        tmpdir = tempfile.mkdtemp(prefix="ria_reuse_bench_")
+        try:
+            scores = {}
+            for k in (1, reuse_k):
+                tcfg = Config(
+                    env_id="toy:chain", compute_dtype="float32",
+                    history_length=2, hidden_size=32, num_cosines=8,
+                    num_tau_samples=4, num_tau_prime_samples=4,
+                    num_quantile_samples=4, batch_size=16,
+                    learning_rate=1e-3, multi_step=3, gamma=0.9,
+                    memory_capacity=2048, learn_start=64,
+                    frames_per_learn=4, replay_ratio=k,
+                    target_update_period=64, num_envs_per_actor=4,
+                    metrics_interval=50, eval_interval=0,
+                    checkpoint_interval=0, eval_episodes=4,
+                    stall_timeout_s=0.0, seed=11,
+                    results_dir=os.path.join(tmpdir, f"r{k}"),
+                    checkpoint_dir=os.path.join(tmpdir, f"c{k}"),
+                )
+                summary = train(tcfg, max_frames=parity_frames)
+                scores[k] = summary
+                if left() < 10:
+                    break
+            if len(scores) == 2:
+                eval_k1 = float(scores[1]["eval_score_mean"])
+                eval_kn = float(scores[reuse_k]["eval_score_mean"])
+                rollbacks = int(scores[reuse_k]["rollbacks"])
+                parity = bool(
+                    np.isfinite(eval_k1) and np.isfinite(eval_kn)
+                    and rollbacks == 0 and eval_kn >= eval_k1 - 1.0
+                )
+        except Exception as e:  # noqa: BLE001 — parity is part of the row
+            print(f"bench: replay_reuse parity arm failed: {e!r}",
+                  file=sys.stderr)
+        finally:
+            shutil.rmtree(tmpdir, ignore_errors=True)
+    else:
+        print("bench: replay_reuse budget exhausted before parity arm",
+              file=sys.stderr, flush=True)
+
+    return [{
+        "metric": "replay_reuse_learn_steps_per_sec",
+        "value": round(best[reuse_k], 2),
+        "unit": (
+            f"learn_steps/s (replay_ratio={reuse_k} fused clipped reuse vs "
+            f"K=1 over the real sample->learn->write-back loop on "
+            f"{platform}: toy {h}x{w}x2 batch={cfg.batch_size}, "
+            f"{sample_us}us emulated actor-bound sample scarcity/sample; "
+            f"best-of-{rep} interleaved reps; plus matched-env-frames "
+            f"({parity_frames}) toy:chain eval parity K=1 vs K={reuse_k})"
+        ),
+        "vs_baseline": None,  # toy shape — not comparable to the 75/s class
+        "path": "replay_reuse",
+        "k": reuse_k,
+        "k1_steps_per_sec": round(best[1], 2),
+        "speedup_vs_k1": round(best[reuse_k] / max(best[1], 1e-9), 3),
+        "eval_k1": None if not np.isfinite(eval_k1) else round(eval_k1, 3),
+        "eval_k": None if not np.isfinite(eval_kn) else round(eval_kn, 3),
+        "reuse_rollbacks": rollbacks,
+        "eval_parity": parity,
+        "parity_frames": parity_frames,
+        "reps": rep,
     }]
 
 

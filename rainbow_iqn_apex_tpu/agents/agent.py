@@ -93,6 +93,10 @@ class Agent:
         self.num_actions = num_actions
         key, init_key = jax.random.split(key)
         self.key = key
+        # replay reuse (cfg.replay_ratio = K > 1): one learn_batch dispatch
+        # is a fused K-pass executable, so state.step — and the host mirror
+        # — advance K per call (ops/learn.py make_reuse_learn_step)
+        self.reuse_k = max(int(cfg.replay_ratio), 1)
         self._host_step: Optional[int] = None  # host mirror of state.step
         self.state: TrainState = init_train_state(
             cfg, num_actions, init_key, state_shape=state_shape
@@ -131,7 +135,7 @@ class Agent:
         dispatch) so the caller decides when — if ever per step — to sync."""
         self._state, info = self._learn(self._state, batch, self._next_key())
         if self._host_step is not None:
-            self._host_step += 1
+            self._host_step += self.reuse_k
         return info
 
     # `state` invalidates the host step mirror on direct assignment (resume,

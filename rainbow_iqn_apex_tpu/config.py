@@ -140,7 +140,29 @@ class Config:
     max_grad_norm: float = 10.0  # 0 disables clipping
     target_update_period: int = 8_000  # learner steps between hard target copies
     learn_start: int = 20_000  # transitions stored before learning begins
-    replay_ratio: int = 4  # env frames per learner step (single-process mode)
+    frames_per_learn: int = 4  # env frames per SAMPLED learner batch (the
+    # single-process / apex interleave cadence; was named `replay_ratio`
+    # through PR 11 — renamed because that name now means batch REUSE below,
+    # matching the literature's updates-per-sample sense)
+    replay_ratio: int = 1  # learner passes per sampled batch (K).  1
+    # (default) = the PR-11 path, bitwise: one SGD pass per sample.  K > 1
+    # re-uses each device-staged batch K times inside ONE fori_loop'd XLA
+    # executable (no K-fold dispatch), with an IMPACT-style clip
+    # (arXiv:1912.00167) on reuse passes 2..K: per-row importance ratios of
+    # the current Boltzmann policy (softmax over mean-of-tau q-values at the
+    # taken action) against the pass-1 behavior snapshot — evaluated under
+    # one shared ratio key, so zero parameter drift means ratio == 1 exactly
+    # — are clipped to [1/reuse_clip, reuse_clip] and scale the IS weights,
+    # so stale re-consumption can't blow up the IQN loss.  Priorities and
+    # the finite guard come from the FINAL pass, written back once per
+    # sample, so the WritebackRing still sees one entry per sample.  This is
+    # the actor-bound -> device-bound knob: learn_steps/s scales ~K at fixed
+    # env-frames/s (docs/PERFORMANCE.md "Replay reuse"; RUNBOOK verdict
+    # map).  Implemented for the single-process and apex IQN loops
+    # (multitask included); the r2d2/anakin loops reject K > 1.
+    reuse_clip: float = 2.0  # IMPACT clip bound c for reuse passes: per-row
+    # ratios outside [1/c, c] are clipped (and counted — learn rows carry
+    # the per-sample mean clip fraction, the K-too-high early warning)
     t_max: int = 200_000_000  # total env frames of training budget
 
     # ---- prioritized replay (SURVEY §2 rows 5-6) ----------------------------------

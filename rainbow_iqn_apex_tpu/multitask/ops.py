@@ -32,7 +32,12 @@ from rainbow_iqn_apex_tpu.multitask.model import (
     masked_q_values,
 )
 from rainbow_iqn_apex_tpu.multitask.spec import MultiGameSpec
-from rainbow_iqn_apex_tpu.ops.learn import Batch, TrainState, make_optimizer
+from rainbow_iqn_apex_tpu.ops.learn import (
+    Batch,
+    TrainState,
+    make_optimizer,
+    make_reuse_learn_step,
+)
 from rainbow_iqn_apex_tpu.ops.losses import quantile_huber_loss
 
 
@@ -93,7 +98,7 @@ def build_mt_learn_step(
     tx = make_optimizer(cfg)
     mask_table = jnp.asarray(action_mask_table(spec))
 
-    def loss_fn(params, target_params, batch: Batch, key):
+    def loss_fn(params, target_params, batch: Batch, key, weight_scale=None):
         (k_sel_tau, k_sel_noise, k_tgt_tau, k_tgt_noise,
          k_on_tau, k_on_noise) = jax.random.split(key, 6)
         game = batch.game
@@ -123,7 +128,10 @@ def build_mt_learn_step(
             on_q, batch.action[:, None, None], axis=-1)[..., 0]
         per_sample, td_abs = quantile_huber_loss(
             z_online, taus, td_target, cfg.kappa)
-        loss = jnp.mean(batch.weight * per_sample)
+        weight = batch.weight
+        if weight_scale is not None:  # clipped reuse ratio (ops/learn.py)
+            weight = weight * weight_scale
+        loss = jnp.mean(weight * per_sample)
         aux = {
             "td_abs": td_abs,
             "q_mean": on_q.mean(),
@@ -131,9 +139,10 @@ def build_mt_learn_step(
         }
         return loss, aux
 
-    def learn_step(state: TrainState, batch: Batch, key: chex.PRNGKey):
+    def learn_step(state: TrainState, batch: Batch, key: chex.PRNGKey,
+                   weight_scale=None):
         (loss, aux), grads = jax.value_and_grad(loss_fn, has_aux=True)(
-            state.params, state.target_params, batch, key
+            state.params, state.target_params, batch, key, weight_scale
         )
         updates, opt_state = tx.update(grads, state.opt_state, state.params)
         params = optax.apply_updates(state.params, updates)
@@ -163,7 +172,25 @@ def build_mt_learn_step(
             info,
         )
 
-    return learn_step
+    if cfg.replay_ratio <= 1:
+        return learn_step
+
+    # replay-ratio > 1 (ops/learn.py `make_reuse_learn_step`): the ratio's
+    # Boltzmann policy is masked to each row's own game, so a pad slot a
+    # sibling game owns can never contribute probability mass
+    def logp(params, batch: Batch, key):
+        k_tau, k_noise = jax.random.split(key)
+        quantiles, _ = net.apply(
+            {"params": params}, batch.obs, batch.game,
+            cfg.num_quantile_samples,
+            rngs={"taus": k_tau, "noise": k_noise},
+        )
+        q = masked_q_values(quantiles, batch.game, mask_table)
+        logits = jax.nn.log_softmax(q, axis=-1)
+        return jnp.take_along_axis(
+            logits, batch.action[:, None], axis=-1)[..., 0]
+
+    return make_reuse_learn_step(cfg, learn_step, logp)
 
 
 def build_mt_act_step(

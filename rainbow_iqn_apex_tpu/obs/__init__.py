@@ -158,13 +158,16 @@ class RunObs:
     def span(self, name: str, **attrs: Any):
         return self.tracer.span(name, **attrs)
 
-    def after_learn_step(self, step: int, block_on=None) -> None:
+    def after_learn_step(self, step: int, block_on=None,
+                         units: int = 1) -> None:
         """Per-learn-step bookkeeping: StepTimer lap + the --trace-dir
         window.  Leave ``block_on`` None when the loop already syncs on the
         step's scalars (NaN guard / priority write-back) or deliberately
         stays async (anakin) — a gratuitous barrier here would serialize
-        the host against the device queue."""
-        self.timer.lap(block_on)
+        the host against the device queue.  ``units`` = SGD steps the call
+        covers (replay reuse dispatches K per call — the timing row's
+        steps/steps_per_sec must count steps, not dispatches)."""
+        self.timer.lap(block_on, units=units)
         self.health.note_finite_step()
         self.trace_window.step(step)
 

@@ -35,6 +35,10 @@ ENVELOPE_KEYS = frozenset({"t", "ts", "host", "run", "kind", "schema"})
 # here are free-form but still get the envelope + sanitisation.
 REQUIRED_KEYS: Dict[str, frozenset] = {
     "learn": frozenset({"step", "frames", "loss"}),  # per-interval train row
+    # (replay-reuse runs — cfg.replay_ratio > 1 — additionally carry
+    # `replay_ratio`, `reuse_index` (last completed pass of the newest
+    # retired sample) and `clip_frac` (mean fraction of rows the IMPACT
+    # clip bounded per reuse pass); optional so K=1 rows stay byte-stable)
     "eval": frozenset({"step", "score_mean"}),
     "fault": frozenset({"event"}),  # supervisor/chaos events (PR 2)
     "serve": frozenset({"requests", "batches", "shed"}),

@@ -109,8 +109,14 @@ def loss_and_priorities(
     target_params: Params,
     batch: Batch,
     key: chex.PRNGKey,
+    weight_scale: Optional[jnp.ndarray] = None,
 ) -> Tuple[jnp.ndarray, Dict[str, jnp.ndarray]]:
-    """Quantile-Huber loss (IS-weighted mean) + diagnostics. SURVEY §3.4."""
+    """Quantile-Huber loss (IS-weighted mean) + diagnostics. SURVEY §3.4.
+
+    ``weight_scale`` ([B], optional) multiplies the IS weights — the clipped
+    IMPACT reuse ratio on replay-reuse passes (``make_reuse_learn_step``).
+    None (the default) leaves the trace byte-identical to the pre-reuse
+    path."""
     k_sel_tau, k_sel_noise, k_tgt_tau, k_tgt_noise, k_on_tau, k_on_noise = (
         jax.random.split(key, 6)
     )
@@ -150,7 +156,10 @@ def loss_and_priorities(
     # (SIGABRT) at every block size while this jnp path ran 1657 learn
     # steps/s device-resident — XLA's own fusion wins, kernel deleted.
     per_sample, td_abs = quantile_huber_loss(z_online, taus, td_target, cfg.kappa)
-    loss = jnp.mean(batch.weight * per_sample)
+    weight = batch.weight
+    if weight_scale is not None:
+        weight = weight * weight_scale
+    loss = jnp.mean(weight * per_sample)
     aux = {
         "td_abs": td_abs,
         "loss_per_sample": per_sample,
@@ -160,19 +169,113 @@ def loss_and_priorities(
     return loss, aux
 
 
-def build_learn_step(
-    cfg: Config, num_actions: int
+def make_policy_logp(
+    net: RainbowIQN, cfg: Config
+) -> Callable[[Params, Batch, chex.PRNGKey], jnp.ndarray]:
+    """[B] log-prob of each row's TAKEN action under the Boltzmann policy
+    softmax(mean-of-tau q-values) — the value-based stand-in for IMPACT's
+    pi(a|s) (arXiv:1912.00167) that replay-reuse importance ratios are built
+    from.  Derived from the online quantile distribution at K acting taus;
+    callers hand every pass the SAME key so two calls with identical params
+    return bitwise-identical log-probs (ratio drift measures parameter
+    drift only, never tau/noise resampling)."""
+
+    def logp(params: Params, batch: Batch, key: chex.PRNGKey) -> jnp.ndarray:
+        k_tau, k_noise = jax.random.split(key)
+        quantiles, _ = net.apply(
+            {"params": params},
+            batch.obs,
+            cfg.num_quantile_samples,
+            rngs={"taus": k_tau, "noise": k_noise},
+        )
+        logits = jax.nn.log_softmax(q_values(quantiles), axis=-1)
+        return jnp.take_along_axis(
+            logits, batch.action[:, None], axis=-1)[..., 0]
+
+    return logp
+
+
+def make_reuse_learn_step(
+    cfg: Config,
+    pass_fn: Callable[..., Tuple[TrainState, Dict[str, jnp.ndarray]]],
+    logp_fn: Callable[[Params, Batch, chex.PRNGKey], jnp.ndarray],
 ) -> Callable[[TrainState, Batch, chex.PRNGKey], Tuple[TrainState, Dict[str, jnp.ndarray]]]:
-    """Returns the un-jitted learn step; callers jit/pjit it with their own
-    sharding (single-chip agent vs mesh learner, parallel/apex.py)."""
-    net = make_network(cfg, num_actions)
-    tx = make_optimizer(cfg)
+    """Replay-ratio > 1: one fori_loop'd K-pass learn step (IMPACT-style
+    clipped reuse, arXiv:1912.00167) — XLA sees a SINGLE executable, so a
+    K-fold learn rate costs one dispatch per sampled batch.
+
+    Pass 1 is the plain learn step and snapshots the behavior policy's
+    per-row log-probs (``logp_fn`` under a dedicated ratio key, shared by
+    every pass).  Passes 2..K re-run the same batch with the IS weights
+    scaled by clip(pi_now / pi_behavior, 1/c, c), c = ``cfg.reuse_clip`` —
+    stale re-consumption of rows the policy has already moved away from is
+    bounded, which is what makes K > 1 safe under staleness.  The returned
+    info carries the FINAL pass's priorities (written back once per sample,
+    not once per pass), the AND of every pass's finite flag (a mid-reuse
+    NaN can't hide behind a later pass), and ``clip_frac`` = mean fraction
+    of rows clipped per reuse pass — the K-too-high early-warning signal.
+    ``state.step`` advances K per call (each pass IS an SGD step, so the
+    target-copy schedule keeps its meaning)."""
+    reuse_k = int(cfg.replay_ratio)
+    clip_c = float(cfg.reuse_clip)
 
     def learn_step(
         state: TrainState, batch: Batch, key: chex.PRNGKey
     ) -> Tuple[TrainState, Dict[str, jnp.ndarray]]:
+        k_ratio, k_loop = jax.random.split(key)
+        behav_logp = jax.lax.stop_gradient(
+            logp_fn(state.params, batch, k_ratio))
+        # pass 1: the unscaled learn step (ratio == 1 by definition)
+        state, info = pass_fn(state, batch, jax.random.fold_in(k_loop, 0))
+
+        def body(p, carry):
+            state, _info, clip_sum, finite = carry
+            logp = jax.lax.stop_gradient(logp_fn(state.params, batch, k_ratio))
+            ratio = jnp.exp(logp - behav_logp)
+            clipped = jnp.clip(ratio, 1.0 / clip_c, clip_c)
+            clip_frac = jnp.mean((ratio != clipped).astype(jnp.float32))
+            state, info = pass_fn(
+                state, batch, jax.random.fold_in(k_loop, p), clipped)
+            return (state, info, clip_sum + clip_frac,
+                    finite & info["finite"])
+
+        state, info, clip_sum, finite = jax.lax.fori_loop(
+            1, reuse_k, body,
+            (state, info, jnp.zeros((), jnp.float32), info["finite"]),
+        )
+        info = dict(info)
+        info["finite"] = finite
+        info["clip_frac"] = clip_sum / max(reuse_k - 1, 1)
+        # static row metadata: learn rows report reuse without a device read
+        info["replay_ratio"] = reuse_k
+        info["reuse_index"] = reuse_k - 1  # last completed pass this sample
+        return state, info
+
+    return learn_step
+
+
+def build_learn_step(
+    cfg: Config, num_actions: int
+) -> Callable[[TrainState, Batch, chex.PRNGKey], Tuple[TrainState, Dict[str, jnp.ndarray]]]:
+    """Returns the un-jitted learn step; callers jit/pjit it with their own
+    sharding (single-chip agent vs mesh learner, parallel/apex.py).
+
+    ``cfg.replay_ratio`` = 1 (default) returns the single-pass step,
+    bitwise the PR-11 path; K > 1 wraps it in ``make_reuse_learn_step`` —
+    one fori_loop'd K-pass executable with the IMPACT clip."""
+    net = make_network(cfg, num_actions)
+    tx = make_optimizer(cfg)
+
+    def learn_step(
+        state: TrainState,
+        batch: Batch,
+        key: chex.PRNGKey,
+        weight_scale: Optional[jnp.ndarray] = None,
+    ) -> Tuple[TrainState, Dict[str, jnp.ndarray]]:
         def loss_fn(params):
-            return loss_and_priorities(net, cfg, params, state.target_params, batch, key)
+            return loss_and_priorities(
+                net, cfg, params, state.target_params, batch, key,
+                weight_scale)
 
         (loss, aux), grads = jax.value_and_grad(loss_fn, has_aux=True)(state.params)
         updates, opt_state = tx.update(grads, state.opt_state, state.params)
@@ -210,7 +313,9 @@ def build_learn_step(
             info,
         )
 
-    return learn_step
+    if cfg.replay_ratio <= 1:
+        return learn_step
+    return make_reuse_learn_step(cfg, learn_step, make_policy_logp(net, cfg))
 
 
 def build_act_step(

@@ -45,7 +45,11 @@ from rainbow_iqn_apex_tpu.utils import hostsync
 from rainbow_iqn_apex_tpu.utils.writeback import (
     RingCommitter,
     WritebackRing,
+    cadence_hit,
+    check_reuse_cadences,
     pipeline_gauges,
+    reuse_health,
+    reuse_learn_row,
 )
 from rainbow_iqn_apex_tpu.config import Config
 from rainbow_iqn_apex_tpu.envs import make_vector_env
@@ -149,6 +153,10 @@ class ApexDriver(QuantPublishMixin):
         self.cfg = cfg
         self.num_actions = num_actions
         self.spec = spec
+        # replay reuse (ops/learn.py make_reuse_learn_step): one learn
+        # dispatch = a fused K-pass executable, so state.step — and the
+        # host step mirror — advance K per learn_batch call
+        self.reuse_k = max(int(cfg.replay_ratio), 1)
         ldevs, adevs = split_devices(devices, cfg.learner_devices)
         self.lmesh = learner_mesh(ldevs)
         self.amesh = actor_mesh(adevs)
@@ -385,7 +393,7 @@ class ApexDriver(QuantPublishMixin):
         async dispatch) — the write-back ring decides when to sync."""
         self._state, info = self._learn(self._state, batch, self._next_key())
         if self._host_step is not None:
-            self._host_step += 1
+            self._host_step += self.reuse_k
         return info
 
     # ------------------------------------------------------------- multi-host
@@ -763,6 +771,16 @@ def train_apex(cfg: Config, max_frames: Optional[int] = None) -> Dict[str, Any]:
     )
     last_scalars = committer.scalars  # newest RETIRED step's host scalars
     _commit, _drain = committer.commit, committer.drain
+    # replay reuse (docs/PERFORMANCE.md "Replay reuse"): one sampled batch
+    # drives a fused K-pass learn dispatch, so the step counter jumps K per
+    # sample — the sample trigger divides steps back into samples, cadences
+    # fire on crossings (cadence_hit), and the ring still holds one entry
+    # per SAMPLE (final-pass priorities), so priorities lag samples, not
+    # passes
+    reuse_k = driver.reuse_k
+    check_reuse_cadences(cfg, "metrics_interval", "eval_interval",
+                         "checkpoint_interval", "guard_snapshot_interval",
+                         "weight_publish_interval")
 
     if multihost and cfg.pipelined_actor:
         raise ValueError("pipelined_actor is single-host only (for now)")
@@ -884,7 +902,11 @@ def train_apex(cfg: Config, max_frames: Optional[int] = None) -> Dict[str, Any]:
                         cfg.batch_size,
                         lambda: priority_beta(cfg, frames),
                         lambda: len(memory),
+                        # replay reuse: one staged batch feeds K fused
+                        # learn passes — the pusher shrinks its queue depth
+                        # and device-side draw-ahead K-fold from reuse=
                         depth=cfg.sample_ahead_depth,
+                        reuse=reuse_k,
                         registry=obs_run.registry,
                     )
                 elif cfg.prefetch_depth > 0 and prefetcher is None:
@@ -908,7 +930,8 @@ def train_apex(cfg: Config, max_frames: Optional[int] = None) -> Dict[str, Any]:
                             memory, cfg, lambda: priority_beta(cfg, frames),
                             registry=obs_run.registry,
                         )
-                steps_due = frames // cfg.replay_ratio - driver.step
+                steps_due = (frames // cfg.frames_per_learn
+                             - driver.step // reuse_k)
                 for _ in range(max(steps_due, 0)):
                     if sup.snapshot_due(driver.step):
                         # drain BEFORE capturing: the snapshot must never
@@ -984,7 +1007,7 @@ def train_apex(cfg: Config, max_frames: Optional[int] = None) -> Dict[str, Any]:
                     if not _commit(ring.push(driver.step, idx, info)):
                         continue
                     step = driver.step
-                    obs_run.after_learn_step(step)
+                    obs_run.after_learn_step(step, units=reuse_k)
                     if step - last_pub >= cfg.weight_publish_interval:
                         # ring boundary: actors must never adopt params with
                         # an unverified step in their history, so everything
@@ -999,7 +1022,7 @@ def train_apex(cfg: Config, max_frames: Optional[int] = None) -> Dict[str, Any]:
                         ).set(version)
                         if heartbeat is not None:
                             heartbeat.set_weight_version(version)
-                    if step % cfg.metrics_interval == 0:
+                    if cadence_hit(step, cfg.metrics_interval, reuse_k):
                         fence.observe(
                             driver.actor_weights_version,
                             driver.weights_version,
@@ -1017,6 +1040,7 @@ def train_apex(cfg: Config, max_frames: Optional[int] = None) -> Dict[str, Any]:
                             q_mean=last_scalars.get("q_mean", float("nan")),
                             mean_return=float(np.mean(returns)) if returns else float("nan"),
                             staleness=step - last_pub,
+                            **reuse_learn_row(reuse_k, last_scalars),
                         )
                         obs_run.periodic(
                             step,
@@ -1034,7 +1058,10 @@ def train_apex(cfg: Config, max_frames: Optional[int] = None) -> Dict[str, Any]:
                             weight_staleness=step - last_pub,
                             weights_version=driver.weights_version,
                             weight_version_lag=fence.lag,
-                            **pipeline_gauges(ring, obs_run.registry, frontier),
+                            **pipeline_gauges(
+                                ring, obs_run.registry, frontier,
+                                reuse=reuse_health(reuse_k, last_scalars),
+                            ),
                         )
                         if spec is not None:
                             # per-game breakdown (docs/MULTITASK.md): learn
@@ -1055,8 +1082,18 @@ def train_apex(cfg: Config, max_frames: Optional[int] = None) -> Dict[str, Any]:
                             )
                         # lag-attribution row (obs/pipeline_trace.py):
                         # sample age / retirement / publish->adopt
-                        # percentiles, RunHealth folds budget breaches
-                        ptrace.emit_lag_row(step)
+                        # percentiles, RunHealth folds budget breaches.
+                        # Reuse accounting: K > 1 multiplies learn_steps/s
+                        # at a fixed publish-interval-in-steps, so the WALL
+                        # publish cadence — and with it the publish->adopt
+                        # budget — shrinks ~K-fold; the row carries
+                        # replay_ratio so a budget shift reads as the knob,
+                        # not a regression.
+                        ptrace.emit_lag_row(
+                            step,
+                            **({} if reuse_k == 1
+                               else {"replay_ratio": reuse_k}),
+                        )
                         if monitor is not None:
                             # a preempted host stops heartbeating; the
                             # host_dead row is the external supervisor's
@@ -1081,7 +1118,7 @@ def train_apex(cfg: Config, max_frames: Optional[int] = None) -> Dict[str, Any]:
                                     epoch=lease.epoch, step=step,
                                     frames=frames,
                                 )
-                    if cfg.eval_interval and step % cfg.eval_interval == 0:
+                    if cadence_hit(step, cfg.eval_interval, reuse_k):
                         # the drain runs on EVERY host (the cadence is a
                         # function of the lockstep step counter) so a
                         # rollback here stays lockstep; only the eval
@@ -1096,7 +1133,7 @@ def train_apex(cfg: Config, max_frames: Optional[int] = None) -> Dict[str, Any]:
                                 "eval", step=step,
                                 **_eval_learner(cfg, env, driver),
                             )
-                    if cfg.checkpoint_interval and step % cfg.checkpoint_interval == 0:
+                    if cadence_hit(step, cfg.checkpoint_interval, reuse_k):
                         if not _drain():  # checkpoint only verified params
                             continue
                         # every host calls save — Orbax treats it as a

@@ -445,6 +445,11 @@ def train_apex_r2d2(cfg: Config, max_frames: Optional[int] = None) -> Dict[str, 
     hosts — the multi-host learn trigger therefore uses only the global
     frame counter (after enough ticks every lane has emitted at least one
     full window deterministically)."""
+    if cfg.replay_ratio > 1:
+        raise ValueError(
+            "replay_ratio > 1 (clipped replay reuse) is implemented for the "
+            "IQN apex/single loops; sequence-batch reuse under stored LSTM "
+            "state is the recorded ROADMAP follow-up")
     total_frames = max_frames or cfg.t_max
     lanes_total = cfg.num_actors * cfg.num_envs_per_actor
     seq_total = cfg.r2d2_burn_in + cfg.r2d2_seq_len
@@ -618,7 +623,7 @@ def train_apex_r2d2(cfg: Config, max_frames: Optional[int] = None) -> Dict[str, 
     _commit, _drain = committer.commit, committer.drain
 
     learn_start_seqs = max(cfg.learn_start // seq_total, 8)  # single-host gate
-    frames_per_step = cfg.replay_ratio * cfg.r2d2_seq_len
+    frames_per_step = cfg.frames_per_learn * cfg.r2d2_seq_len
     # multi-host learn trigger: frames-only (lockstep-deterministic), and
     # counted from THIS (re)start so a resume with a cold/torn replay
     # snapshot re-warms instead of sampling an empty buffer; by this many

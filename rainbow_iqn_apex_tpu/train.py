@@ -1,6 +1,6 @@
 """Single-process Rainbow-IQN training loop (reference parity: the 1-actor,
 no-Ape-X mode of `train_agent_apex.py`, SURVEY.md §3.1+§3.2 merged into one
-process — act/learn interleaved at `replay_ratio` env frames per learner step,
+process — act/learn interleaved at `frames_per_learn` env frames per learner step,
 scheduled target update, Orbax checkpoints, JSONL metrics, periodic eval).
 
 The Ape-X multi-role path lives in parallel/apex.py; this file is the
@@ -21,7 +21,11 @@ from rainbow_iqn_apex_tpu.utils.prefetch import BatchPrefetcher, make_replay_pre
 from rainbow_iqn_apex_tpu.utils.writeback import (
     RingCommitter,
     WritebackRing,
+    cadence_hit,
+    check_reuse_cadences,
     pipeline_gauges,
+    reuse_health,
+    reuse_learn_row,
 )
 from rainbow_iqn_apex_tpu.config import Config
 from rainbow_iqn_apex_tpu.envs import make_vector_env
@@ -109,6 +113,13 @@ def train(cfg: Config, max_frames: Optional[int] = None) -> Dict[str, Any]:
     )
     last_scalars = committer.scalars
     _commit, _drain = committer.commit, committer.drain
+    # replay reuse (docs/PERFORMANCE.md "Replay reuse"): each sampled batch
+    # drives one fused K-pass learn dispatch, so the step counter jumps K
+    # per sample — cadences use cadence_hit (crossing, not % == 0) and the
+    # sample trigger divides the step count back into samples
+    reuse_k = agent.reuse_k
+    check_reuse_cadences(cfg, "metrics_interval", "eval_interval",
+                         "checkpoint_interval", "guard_snapshot_interval")
 
     try:
         while frames < total_frames:
@@ -127,7 +138,7 @@ def train(cfg: Config, max_frames: Optional[int] = None) -> Dict[str, Any]:
             for r in ep_returns[~np.isnan(ep_returns)]:
                 returns.append(float(r))
 
-            # one learner step per `replay_ratio` env frames once warm
+            # one learner step per `frames_per_learn` env frames once warm
             if len(memory) >= cfg.learn_start and memory.sampleable:
                 if cfg.prefetch_depth > 0 and prefetcher is None:
                     # background sampler overlaps batch assembly + transfer
@@ -136,7 +147,7 @@ def train(cfg: Config, max_frames: Optional[int] = None) -> Dict[str, Any]:
                         memory, cfg, lambda: priority_beta(cfg, frames),
                         registry=obs_run.registry,
                     )
-                steps_due = frames // cfg.replay_ratio - agent.step
+                steps_due = frames // cfg.frames_per_learn - agent.step // reuse_k
                 for _ in range(max(steps_due, 0)):
                     if sup.snapshot_due(agent.step):
                         # drain first: the rollback target must never hold a
@@ -166,8 +177,8 @@ def train(cfg: Config, max_frames: Optional[int] = None) -> Dict[str, Any]:
                         continue
 
                     step = agent.step
-                    obs_run.after_learn_step(step)
-                    if step % cfg.metrics_interval == 0:
+                    obs_run.after_learn_step(step, units=reuse_k)
+                    if cadence_hit(step, cfg.metrics_interval, reuse_k):
                         metrics.log(
                             "learn",
                             step=step,
@@ -177,6 +188,7 @@ def train(cfg: Config, max_frames: Optional[int] = None) -> Dict[str, Any]:
                             q_mean=last_scalars.get("q_mean", float("nan")),
                             grad_norm=last_scalars.get("grad_norm", float("nan")),
                             mean_return=float(np.mean(returns)) if returns else float("nan"),
+                            **reuse_learn_row(reuse_k, last_scalars),
                         )
                         obs_run.periodic(
                             step,
@@ -185,14 +197,17 @@ def train(cfg: Config, max_frames: Optional[int] = None) -> Dict[str, Any]:
                             replay_occupancy=round(
                                 len(memory) / max(cfg.memory_capacity, 1), 4
                             ),
-                            **pipeline_gauges(ring, obs_run.registry),
+                            **pipeline_gauges(
+                                ring, obs_run.registry,
+                                reuse=reuse_health(reuse_k, last_scalars),
+                            ),
                         )
-                    if cfg.eval_interval and step % cfg.eval_interval == 0:
+                    if cadence_hit(step, cfg.eval_interval, reuse_k):
                         if not _drain():  # evaluate only verified params
                             continue
                         last_eval = evaluate(cfg, agent, seed=cfg.seed + 977)
                         metrics.log("eval", step=step, **last_eval)
-                    if cfg.checkpoint_interval and step % cfg.checkpoint_interval == 0:
+                    if cadence_hit(step, cfg.checkpoint_interval, reuse_k):
                         if not _drain():  # checkpoint only verified params
                             continue
                         sup.save_checkpoint(

@@ -3,7 +3,7 @@ PER replay (replay/device.py) + the fused sample->learn->write-back tick —
 with host envs feeding one small [L, H, W] frame tensor per tick.
 
 Reference parity: same algorithm and schedules as the single-process mode
-(`train.py`, SURVEY.md §3.1+§3.2) — act/learn interleaved at `replay_ratio`,
+(`train.py`, SURVEY.md §3.1+§3.2) — act/learn interleaved at `frames_per_learn`,
 n-step PER with the reference's max-priority insertion for fresh transitions,
 scheduled target update (inside the learn graph), Orbax checkpoints, JSONL
 metrics, periodic eval.  What changes is WHERE the replay lives: the
@@ -86,6 +86,11 @@ def train_anakin(cfg: Config, max_frames: Optional[int] = None) -> Dict[str, Any
 
     With a pure-JAX env (`jaxgame:*`) and `fused_env` on, dispatches to the
     fully fused variant (env compiled into the graph) below."""
+    if cfg.replay_ratio > 1:
+        raise ValueError(
+            "replay_ratio > 1 (clipped replay reuse) targets the actor-bound "
+            "apex/single loops; the anakin learner is already fused "
+            "device-resident — reuse there is the recorded ROADMAP follow-up")
     if cfg.fused_env and cfg.env_id.startswith("jaxgame:"):
         return train_anakin_fused(cfg, max_frames)
     total_frames = max_frames or cfg.t_max
@@ -179,7 +184,7 @@ def train_anakin(cfg: Config, max_frames: Optional[int] = None) -> Dict[str, Any
             # warmness from host-side lockstep counters (appends lag one tick)
             stored = min(max(ticks - 1, 0), seg) * lanes
             if stored >= cfg.learn_start and ticks - 1 > cfg.multi_step:
-                steps_due = frames // cfg.replay_ratio - learn_steps
+                steps_due = frames // cfg.frames_per_learn - learn_steps
                 for _ in range(max(steps_due, 0)):
                     key, k = jax.random.split(key)
                     with obs_run.span("learn_step"):
@@ -256,7 +261,7 @@ def build_fused_segment(cfg: Config, game, replay: DeviceReplay, learn_fn):
     from rainbow_iqn_apex_tpu.envs.device_games import batched_reset_step
 
     lanes = cfg.num_envs_per_actor
-    learns_per_tick = lanes // cfg.replay_ratio
+    learns_per_tick = lanes // cfg.frames_per_learn
     seg = replay.seg
     act_fn = build_act_step(cfg, game.num_actions, use_noise=True)
     env_step = batched_reset_step(game)
@@ -369,17 +374,17 @@ def train_anakin_fused(cfg: Config, max_frames: Optional[int] = None) -> Dict[st
     max-priority fresh insertion, same two-channel terminal/truncation cuts,
     same beta anneal (computed in-graph from the frame counter), learning
     gated in-graph on the same warmness rule.  One deliberate deviation: the
-    learn cadence is `lanes/replay_ratio` steps per tick (lanes must divide
-    by replay_ratio), the in-graph form of `frames // replay_ratio`.
+    learn cadence is `lanes/frames_per_learn` steps per tick (lanes must divide
+    by frames_per_learn), the in-graph form of `frames // frames_per_learn`.
     """
     from rainbow_iqn_apex_tpu.envs.device_games import make_device_game
 
     total_frames = max_frames or cfg.t_max
     lanes = cfg.num_envs_per_actor
-    if lanes % cfg.replay_ratio:
+    if lanes % cfg.frames_per_learn:
         raise ValueError(
-            f"fused anakin needs lanes ({lanes}) divisible by replay_ratio "
-            f"({cfg.replay_ratio}) — the learn cadence is in-graph"
+            f"fused anakin needs lanes ({lanes}) divisible by frames_per_learn "
+            f"({cfg.frames_per_learn}) — the learn cadence is in-graph"
         )
     T = cfg.anakin_segment_ticks
     game = make_device_game(cfg.env_id.split(":", 1)[1])

@@ -11,7 +11,7 @@ Semantics pinned to the host R2D2 trainer (train_r2d2.py):
   - the actor sees frame-stacked input AND an LSTM; the replay stores single
     frames + the PRE-act LSTM state of each step (stored-state replay);
   - LSTM state zero-resets on terminal OR truncation (keep mask);
-  - learn cadence: one sequence learn step per replay_ratio * r2d2_seq_len
+  - learn cadence: one sequence learn step per frames_per_learn * r2d2_seq_len
     env frames — the same per-transition reuse as the feedforward path —
     expressed statically as `period` ticks per step (or k steps per tick
     when lanes exceed that frame budget);
@@ -66,8 +66,8 @@ def _seq_geometry(cfg: Config):
 
 def _learn_cadence(cfg: Config):
     """Static (period_ticks, learns_per_tick) for the in-graph cadence:
-    one learn step per replay_ratio * r2d2_seq_len env frames."""
-    fps = cfg.replay_ratio * cfg.r2d2_seq_len
+    one learn step per frames_per_learn * r2d2_seq_len env frames."""
+    fps = cfg.frames_per_learn * cfg.r2d2_seq_len
     lanes = cfg.num_envs_per_actor
     if fps % lanes == 0:
         return fps // lanes, 1
@@ -83,7 +83,7 @@ def _learn_cadence(cfg: Config):
     above = min((d for d in valid if d > lanes), default=None)
     near = " or ".join(str(d) for d in (below, above) if d is not None)
     raise ValueError(
-        f"fused R2D2 anakin needs lanes ({lanes}) and replay_ratio * "
+        f"fused R2D2 anakin needs lanes ({lanes}) and frames_per_learn * "
         f"r2d2_seq_len ({fps}) to divide one another — the learn cadence "
         f"is compiled into the graph.  Nearest valid --num-envs-per-actor: "
         f"{near}"
@@ -248,6 +248,11 @@ def train_anakin_r2d2(cfg: Config,
         tick_budget,
     )
 
+    if cfg.replay_ratio > 1:
+        raise ValueError(
+            "replay_ratio > 1 (clipped replay reuse) is implemented for the "
+            "single-process and apex IQN loops; the fused anakin R2D2 "
+            "learner rejects it (ROADMAP follow-up)")
     if not (cfg.fused_env and cfg.env_id.startswith("jaxgame:")):
         return _train_anakin_r2d2_hostfed(cfg, max_frames)
     total_frames = max_frames or cfg.t_max
@@ -480,7 +485,7 @@ def _train_anakin_r2d2_hostfed(cfg: Config,
     prev = None
     returns: collections.deque = collections.deque(maxlen=100)
     device = jax.devices()[0]
-    frames_per_step = cfg.replay_ratio * cfg.r2d2_seq_len
+    frames_per_step = cfg.frames_per_learn * cfg.r2d2_seq_len
     warm = False  # latches: filled is monotone, so stop syncing once open
 
     # one eval agent for the whole run (rebuilding it per eval would redo

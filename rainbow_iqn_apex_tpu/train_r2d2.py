@@ -126,6 +126,11 @@ def evaluate_r2d2(cfg: Config, agent: R2D2Agent, episodes: Optional[int] = None,
 
 
 def train_r2d2(cfg: Config, max_frames: Optional[int] = None) -> Dict[str, Any]:
+    if cfg.replay_ratio > 1:
+        raise ValueError(
+            "replay_ratio > 1 (clipped replay reuse) is implemented for the "
+            "single-process and apex IQN loops; sequence-batch reuse under "
+            "LSTM state is the recorded ROADMAP follow-up")
     total_frames = max_frames or cfg.t_max
     lanes = cfg.num_envs_per_actor
     env = make_vector_env(cfg.env_id, lanes, seed=cfg.seed)
@@ -188,10 +193,10 @@ def train_r2d2(cfg: Config, max_frames: Optional[int] = None) -> Dict[str, Any]:
             if len(memory) >= learn_start_seqs:
                 # Cadence normalised to the SAME per-transition reuse as the
                 # feedforward path: an IQN step consumes batch_size transitions
-                # per replay_ratio frames; an R2D2 step consumes batch_size
+                # per frames_per_learn frames; an R2D2 step consumes batch_size
                 # sequences x seq_len trained steps, so one learn step per
-                # replay_ratio * seq_len env frames gives identical reuse.
-                frames_per_step = cfg.replay_ratio * cfg.r2d2_seq_len
+                # frames_per_learn * seq_len env frames gives identical reuse.
+                frames_per_step = cfg.frames_per_learn * cfg.r2d2_seq_len
                 steps_due = frames // frames_per_step - agent.step
                 for _ in range(max(steps_due, 0)):
                     with obs_run.span("replay_sample"):

@@ -144,6 +144,14 @@ class SampleAheadPusher(BatchPrefetcher):
 
     ``prefetch_queue_depth`` / ``prefetch_empty_wait_*`` stay live through
     the base class, so existing starvation triage keeps working.
+
+    ``reuse`` (cfg.replay_ratio, docs/PERFORMANCE.md "Replay reuse"): one
+    staged batch feeds K fused learn passes, so the learner pops K-fold
+    fewer batches per learn step — BOTH the staged-queue ``depth`` and the
+    device-side ``draw_ahead`` shrink by the same factor (ceil, floor 1)
+    HERE, in one place, keeping HBM index blocks and host gather work
+    proportional to the SAMPLE rate instead of the step rate.  Callers
+    pass their un-shrunk depths plus ``reuse``.
     """
 
     def __init__(
@@ -155,6 +163,7 @@ class SampleAheadPusher(BatchPrefetcher):
         n_items_fn: Callable[[], int],
         depth: int = 2,
         draw_ahead: int = 2,
+        reuse: int = 1,
         registry=None,
         role: str = "prefetch",
     ):
@@ -163,7 +172,9 @@ class SampleAheadPusher(BatchPrefetcher):
         self._B = int(batch_size)
         self._beta_fn = beta_fn
         self._n_items_fn = n_items_fn
-        self._draw_ahead = max(int(draw_ahead), 1)
+        shrink = max(int(reuse), 1)
+        self._draw_ahead = max(-(-int(draw_ahead) // shrink), 1)
+        depth = max(-(-int(depth) // shrink), 1)
         self._blocks: collections.deque = collections.deque()
         self._batches: collections.deque = collections.deque()
         self._g_sa_depth = self._c_stale = None

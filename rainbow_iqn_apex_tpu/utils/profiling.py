@@ -39,10 +39,14 @@ class StepTimer:
     def __init__(self, warmup: int = 3):
         self.warmup = warmup
         self._laps = []
+        self._units = 0  # SGD steps covered by the recorded laps
         self._count = 0
         self._last: Optional[float] = None
 
-    def lap(self, block_on=None) -> Optional[float]:
+    def lap(self, block_on=None, units: int = 1) -> Optional[float]:
+        """``units``: SGD steps this lap covers — replay reuse (cfg.
+        replay_ratio = K > 1) makes one timed dispatch K steps, and
+        ``steps_per_sec`` must report steps, not dispatches."""
         if block_on is not None:
             jax.block_until_ready(block_on)
         now = time.perf_counter()
@@ -52,6 +56,7 @@ class StepTimer:
             self._count += 1
             if self._count > self.warmup:
                 self._laps.append(dt)
+                self._units += max(int(units), 1)
         self._last = now
         return dt
 
@@ -61,10 +66,12 @@ class StepTimer:
         laps = sorted(self._laps)
         n = len(laps)
         return {
-            "steps": n,
+            # percentiles are per timed LAP (one dispatch); steps /
+            # steps_per_sec are in SGD steps (== laps unless reuse ran)
+            "steps": self._units,
             "mean_s": sum(laps) / n,
             "p50_s": laps[n // 2],
             "p90_s": laps[min(int(n * 0.9), n - 1)],
             "p99_s": laps[min(int(n * 0.99), n - 1)],
-            "steps_per_sec": n / sum(laps),
+            "steps_per_sec": self._units / sum(laps),
         }

@@ -172,8 +172,72 @@ class WritebackRing:
         return out
 
 
+def cadence_hit(step: int, interval: int, reuse_k: int = 1) -> bool:
+    """Did the step counter CROSS a multiple of ``interval`` in the jump
+    that landed on ``step``?  With replay reuse (cfg.replay_ratio = K > 1)
+    the learner step advances K per fused dispatch, so ``step % interval ==
+    0`` would silently skip any cadence not divisible by K; ``step %
+    interval < K`` fires exactly once per crossing instead (intervals are
+    assumed >= K — every production cadence is orders of magnitude above
+    it).  K = 1 degenerates to the exact ``% == 0`` the pre-reuse loops
+    ran, so the default path's behaviour is unchanged."""
+    return bool(interval) and step % interval < max(int(reuse_k), 1)
+
+
+def check_reuse_cadences(cfg, *names: str) -> None:
+    """``cadence_hit`` (and the delta-based publish/snapshot cadences) fire
+    once per interval CROSSING under step jumps of K = cfg.replay_ratio,
+    assuming every live interval >= K; a sub-K interval fires every fused
+    dispatch — eval/drain after each learn call, the per-step-sync loop the
+    ring exists to avoid — with no error.  The reuse loops call this at
+    start to make the documented assumption real."""
+    k = max(int(cfg.replay_ratio), 1)
+    if k == 1:
+        return
+    for name in names:
+        iv = int(getattr(cfg, name) or 0)
+        if iv and iv < k:
+            raise ValueError(
+                f"{name} ({iv}) must be 0 (off) or >= replay_ratio ({k}): "
+                "the step counter advances K per fused reuse dispatch and "
+                "cadences fire once per interval crossing, so a sub-K "
+                "interval would fire EVERY dispatch "
+                "(docs/PERFORMANCE.md \"Replay reuse\")")
+
+
+def reuse_learn_row(reuse_k: int,
+                    scalars: Dict[str, Any]) -> Dict[str, Any]:
+    """Learn-row extras for a replay-reuse run (docs/PERFORMANCE.md "Replay
+    reuse"), from the newest RETIRED sample's host scalars — one definition
+    so train.py and parallel/apex.py can't drift on the row surface (same
+    rationale as ``pipeline_gauges``).  Empty at K = 1 so default-path rows
+    stay byte-identical."""
+    if reuse_k == 1:
+        return {}
+    ri = scalars.get("reuse_index")
+    return {
+        "replay_ratio": reuse_k,
+        "reuse_index": None if ri is None else int(ri),
+        "clip_frac": scalars.get("clip_frac"),
+    }
+
+
+def reuse_health(reuse_k: int,
+                 scalars: Dict[str, Any]) -> Optional[Dict[str, Any]]:
+    """The ``pipeline_gauges(reuse=)`` payload for health rows: None at
+    K = 1 (rows stay byte-identical), else K + the newest retired sample's
+    mean reuse-pass clip fraction (the K-too-high early warning)."""
+    if reuse_k == 1:
+        return None
+    return {
+        "replay_ratio": reuse_k,
+        "reuse_clip_frac": scalars.get("clip_frac"),
+    }
+
+
 def pipeline_gauges(ring: WritebackRing, registry,
-                    frontier=None) -> Dict[str, float]:
+                    frontier=None, reuse: Optional[Dict[str, Any]] = None,
+                    ) -> Dict[str, float]:
     """The pipeline-health gauges every loop feeds to ``obs_run.periodic``
     (and obs_report keys on as the ``pipeline:`` line) — one definition so
     the three loops can't drift on the surface (docs/PERFORMANCE.md)."""
@@ -187,6 +251,12 @@ def pipeline_gauges(ring: WritebackRing, registry,
             "prefetch_empty_wait_total", "prefetch"
         ).get(),
     }
+    if reuse:
+        # replay reuse live (cfg.replay_ratio > 1): present on health rows
+        # ONLY then, so a K=1 run's rows stay byte-identical and obs_report
+        # can tell a reusing run at a glance (replay_ratio, newest retired
+        # sample's mean reuse-pass clip fraction — the K-too-high signal)
+        out.update(reuse)
     if frontier is not None:
         # device-sampling pipeline (replay/frontier.py) — present on health
         # rows ONLY when the frontier is live, so obs_report can tell a

@@ -18,6 +18,7 @@ minute:
   apex_loop.speedup_vs_depth0       pipelined ring vs per-step-sync loop
   sample_path.speedup_vs_host       device frontier vs host sum-tree
   weight_publish.ratio_vs_fp32      int8-delta bytes vs fp32 full
+  replay_reuse.speedup_vs_k1        fused K-pass clipped reuse vs K=1
   trace_overhead (inverted)         traced/untraced — gated ABSOLUTE <= cap
                                     in `make trace-smoke`, reported here
 
@@ -47,11 +48,13 @@ GATED = {
     "apex_loop": "speedup_vs_depth0",
     "sample_path": "speedup_vs_host",
     "weight_publish": "ratio_vs_fp32",
+    "replay_reuse": "speedup_vs_k1",
 }
 # path -> metric reported (warn-only): raw rates, machine-weather-dependent
 REPORTED = {
     "host_feed": "value",
     "apex_loop": "value",
+    "replay_reuse": "value",
     "sample_path": "value",
     "trace_overhead": "value",
     # the multi-game tax ratio is deliberately report-only (ISSUE 10): the

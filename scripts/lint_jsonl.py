@@ -21,7 +21,12 @@ chaos-soak run dir lints as strictly as a training run dir, and the
 pipeline-tracing rows (``span_link``/``lag`` — obs/pipeline_trace.py), so a
 traced run dir lints before trace_export/obs_report consume it, and the
 cross-host serving rows (``net``/``gossip`` — serving/net/), so a net-smoke
-run dir lints before its `net:` report section is read.
+run dir lints before its `net:` report section is read.  Replay-reuse runs
+(cfg.replay_ratio > 1) extend ``learn``/``health``/``lag`` rows with
+``replay_ratio``/``reuse_index``/``clip_frac``/``reuse_clip_frac`` — all
+optional payload keys under the same strict-JSON rules (obs/schema.py
+documents them on the learn kind), and the ``replay_reuse`` bench row's
+fields ride through the bench JSONL the perf-smoke target lints.
 """
 
 from __future__ import annotations

@@ -367,6 +367,14 @@ def aggregate(rows: List[Dict[str, Any]]) -> Dict[str, Any]:
             .get("sample_ahead_stale_indices"),
             "mirror_reconcile_s": _last_with(rows, "health", "mirror_reconcile_s")
             .get("mirror_reconcile_s"),
+            # replay reuse (docs/PERFORMANCE.md "Replay reuse"): present
+            # only when the run ran cfg.replay_ratio > 1 — K and the newest
+            # retired sample's mean reuse-pass clip fraction (a climbing
+            # fraction is the K-too-high early warning)
+            "replay_ratio": _last_with(rows, "health", "replay_ratio")
+            .get("replay_ratio"),
+            "reuse_clip_frac": _last_with(rows, "health", "reuse_clip_frac")
+            .get("reuse_clip_frac"),
         },
         # critical-path attribution (obs/pipeline_trace.py): which stage
         # owns the largest exclusive share of traced end-to-end latency —
@@ -447,6 +455,11 @@ def render(report: Dict[str, Any]) -> str:
                 f" sample_ahead_depth={p['sample_ahead_queue_depth']} "
                 f"stale_indices={p['sample_ahead_stale_indices']} "
                 f"mirror_reconcile_s={p['mirror_reconcile_s']}"
+            )
+        if p.get("replay_ratio") is not None:  # replay reuse on (K > 1)
+            line += (
+                f" replay_ratio={p['replay_ratio']} "
+                f"reuse_clip_frac={p['reuse_clip_frac']}"
             )
         lines.append(line)
     cp = report.get("critical_path")

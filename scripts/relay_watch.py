@@ -240,6 +240,13 @@ def health_attribution(metrics_glob) -> dict:
     games_tally = {"games": 0, "eval_mt": 0}
     by_game: dict = {}
     last_hn = None
+    # replay-reuse rows (docs/PERFORMANCE.md "Replay reuse"): learn rows of
+    # a cfg.replay_ratio > 1 run carry replay_ratio/reuse_index/clip_frac —
+    # the tally says a phase ran reusing, at which K, and how hard the
+    # IMPACT clip was working (the K-too-high early warning) straight off
+    # its phase_done row
+    reuse = {"rows": 0}
+    reuse_last: dict = {}
     span_rows = []
     last = None
     for path in sorted(_glob.glob(metrics_glob)):
@@ -280,6 +287,12 @@ def health_attribution(metrics_glob) -> dict:
                         snap["score_mean"] = row.get("score_mean")
                         if row.get("human_normalized") is not None:
                             snap["human_normalized"] = row["human_normalized"]
+                    elif kind == "learn" and row.get("replay_ratio"):
+                        reuse["rows"] += 1
+                        reuse_last = {
+                            "replay_ratio": row.get("replay_ratio"),
+                            "clip_frac": row.get("clip_frac"),
+                        }
                     elif kind in trace:
                         trace[kind] += 1
                         # bounded retention: the echo needs stage shares,
@@ -302,6 +315,8 @@ def health_attribution(metrics_glob) -> dict:
     if games_tally["games"] or games_tally["eval_mt"] or by_game:
         out["games"] = {**games_tally, "by_game": by_game,
                         "aggregate": last_hn}
+    if reuse["rows"]:
+        out["reuse"] = {**reuse, **reuse_last}
     return out
 
 
@@ -415,7 +430,7 @@ def capture_chain() -> bool:
               "--batch-size", "32", "--learning-rate", "1e-3",
               "--multi-step", "3", "--gamma", "0.9",
               "--memory-capacity", "8192", "--learn-start", "512",
-              "--replay-ratio", "2", "--target-update-period", "200",
+              "--frames-per-learn", "2", "--target-update-period", "200",
               "--num-envs-per-actor", "8", "--anakin-segment-ticks", "32",
               "--learner-devices", "1", "--metrics-interval", "1000",
               "--eval-interval", "0", "--checkpoint-interval", "2000",

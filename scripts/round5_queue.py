@@ -40,7 +40,7 @@ SHARED = ["--role", "anakin", "--compute-dtype", "float32",
           "--batch-size", "32", "--learning-rate", "1e-3",
           "--multi-step", "3", "--gamma", "0.9",
           "--memory-capacity", "8192", "--learn-start", "512",
-          "--replay-ratio", "2", "--target-update-period", "200",
+          "--frames-per-learn", "2", "--target-update-period", "200",
           "--num-envs-per-actor", "8", "--anakin-segment-ticks", "32",
           "--learner-devices", "1", "--metrics-interval", "1000",
           "--eval-interval", "0", "--checkpoint-interval", "2000",

@@ -7,7 +7,7 @@ results/jaxsuite/{per_game.csv, aggregate.json}.
 
 Example (CPU sandbox, short budget):
   python scripts/run_jaxsuite.py --games catch breakout -- \
-    --role anakin --t-max 8000 --learn-start 512 --replay-ratio 2 \
+    --role anakin --t-max 8000 --learn-start 512 --frames-per-learn 2 \
     --history-length 2 --gamma 0.9 --memory-capacity 8192 \
     --learning-rate 1e-3 --target-update-period 200 \
     --compute-dtype float32 --eval-episodes 40

@@ -57,9 +57,9 @@ def main() -> None:
         gamma=0.9,
         memory_capacity=16_000,
         learn_start=512,
-        replay_ratio=1,
+        frames_per_learn=1,
         target_update_period=100,
-        num_envs_per_actor=10,  # lanes must divide replay_ratio*seq_len (10)
+        num_envs_per_actor=10,  # lanes must divide frames_per_learn*seq_len (10)
         anakin_segment_ticks=32,
         learner_devices=1,
         metrics_interval=50,

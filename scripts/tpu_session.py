@@ -205,7 +205,7 @@ def main() -> None:
             segment = build_fused_segment(
                 acfg, game, rep, build_device_learn(acfg, game.num_actions, rep)
             )
-            lpt = lanes // acfg.replay_ratio
+            lpt = lanes // acfg.frames_per_learn
             carry = init_fused_carry(acfg, game, rep, ts2, rep.init_state(),
                                      jax.random.PRNGKey(1))
             kk = jax.random.PRNGKey(2)
@@ -258,7 +258,7 @@ def main() -> None:
                 num_envs_per_actor=lanes2,
                 anakin_segment_ticks=T2,
                 r2d2_burn_in=8, r2d2_seq_len=16, r2d2_overlap=8,
-                replay_ratio=lanes2 // 16 or 1,  # fps 16 vs lanes: learn ~1/tick
+                frames_per_learn=lanes2 // 16 or 1,  # fps 16 vs lanes: learn ~1/tick
                 memory_capacity=512 * 24,  # 512 sequences of burn_in+seq_len
                 learn_start=8 * 24,
             )

@@ -136,7 +136,7 @@ def main():
             hidden_size=32, lstm_size=32, r2d2_burn_in=2, r2d2_seq_len=6,
             r2d2_overlap=2, multi_step=2, batch_size=16, learner_devices=0,
             num_actors=1, num_envs_per_actor=8, learn_start=256,
-            replay_ratio=4, memory_capacity=8192, metrics_interval=20,
+            frames_per_learn=4, memory_capacity=8192, metrics_interval=20,
             checkpoint_interval=0, eval_interval=0, eval_episodes=2,
             prefetch_depth=2, process_count=2, process_id=pid,
             results_dir=sys.argv[4], checkpoint_dir=sys.argv[4] + "/ckpt",
@@ -153,7 +153,7 @@ def main():
             hidden_size=32, num_cosines=8, num_tau_samples=4,
             num_tau_prime_samples=4, num_quantile_samples=2,
             batch_size=16, learner_devices=0, num_actors=1,
-            num_envs_per_actor=8, learn_start=256, replay_ratio=8,
+            num_envs_per_actor=8, learn_start=256, frames_per_learn=8,
             memory_capacity=4096, metrics_interval=50,
             checkpoint_interval=0, eval_interval=0, eval_episodes=2,
             prefetch_depth=2, process_count=2, process_id=pid,

@@ -32,7 +32,7 @@ def _cfg(tmp_path, **kw):
         gamma=0.9,
         memory_capacity=4096,
         learn_start=256,
-        replay_ratio=4,
+        frames_per_learn=4,
         target_update_period=100,
         num_envs_per_actor=8,
         metrics_interval=100,
@@ -53,7 +53,7 @@ def test_anakin_smoke_end_to_end(tmp_path):
     cfg = _cfg(tmp_path, checkpoint_interval=100)
     summary = train_anakin(cfg, max_frames=2_000)
     assert summary["frames"] >= 2_000
-    # replay_ratio 4: ~2000/4 minus warmup
+    # frames_per_learn 4: ~2000/4 minus warmup
     assert summary["learn_steps"] > 200
     assert np.isfinite(summary["eval_score_mean"])
     metrics_path = os.path.join(cfg.results_dir, cfg.run_id, "metrics.jsonl")
@@ -73,8 +73,8 @@ def test_anakin_resume_continues_counters(tmp_path):
     assert second["frames"] >= 2_400
     assert second["learn_steps"] > first["learn_steps"]
     # the resume must have restored the replay snapshot (warm restart):
-    # learn steps continue at the replay_ratio cadence from restored frames
-    assert second["learn_steps"] >= second["frames"] // cfg.replay_ratio - 64
+    # learn steps continue at the frames_per_learn cadence from restored frames
+    assert second["learn_steps"] >= second["frames"] // cfg.frames_per_learn - 64
 
 
 @pytest.mark.slow
@@ -88,7 +88,7 @@ def test_anakin_learns_catch(tmp_path):
         batch_size=32,
         memory_capacity=8192,
         learn_start=512,
-        replay_ratio=2,
+        frames_per_learn=2,
         target_update_period=200,
         eval_episodes=40,
         seed=7,

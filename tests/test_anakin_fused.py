@@ -29,7 +29,7 @@ def _cfg(tmp_path, **kw):
         gamma=0.9,
         memory_capacity=4096,
         learn_start=256,
-        replay_ratio=4,
+        frames_per_learn=4,
         target_update_period=100,
         num_envs_per_actor=8,
         anakin_segment_ticks=16,
@@ -55,7 +55,7 @@ def test_fused_smoke_end_to_end(tmp_path):
     cfg = _cfg(tmp_path, checkpoint_interval=100)
     summary = train_anakin(cfg, max_frames=2_000)
     assert summary["frames"] >= 2_000
-    # in-graph cadence: lanes/replay_ratio learn steps per warm tick
+    # in-graph cadence: lanes/frames_per_learn learn steps per warm tick
     assert summary["learn_steps"] > 200
     assert np.isfinite(summary["eval_score_mean"])
     metrics_path = os.path.join(cfg.results_dir, cfg.run_id, "metrics.jsonl")
@@ -67,8 +67,8 @@ def test_fused_smoke_end_to_end(tmp_path):
 
 
 def test_fused_requires_divisible_lanes(tmp_path):
-    cfg = _cfg(tmp_path, num_envs_per_actor=6, replay_ratio=4)
-    with pytest.raises(ValueError, match="divisible by replay_ratio"):
+    cfg = _cfg(tmp_path, num_envs_per_actor=6, frames_per_learn=4)
+    with pytest.raises(ValueError, match="divisible by frames_per_learn"):
         train_anakin(cfg, max_frames=100)
 
 
@@ -90,7 +90,7 @@ def test_fused_resume_continues_counters(tmp_path):
     assert second["frames"] >= 2_400
     assert second["learn_steps"] > first["learn_steps"]
     # warm restart: learning continues at the in-graph cadence
-    assert second["learn_steps"] >= second["frames"] // cfg.replay_ratio - 512
+    assert second["learn_steps"] >= second["frames"] // cfg.frames_per_learn - 512
 
 
 def test_fused_sharded_over_mesh(tmp_path):
@@ -127,7 +127,7 @@ def test_fused_learns_catch(tmp_path):
         batch_size=32,
         memory_capacity=8192,
         learn_start=512,
-        replay_ratio=2,
+        frames_per_learn=2,
         target_update_period=200,
         anakin_segment_ticks=32,
         eval_episodes=40,

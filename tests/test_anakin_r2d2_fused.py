@@ -35,7 +35,7 @@ def _cfg(tmp_path, **kw):
         gamma=0.9,
         memory_capacity=4_000,  # -> 400 sequences of 10
         learn_start=256,  # -> warm at 25 sequences
-        replay_ratio=2,  # fps=16 frames/step = 2 ticks of 8 lanes
+        frames_per_learn=2,  # fps=16 frames/step = 2 ticks of 8 lanes
         target_update_period=100,
         num_envs_per_actor=8,
         anakin_segment_ticks=16,
@@ -57,11 +57,11 @@ def test_cadence_static_mapping(tmp_path):
     assert _learn_cadence(_cfg(tmp_path)) == (2, 1)
     # k learn steps per tick when lanes exceed the frame budget
     assert _learn_cadence(
-        _cfg(tmp_path, num_envs_per_actor=32, replay_ratio=2, r2d2_seq_len=8)
+        _cfg(tmp_path, num_envs_per_actor=32, frames_per_learn=2, r2d2_seq_len=8)
     ) == (1, 2)
     with pytest.raises(ValueError, match="divide one another"):
         _learn_cadence(
-            _cfg(tmp_path, num_envs_per_actor=12, replay_ratio=2,
+            _cfg(tmp_path, num_envs_per_actor=12, frames_per_learn=2,
                  r2d2_seq_len=8)
         )
 
@@ -141,7 +141,7 @@ def test_entry_point_dispatches_anakin_r2d2(tmp_path):
         "--history-length", "2", "--hidden-size", "32", "--lstm-size", "16",
         "--r2d2-burn-in", "2", "--r2d2-seq-len", "8", "--r2d2-overlap", "4",
         "--batch-size", "8", "--multi-step", "2", "--memory-capacity", "2000",
-        "--learn-start", "200", "--replay-ratio", "2",
+        "--learn-start", "200", "--frames-per-learn", "2",
         "--num-envs-per-actor", "8", "--anakin-segment-ticks", "8",
         "--learner-devices", "1", "--eval-episodes", "4",
         "--eval-interval", "0", "--checkpoint-interval", "0",
@@ -177,8 +177,8 @@ def test_fused_r2d2_learns_catch(tmp_path):
         learning_rate=2e-3,
         memory_capacity=16_000,
         learn_start=512,
-        replay_ratio=1,  # 10 frames/step = 1 tick -> dense updates
-        num_envs_per_actor=10,  # lanes must equal replay_ratio * seq_len
+        frames_per_learn=1,  # 10 frames/step = 1 tick -> dense updates
+        num_envs_per_actor=10,  # lanes must equal frames_per_learn * seq_len
         anakin_segment_ticks=32,
         target_update_period=100,
         eval_episodes=40,

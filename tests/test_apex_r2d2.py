@@ -116,7 +116,7 @@ def test_apex_r2d2_short_run_with_device_stack(tmp_path):
         history_length=4,
         r2d2_burn_in=3,
         learn_start=256,
-        replay_ratio=4,
+        frames_per_learn=4,
         memory_capacity=8192,
         metrics_interval=20,
         checkpoint_interval=0,
@@ -156,7 +156,7 @@ def test_apex_r2d2_kill_and_resume(tmp_path):
     cfg = CFG.replace(
         env_id="toy:catch",
         learn_start=256,
-        replay_ratio=4,
+        frames_per_learn=4,
         memory_capacity=8192,
         metrics_interval=20,
         checkpoint_interval=10,
@@ -188,7 +188,7 @@ def test_apex_r2d2_end_to_end_short(tmp_path):
     cfg = CFG.replace(
         env_id="toy:catch",
         learn_start=256,
-        replay_ratio=4,
+        frames_per_learn=4,
         memory_capacity=8192,
         metrics_interval=20,
         checkpoint_interval=0,

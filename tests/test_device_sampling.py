@@ -306,7 +306,7 @@ def _apex_cfg(tmp_path, run_id, **kw):
         frame_width=44, history_length=2, hidden_size=32, num_cosines=8,
         num_tau_samples=4, num_tau_prime_samples=4, num_quantile_samples=4,
         batch_size=16, learning_rate=1e-3, multi_step=3, gamma=0.9,
-        memory_capacity=2048, learn_start=256, replay_ratio=2,
+        memory_capacity=2048, learn_start=256, frames_per_learn=2,
         target_update_period=100, num_envs_per_actor=8, metrics_interval=50,
         eval_interval=0, checkpoint_interval=0, eval_episodes=2,
         stall_timeout_s=0.0, writeback_depth=2, replay_shards=2,
@@ -369,7 +369,7 @@ def test_apex_r2d2_device_sampling_smoke(tmp_path):
         frame_height=24, frame_width=24, history_length=1, hidden_size=32,
         lstm_size=32, r2d2_burn_in=4, r2d2_seq_len=8, r2d2_overlap=4,
         batch_size=8, learning_rate=1e-3, multi_step=1, gamma=0.9,
-        memory_capacity=4096, learn_start=64, replay_ratio=4,
+        memory_capacity=4096, learn_start=64, frames_per_learn=4,
         target_update_period=100, num_envs_per_actor=8, metrics_interval=20,
         eval_interval=0, checkpoint_interval=0, eval_episodes=1,
         stall_timeout_s=0.0, device_sampling=True, sample_ahead_depth=2,

@@ -400,7 +400,7 @@ def test_two_game_apex_run_end_to_end(tmp_path):
         learning_rate=1e-3,
         memory_capacity=4096,
         learn_start=256,
-        replay_ratio=4,
+        frames_per_learn=4,
         target_update_period=200,
         num_envs_per_actor=8,
         metrics_interval=50,

@@ -315,7 +315,7 @@ def golden_run(tmp_path_factory):
         frame_width=80, history_length=2, hidden_size=64, num_cosines=16,
         num_tau_samples=4, num_tau_prime_samples=4, num_quantile_samples=4,
         batch_size=16, learning_rate=1e-3, adam_eps=1e-8, multi_step=3,
-        gamma=0.9, memory_capacity=4096, learn_start=256, replay_ratio=2,
+        gamma=0.9, memory_capacity=4096, learn_start=256, frames_per_learn=2,
         target_update_period=200, num_envs_per_actor=8, metrics_interval=100,
         eval_interval=0, checkpoint_interval=0, eval_episodes=2,
         prefetch_depth=0, seed=7,

@@ -206,7 +206,7 @@ def test_pipelined_actor_short_run(tmp_path):
         frame_height=80,
         frame_width=80,
         learn_start=512,
-        replay_ratio=8,
+        frames_per_learn=8,
         memory_capacity=4096,
         metrics_interval=50,
         checkpoint_interval=0,
@@ -262,7 +262,7 @@ def test_apex_short_run_with_host_stacker(tmp_path):
         frame_width=80,
         device_frame_stack=False,
         learn_start=512,
-        replay_ratio=8,
+        frames_per_learn=8,
         memory_capacity=4096,
         metrics_interval=50,
         checkpoint_interval=0,
@@ -290,7 +290,7 @@ def test_apex_kill_and_resume(tmp_path):
         frame_height=80,
         frame_width=80,
         learn_start=256,
-        replay_ratio=8,
+        frames_per_learn=8,
         memory_capacity=4096,
         metrics_interval=50,
         checkpoint_interval=20,
@@ -328,7 +328,7 @@ def test_apex_end_to_end_short(tmp_path):
         frame_height=80,
         frame_width=80,
         learn_start=256,
-        replay_ratio=8,
+        frames_per_learn=8,
         memory_capacity=4096,
         weight_publish_interval=20,
         metrics_interval=50,

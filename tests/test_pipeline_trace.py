@@ -512,7 +512,7 @@ def traced_apex_run(tmp_path_factory):
         frame_width=44, history_length=2, hidden_size=32, num_cosines=8,
         num_tau_samples=4, num_tau_prime_samples=4, num_quantile_samples=4,
         batch_size=16, learning_rate=1e-3, multi_step=3, gamma=0.9,
-        memory_capacity=4096, learn_start=256, replay_ratio=4,
+        memory_capacity=4096, learn_start=256, frames_per_learn=4,
         target_update_period=200, num_envs_per_actor=8, metrics_interval=50,
         eval_interval=0, checkpoint_interval=0, eval_episodes=2,
         weight_publish_interval=50, trace_sample_every=4, max_weight_lag=4,
@@ -579,7 +579,7 @@ def test_untraced_apex_run_emits_no_spans(tmp_path):
         frame_width=44, history_length=2, hidden_size=32, num_cosines=8,
         num_tau_samples=4, num_tau_prime_samples=4, num_quantile_samples=4,
         batch_size=16, learning_rate=1e-3, multi_step=3, gamma=0.9,
-        memory_capacity=4096, learn_start=256, replay_ratio=4,
+        memory_capacity=4096, learn_start=256, frames_per_learn=4,
         target_update_period=200, num_envs_per_actor=8, metrics_interval=50,
         eval_interval=0, checkpoint_interval=0, eval_episodes=2, seed=11,
         results_dir=str(tmp_path / "results"),

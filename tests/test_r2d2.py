@@ -362,7 +362,7 @@ def test_r2d2_learns_catch(tmp_path):
         target_update_period=100,
         memory_capacity=40_000,
         learn_start=2_000,
-        replay_ratio=1,  # 1 step / seq_len(=10) frames -> 2000 steps @ 20k
+        frames_per_learn=1,  # 1 step / seq_len(=10) frames -> 2000 steps @ 20k
         num_envs_per_actor=8,
         metrics_interval=100,
         checkpoint_interval=0,

@@ -301,7 +301,7 @@ def _train_cfg(tmp_path, **kw):
         gamma=0.9,
         memory_capacity=2048,
         learn_start=128,
-        replay_ratio=2,
+        frames_per_learn=2,
         target_update_period=100,
         num_envs_per_actor=4,
         metrics_interval=10,
@@ -478,7 +478,7 @@ def test_nan_step_rolls_back_in_apex_driver(tmp_path):
         tmp_path,
         num_envs_per_actor=8,
         learn_start=256,
-        replay_ratio=8,
+        frames_per_learn=8,
         memory_capacity=4096,
         metrics_interval=20,
         fault_spec="nan_loss@3",

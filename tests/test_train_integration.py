@@ -32,7 +32,7 @@ def _cfg(tmp_path, **kw):
         gamma=0.9,
         memory_capacity=8192,
         learn_start=512,
-        replay_ratio=2,
+        frames_per_learn=2,
         target_update_period=200,
         num_envs_per_actor=8,
         metrics_interval=200,

@@ -172,7 +172,7 @@ def test_train_loop_hot_path_issues_no_blocking_sync(tmp_path):
         gamma=0.9,
         memory_capacity=2048,
         learn_start=128,
-        replay_ratio=2,
+        frames_per_learn=2,
         target_update_period=100,
         num_envs_per_actor=4,
         metrics_interval=20,
