@@ -2,7 +2,7 @@
 
 PY ?= python
 
-.PHONY: test test-fast serve-smoke serve-bench chaos-smoke obs-smoke soak-smoke perf-smoke fleet-smoke quant-smoke trace-smoke multitask-smoke net-smoke
+.PHONY: test test-fast serve-smoke serve-bench chaos-smoke obs-smoke soak-smoke perf-smoke fleet-smoke quant-smoke trace-smoke multitask-smoke net-smoke league-smoke
 
 # tier-1: fast unit + integration tests on the virtual 8-device CPU mesh
 test-fast:
@@ -211,6 +211,27 @@ multitask-smoke:
 	  assert r.get('status') is None, 'multitask_throughput row: %s' % r['status']; \
 	  print('multitask_throughput: %.2f steps/s vs single %.2f (ratio %.3f, report-only)' \
 	        % (r['value'], r['single_steps_per_sec'], r['ratio_vs_single']))"
+
+# league smoke (docs/LEAGUE.md): the `league`-marked tier-1 tests (seeded
+# exploit determinism, bit-exact mailbox-chain copy, fitness ordering with
+# missing/NaN evals, respawn keeps member id + generation, default-off
+# bitwise parity), then the REAL multi-process soak: a seeded 2-member
+# population of genuine toy-scale train() loops under the LeagueController,
+# one FORCED truncation exploit; self-asserted gates (exit 1): the loser's
+# adopted weights are digest-identical to the winner's published outbox
+# reconstruction, the loser's genome was perturbed (not equal to the
+# source's), member leases carried member/generation, and the league dir
+# lints as strict schema-versioned JSONL; then obs_report must render the
+# `league:` per-member section off the controller's rows
+league-smoke:
+	JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_league.py -q -m league
+	rm -rf /tmp/ria_league_smoke
+	JAX_PLATFORMS=cpu $(PY) scripts/league_soak.py --members 2 \
+	  --out /tmp/ria_league_smoke
+	$(PY) scripts/lint_jsonl.py /tmp/ria_league_smoke
+	$(PY) scripts/obs_report.py /tmp/ria_league_smoke \
+	  | tee /tmp/ria_league_smoke/report.txt
+	grep -q "league:" /tmp/ria_league_smoke/report.txt
 
 # obs smoke: a short anakin run must yield a lintable, reportable run dir —
 # obs_report prints per-role throughput / learn-step percentiles / health,

@@ -165,6 +165,40 @@ class Agent:
         self.state = jax.tree.map(jnp.asarray, state)
         self.key = jnp.asarray(key)
 
+    # ------------------------------------------------------- league adoption
+    def adopt_params(self, host_params) -> None:
+        """League exploit adoption (league/member.py, docs/LEAGUE.md):
+        replace online AND target params with a copied member's weights —
+        called only at a drained boundary.  Optimizer moments are re-init
+        fresh: Adam statistics accumulated around the LOSER's trajectory
+        are meaningless at the winner's point in weight space, and a
+        deterministic re-init is reproducible where stale moments are not.
+        The step counter and PRNG stream are untouched (cadences and
+        exploration continue where the member left off)."""
+        from rainbow_iqn_apex_tpu.league.member import graft_tree
+        from rainbow_iqn_apex_tpu.ops.learn import make_optimizer
+
+        params = jax.tree.map(
+            jnp.asarray, graft_tree(self._state.params, host_params))
+        self.state = self._state.replace(
+            params=params,
+            target_params=jax.tree.map(jnp.copy, params),
+            opt_state=make_optimizer(self.cfg).init(params),
+        )
+
+    def retune(self, learning_rate: Optional[float] = None) -> None:
+        """Mid-run live-gene adoption: rebuild the jitted learn step under
+        the new hyperparameters (one recompile per exploit event — rare by
+        construction).  Replay-side genes (n_step, priority_exponent) are
+        retuned on the replay object by the loop; this covers the genes
+        baked into the learn executable."""
+        if learning_rate is None or self._learn is None:
+            return
+        self.cfg = self.cfg.replace(learning_rate=float(learning_rate))
+        self._learn = jax.jit(
+            build_learn_step(self.cfg, self.num_actions), donate_argnums=0
+        )
+
     # ------------------------------------------------------------- weight sync
     def params_for_publish(self):
         """Online params as the learner publishes them to actors (the Redis

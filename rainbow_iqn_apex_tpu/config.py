@@ -302,6 +302,35 @@ class Config:
     # routers; "" = solo router, federation off (no gossip socket at all)
     serve_net_gossip_interval_s: float = 1.0  # snapshot broadcast cadence
 
+    # ---- league / population-based training (league/; docs/LEAGUE.md) -------------
+    league_dir: str = ""  # shared league state directory (genomes, per-member
+    # weight mailboxes, exploit directives).  "" = league OFF everywhere — the
+    # default: no league code runs and every training loop is bitwise the
+    # pre-league path (tier-1 asserted).  The CONTROLLER (league/controller.py)
+    # and every MEMBER trainer point at the same directory.
+    league_population: int = 0  # members the league controller supervises
+    # (controller side; each member is a RoleSupervisor role with its own
+    # lease, genome, and mailbox pair).  0 = off; >= 2 required when on —
+    # a 1-member population has nobody to exploit (check_league_config).
+    league_member_id: int = -1  # THIS trainer process is league member k
+    # (trainer side: genome overlay at loop start, outbox weight publishes,
+    # exploit-directive polls at drain boundaries).  < 0 = not a member.
+    league_fitness_window: int = 4  # eval rows per member in the windowed
+    # human-normalized fitness (league/fitness.py); NaN/missing evals are
+    # skipped, a member with zero windowed evals has fitness None and is
+    # excluded from exploit on BOTH sides (missing-eval tolerance)
+    league_exploit_interval_s: float = 30.0  # controller seconds between
+    # truncation exploit/explore sweeps (bottom quantile copies a top-
+    # quantile member's weights bit-exactly + perturbs its genome)
+    league_bottom_quantile: float = 0.25  # fraction of ranked members that
+    # EXPLOIT (copy weights, perturb genome) each sweep
+    league_top_quantile: float = 0.25  # fraction of ranked members eligible
+    # as copy SOURCES; bottom + top must not overlap (<= 1.0)
+    league_perturb_factor: float = 1.2  # explore: continuous genes multiply
+    # or divide by this (seeded coin); must be > 0 (check_league_config)
+    league_resample_prob: float = 0.1  # explore: probability a perturbed
+    # gene is instead resampled fresh from its prior range
+
     # ---- evaluation (SURVEY §2 row 9) ---------------------------------------------
     eval_episodes: int = 10
     eval_interval: int = 50_000  # learner steps between in-training evals; 0 = off

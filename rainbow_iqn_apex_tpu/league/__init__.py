@@ -1,0 +1,60 @@
+"""league/ — population-based training on the Ape-X substrate
+(docs/LEAGUE.md).
+
+Exports resolve lazily (PEP 562) and every submodule imports jax-free:
+the controller and respawned member children are plain processes that
+must start in ~0.3s, exactly like parallel/elastic.py's consumers.
+"""
+
+from typing import TYPE_CHECKING
+
+_EXPORTS = {
+    "Genome": "rainbow_iqn_apex_tpu.league.population",
+    "check_league_config": "rainbow_iqn_apex_tpu.league.population",
+    "genome_from_config": "rainbow_iqn_apex_tpu.league.population",
+    "overlay_config": "rainbow_iqn_apex_tpu.league.population",
+    "perturb_genome": "rainbow_iqn_apex_tpu.league.population",
+    "resample_genome": "rainbow_iqn_apex_tpu.league.population",
+    "FitnessTracker": "rainbow_iqn_apex_tpu.league.fitness",
+    "quantile_split": "rainbow_iqn_apex_tpu.league.fitness",
+    "rank_members": "rainbow_iqn_apex_tpu.league.fitness",
+    "ExploitPlan": "rainbow_iqn_apex_tpu.league.exploit",
+    "copy_weights": "rainbow_iqn_apex_tpu.league.exploit",
+    "plan_exploits": "rainbow_iqn_apex_tpu.league.exploit",
+    "LeagueMember": "rainbow_iqn_apex_tpu.league.member",
+    "LeagueController": "rainbow_iqn_apex_tpu.league.controller",
+}
+
+__all__ = sorted(_EXPORTS)
+
+
+def __getattr__(name: str):
+    module = _EXPORTS.get(name)
+    if module is None:
+        raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
+    import importlib
+
+    return getattr(importlib.import_module(module), name)
+
+
+if TYPE_CHECKING:  # pragma: no cover - static analysis only
+    from rainbow_iqn_apex_tpu.league.controller import LeagueController
+    from rainbow_iqn_apex_tpu.league.exploit import (
+        ExploitPlan,
+        copy_weights,
+        plan_exploits,
+    )
+    from rainbow_iqn_apex_tpu.league.fitness import (
+        FitnessTracker,
+        quantile_split,
+        rank_members,
+    )
+    from rainbow_iqn_apex_tpu.league.member import LeagueMember
+    from rainbow_iqn_apex_tpu.league.population import (
+        Genome,
+        check_league_config,
+        genome_from_config,
+        overlay_config,
+        perturb_genome,
+        resample_genome,
+    )

@@ -1,0 +1,372 @@
+"""LeagueController: population-based training on the Ape-X substrate.
+
+The controller is the league's only writer of exploit state: it supervises
+N member trainer processes (each a `RoleSupervisor` role — respawn with
+backoff keeps the SAME member id at epoch+1, eviction after the
+FailureBudget), scores them from the eval telemetry they already emit
+(league/fitness.py), and runs truncation exploit/explore
+(league/exploit.py): bottom-quantile members receive a top-quantile
+member's weights bit-exactly over the WeightMailbox int8-delta chain plus
+a perturbed/resampled genome, under a monotone per-member generation
+counter.
+
+Everything is jax-free and file-backed — the controller is a small loop a
+launcher runs next to (or instead of) a learner, and every decision it
+takes is reconstructible from its JSONL:
+
+    league row, event="exploit"  one weight copy (loser/winner/generation/
+                                 digest/genome)
+    league row, event="status"   periodic per-member table: fitness,
+                                 generation, exploits/explores received,
+                                 restarts, evictions, last copy source
+                                 (+ ``collapsed`` when < 2 members remain
+                                 alive — RunHealth degrades on it)
+
+`scripts/league_soak.py` drives a real 2-member population end to end;
+tests/test_league.py drives this class with fake member processes.
+"""
+
+from __future__ import annotations
+
+import glob
+import json
+import os
+import time
+from typing import Any, Callable, Dict, List, Optional
+
+import numpy as np
+
+from rainbow_iqn_apex_tpu.league import exploit as exploit_mod
+from rainbow_iqn_apex_tpu.league.fitness import (
+    FitnessTracker,
+    quantile_split,
+    rank_members,
+)
+from rainbow_iqn_apex_tpu.league.population import (
+    Genome,
+    check_league_config,
+    genome_from_config,
+    genome_path,
+    load_genome,
+    perturb_genome,
+    save_genome,
+)
+
+
+class MemberRecord:
+    """Controller-side view of one member (fitness lives in the tracker)."""
+
+    def __init__(self, member_id: int, genome: Genome, generation: int = 0):
+        self.member_id = int(member_id)
+        self.genome = genome
+        self.generation = int(generation)
+        self.exploits = 0  # times this member ADOPTED a winner's weights
+        self.explores = 0  # explore steps received (every exploit carries
+        # one: the per-gene perturb-or-resample of the winner's genome)
+        self.copies_out = 0  # times this member was the SOURCE
+        self.last_copy_source: Optional[int] = None
+        self.evicted = False
+
+
+class LeagueController:
+    def __init__(
+        self,
+        cfg,
+        spawn_member: Callable[[int, int], Any],
+        metrics=None,
+        registry=None,
+        clock: Callable[[], float] = time.monotonic,
+        supervisor=None,
+    ):
+        """``spawn_member(member_id, epoch)`` returns a process-like object
+        (``poll()`` -> rc | None, ``kill()``) running that member's trainer
+        — the same contract RoleSupervisor spawns everywhere else."""
+        check_league_config(cfg)
+        if cfg.league_population < 2:
+            raise ValueError(
+                f"league_population ({cfg.league_population}) must be >= 2 "
+                "to run a controller (docs/LEAGUE.md)")
+        self.cfg = cfg
+        self.league_dir = cfg.league_dir
+        self.metrics = metrics
+        self.registry = registry
+        self.clock = clock
+        self.rng = np.random.default_rng(cfg.seed + 4242)
+        self.fitness = FitnessTracker(cfg.league_fitness_window)
+        self.exploit_events = 0
+        self.exploit_skips = 0
+        self._offsets: Dict[str, int] = {}  # member jsonl tail offsets
+        self._last_sweep = self.clock()
+
+        os.makedirs(self.league_dir, exist_ok=True)
+        # ---- population: resume genomes from disk, else seed them --------
+        baseline = genome_from_config(cfg)
+        self.members: Dict[int, MemberRecord] = {}
+        for i in range(cfg.league_population):
+            loaded = load_genome(genome_path(self.league_dir, i))
+            if loaded is not None:
+                genome, generation = loaded
+            else:
+                # member 0 keeps the config's own hyperparameters (the
+                # operator's hand-picked point stays in the population);
+                # the rest start perturbed around it for initial diversity
+                genome = baseline if i == 0 else perturb_genome(
+                    baseline, self.rng, cfg.league_perturb_factor,
+                    cfg.league_resample_prob)
+                generation = 0
+                save_genome(genome_path(self.league_dir, i), genome,
+                            generation, i)
+            self.members[i] = MemberRecord(i, genome, generation)
+
+        # ---- supervision: one role per member, role id carries the id ----
+        from rainbow_iqn_apex_tpu.parallel.elastic import RoleSupervisor
+
+        self.sup = supervisor or RoleSupervisor.from_config(
+            cfg, metrics=metrics, registry=registry, clock=clock)
+        for i in range(cfg.league_population):
+            self.sup.register(
+                self._role(i), self._spawn_fn(spawn_member, i),
+                meta={"member": i, "role_host": i})
+        self._observe()
+
+    @staticmethod
+    def _role(member_id: int) -> str:
+        return f"member_m{int(member_id)}"
+
+    def _is_done(self, member_id: int) -> bool:
+        try:
+            return self.sup.state(self._role(member_id)) == "done"
+        except KeyError:
+            return False
+
+    def _restarts(self, member_id: int) -> int:
+        """The supervisor's per-role restart counter IS the restart count —
+        no shadow tally on MemberRecord to drift from it."""
+        try:
+            return int(self.sup.stats(
+                self._role(member_id)).get("restarts", 0))
+        except KeyError:
+            return 0
+
+    def _spawn_fn(self, spawn_member, member_id: int):
+        def spawn(epoch: int):
+            return spawn_member(member_id, epoch)
+
+        return spawn
+
+    # --------------------------------------------------------------- obs
+    def _row(self, **fields) -> None:
+        if self.metrics is not None:
+            self.metrics.log("league", **fields)
+
+    def _observe(self) -> None:
+        if self.registry is None:
+            return
+        alive = sum(1 for m in self.members.values() if not m.evicted)
+        self.registry.gauge("league_members_alive", "league").set(alive)
+        self.registry.gauge("league_exploits_total", "league").set(
+            self.exploit_events)
+
+    def alive_members(self) -> List[int]:
+        return sorted(m.member_id for m in self.members.values()
+                      if not m.evicted)
+
+    def collapsed(self) -> bool:
+        """The population degenerated: fewer than 2 members still alive —
+        selection has nobody left to select between."""
+        return len(self.alive_members()) < 2
+
+    # ------------------------------------------------------------- ingest
+    def _ingest_evals(self) -> int:
+        """Tail every member's JSONL (anything under league_dir/m<i>/) for
+        eval / eval_mt rows; returns rows folded this call.  Offsets are
+        per file, so a respawned incarnation's fresh file is picked up."""
+        folded = 0
+        for m in self.members.values():
+            pattern = os.path.join(
+                exploit_mod.member_dir(self.league_dir, m.member_id),
+                "**", "*.jsonl")
+            for path in glob.glob(pattern, recursive=True):
+                off = self._offsets.get(path, 0)
+                try:
+                    with open(path) as f:
+                        f.seek(off)
+                        while True:
+                            line = f.readline()
+                            if not line or not line.endswith("\n"):
+                                break  # EOF or a row mid-write
+                            off = f.tell()
+                            try:
+                                row = json.loads(line)
+                            except ValueError:
+                                continue
+                            if row.get("kind") in ("eval", "eval_mt"):
+                                if self.fitness.note_row(m.member_id, row):
+                                    folded += 1
+                except OSError:
+                    continue
+                self._offsets[path] = off
+        return folded
+
+    # -------------------------------------------------------- supervision
+    def poll(self, step: int = 0) -> List[Dict[str, Any]]:
+        """One controller tick: supervise members (respawn keeps the member
+        id, eviction is terminal), fold fresh evals, and run an exploit
+        sweep when due.  Returns the supervisor events it saw."""
+        events = self.sup.poll(step=step)
+        for ev in events:
+            member = ev.get("member")
+            if member is None or member not in self.members:
+                continue
+            rec = self.members[member]
+            if ev["event"] == "actor_respawn":
+                # the respawned incarnation re-reads its genome FILE —
+                # generation and genome survive member death by design.
+                # Refresh the controller's view from the same file: the
+                # disk is the single source of truth (a loser may have
+                # adopted — and persisted — a generation this controller
+                # never planned, e.g. after a controller restart)
+                loaded = load_genome(
+                    genome_path(self.league_dir, member))
+                if loaded is not None:
+                    rec.genome, rec.generation = loaded
+            elif ev["event"] == "actor_done":
+                # clean rc=0 completion (t_max reached): the member keeps
+                # its fitness (its outbox still donates weights) but will
+                # never adopt again — NOT a crash, NOT a collapse signal
+                self._row(event="member_done", member=member, step=step,
+                          restarts=self._restarts(member))
+            elif ev["event"] == "actor_evicted":
+                rec.evicted = True
+                # an evicted member's scores must stop shaping the cut
+                # lines (a ghost in the top quantile would donate stale
+                # weights forever)
+                self.fitness.forget(member)
+                self._row(event="evicted", member=member, step=step,
+                          restarts=self._restarts(member))
+        self._ingest_evals()
+        if (self.clock() - self._last_sweep
+                >= self.cfg.league_exploit_interval_s):
+            self.sweep(step=step)
+        self._observe()
+        return events
+
+    def _refresh_from_disk(self, member_ids: List[int]) -> None:
+        """Lift each member's (genome, generation) to the genome FILE's —
+        the single source of truth, written by the member at adoption.
+        The respawn handler's unconditional re-read can briefly REGRESS
+        the in-memory view (a member that crashed before adopting reads
+        back the old generation, then adopts the still-pending directive
+        and persists the new one); planning the next exploit from the
+        stale value would collide with the inbox's monotone-version check
+        and wedge the member out of exploitation forever.  Forward-only on
+        generation: a pending-unadopted directive legitimately keeps the
+        in-memory generation ahead of disk.  EQUAL generations take the
+        disk GENOME too — a member only writes a generation it has adopted
+        (or clamped at loop start), so at equality disk is authoritative:
+        an adoption-time n-step clamp rewrites the genome at the sweep's
+        own generation, and without this the controller would report (and
+        perturb, re-issuing infeasible directives from) an n_step the
+        member never runs."""
+        for m in member_ids:
+            loaded = load_genome(genome_path(self.league_dir, m))
+            if loaded is None:
+                continue
+            rec = self.members[m]
+            genome, generation = loaded
+            if generation >= rec.generation:
+                rec.genome, rec.generation = genome, generation
+
+    # ------------------------------------------------------------- exploit
+    def sweep(self, step: int = 0) -> List[Dict[str, Any]]:
+        """One truncation exploit/explore sweep.  Members without fitness
+        are excluded on both sides (missing-eval tolerance); a sweep with
+        < 2 scored members is a no-op."""
+        self._last_sweep = self.clock()
+        alive = self.alive_members()
+        self._refresh_from_disk(alive)
+        ranked = rank_members(self.fitness, alive)
+        top, bottom = quantile_split(
+            ranked, self.cfg.league_bottom_quantile,
+            self.cfg.league_top_quantile)
+        # a completed member (supervisor state "done") still donates weights
+        # from its outbox but can never adopt — planning it as a loser would
+        # write directives nobody reads and bump its generation forever
+        bottom = [m for m in bottom if not self._is_done(m)]
+        plans = exploit_mod.plan_exploits(
+            top, bottom,
+            {m: self.members[m].genome for m in alive},
+            {m: self.members[m].generation for m in alive},
+            self.rng, self.cfg.league_perturb_factor,
+            self.cfg.league_resample_prob)
+        done: List[Dict[str, Any]] = []
+        for plan in plans:
+            try:
+                _params, digest = exploit_mod.copy_weights(
+                    self.league_dir, plan)
+            except RuntimeError as e:
+                self.exploit_skips += 1
+                self._row(event="exploit_skipped", member=plan.loser,
+                          source=plan.winner, step=step,
+                          reason=str(e)[:200])
+                continue
+            row = exploit_mod.write_directive(
+                self.league_dir, plan, digest, step=step)
+            loser, winner = self.members[plan.loser], self.members[plan.winner]
+            loser.genome = plan.genome
+            loser.generation = plan.generation
+            loser.exploits += 1
+            loser.explores += 1
+            loser.last_copy_source = plan.winner
+            winner.copies_out += 1
+            self.exploit_events += 1
+            self._row(event="exploit", member=plan.loser,
+                      source=plan.winner, generation=plan.generation,
+                      digest=digest,
+                      genome=plan.genome.to_dict(), step=step,
+                      fitness_loser=self.fitness.fitness(plan.loser),
+                      fitness_winner=self.fitness.fitness(plan.winner))
+            done.append(row)
+        self._observe()
+        return done
+
+    def force_sweep(self, step: int = 0) -> List[Dict[str, Any]]:
+        """Run a sweep NOW regardless of the interval (soak/test hook)."""
+        return self.sweep(step=step)
+
+    # ------------------------------------------------------------- status
+    def status_row(self, step: int = 0) -> Dict[str, Any]:
+        """Emit (and return) the periodic per-member `league` status row —
+        the obs_report `league:` section's input."""
+        members: Dict[str, Dict[str, Any]] = {}
+        for m in sorted(self.members):
+            rec = self.members[m]
+            role = self._role(m)
+            stats = self.sup.stats().get(role, {})
+            members[str(m)] = {
+                "fitness": self.fitness.fitness(m),
+                "evals": self.fitness.evals(m),
+                "generation": rec.generation,
+                "exploits": rec.exploits,
+                "explores": rec.explores,
+                "copies_out": rec.copies_out,
+                "last_copy_source": rec.last_copy_source,
+                "restarts": stats.get("restarts", 0),
+                "state": stats.get("state", "unknown"),
+                "lr": rec.genome.learning_rate,
+                "n_step": rec.genome.n_step,
+            }
+        row = {
+            "event": "status",
+            "step": int(step),
+            "members": members,
+            "alive": len(self.alive_members()),
+            "exploit_events": self.exploit_events,
+            "exploit_skips": self.exploit_skips,
+            "collapsed": self.collapsed(),
+        }
+        self._row(**row)
+        return row
+
+    def stop_all(self) -> None:
+        self.sup.stop_all()

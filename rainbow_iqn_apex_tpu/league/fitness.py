@@ -1,0 +1,102 @@
+"""Windowed human-normalized fitness from eval / eval_mt rows.
+
+The league scores members on the SAME eval telemetry every run already
+emits (obs/schema.py): single-game members on per-game ``eval`` rows
+(``score_mean`` -> human-normalized via `eval.HUMAN_BASELINES` when the
+game is known, raw score otherwise), multi-game members on the ``eval_mt``
+aggregate (``hn_median`` — the Atari-57 reporting convention).  No second
+eval pathway exists for the league to drift from.
+
+Missing-eval tolerance is load-bearing: a member that has not evaluated
+yet (cold start, crash-looping, slow game) has fitness ``None`` and is
+excluded from exploit on BOTH sides — it can neither be exploited (killing
+a member for being *unmeasured* is not selection) nor be a copy source.
+NaN scores (a poisoned eval) are skipped row-wise, not propagated.
+"""
+
+from __future__ import annotations
+
+import collections
+import math
+from typing import Any, Dict, List, Optional, Tuple
+
+
+class FitnessTracker:
+    """Per-member sliding window of eval fitness values."""
+
+    def __init__(self, window: int):
+        self.window = max(int(window), 1)
+        self._scores: Dict[int, collections.deque] = {}
+        self.rows_seen = 0
+        self.rows_skipped = 0  # NaN / None / baseline-less rows
+
+    def _window(self, member_id: int) -> collections.deque:
+        return self._scores.setdefault(
+            int(member_id), collections.deque(maxlen=self.window))
+
+    def note_row(self, member_id: int, row: Dict[str, Any]) -> bool:
+        """Fold one eval/eval_mt row; returns True when a fitness value
+        landed.  ``eval_mt`` rows score by ``hn_median``; ``eval`` rows by
+        ``human_normalized`` when present, else the raw ``score_mean``
+        (games without a baseline still rank within themselves)."""
+        kind = row.get("kind")
+        if kind == "eval_mt":
+            value = row.get("hn_median")
+        elif kind == "eval":
+            value = row.get("human_normalized", row.get("score_mean"))
+        else:
+            return False
+        self.rows_seen += 1
+        if value is None or not isinstance(value, (int, float)) \
+                or math.isnan(float(value)) or math.isinf(float(value)):
+            self.rows_skipped += 1
+            return False
+        self._window(member_id).append(float(value))
+        return True
+
+    def note_score(self, member_id: int, value: Optional[float]) -> bool:
+        """Direct score entry (tests, synthetic members)."""
+        if value is None or math.isnan(value) or math.isinf(value):
+            self.rows_skipped += 1
+            return False
+        self._window(member_id).append(float(value))
+        return True
+
+    def fitness(self, member_id: int) -> Optional[float]:
+        win = self._scores.get(int(member_id))
+        if not win:
+            return None  # missing-eval tolerance: unmeasured, not zero
+        return float(sum(win) / len(win))
+
+    def evals(self, member_id: int) -> int:
+        win = self._scores.get(int(member_id))
+        return len(win) if win else 0
+
+    def forget(self, member_id: int) -> None:
+        """Drop a member's window (eviction: its scores must not keep
+        shaping the quantile cut lines)."""
+        self._scores.pop(int(member_id), None)
+
+
+def rank_members(tracker: FitnessTracker,
+                 member_ids: List[int]) -> List[Tuple[int, float]]:
+    """(member_id, fitness) best-first over the members WITH a fitness;
+    ties break toward the lower member id (deterministic exploit plans)."""
+    scored = [(m, f) for m in member_ids
+              if (f := tracker.fitness(m)) is not None]
+    return sorted(scored, key=lambda mf: (-mf[1], mf[0]))
+
+
+def quantile_split(ranked: List[Tuple[int, float]], bottom_q: float,
+                   top_q: float) -> Tuple[List[int], List[int]]:
+    """(top_ids, bottom_ids) under truncation selection.  Quantiles round
+    DOWN but never below 1 once >= 2 members are ranked — with only one
+    scored member both sides are empty (nobody exploits an unmeasured
+    field)."""
+    n = len(ranked)
+    if n < 2:
+        return [], []
+    k_top = max(1, int(n * top_q))
+    k_bot = max(1, int(n * bottom_q))
+    ids = [m for m, _f in ranked]
+    return ids[:k_top], ids[n - k_bot:]

@@ -1,0 +1,248 @@
+"""Trainer-side league runtime: genome overlay, outbox publishes, and
+mid-run exploit adoption at safe drain boundaries.
+
+A member trainer is an ordinary train loop (`train.py` or
+`parallel/apex.py`) with three small hooks, all no-ops when
+``cfg.league_member_id < 0`` or ``cfg.league_dir`` is unset (the default —
+the off path is bitwise the pre-league loop, tier-1 asserted):
+
+1. **overlay** (loop start): the member's genome file overrides the
+   config's hyperparameters (`population.overlay_config`), so a respawned
+   incarnation — same member id, RoleSupervisor epoch+1 — resumes exactly
+   the genome (and generation) it died with;
+2. **publish** (weight-publish cadence): the learner's fp32 params go out
+   on the member's OUTBOX mailbox as an int8-delta chain — the copy source
+   other members adopt from;
+3. **adopt** (drain boundaries, metrics cadence): the exploit directive is
+   polled; when the controller raised the member's generation, the copied
+   chain is replayed from the INBOX, digest-asserted against the
+   directive, and handed to the loop's ``adopt_params``/``retune``
+   callbacks — weights swap and live genes (lr / n-step /
+   priority-exponent) apply WITHOUT restarting the process.  Restart
+   genes (replay_ratio, multitask schedule) wait for the next respawn's
+   overlay.
+
+The poll runs only where the loop has just drained the write-back ring:
+an adoption must never land while an unverified learn step is in flight
+(the same safe-boundary rule weight publishes follow).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Callable, Dict, Optional
+
+from rainbow_iqn_apex_tpu.league import exploit as exploit_mod
+from rainbow_iqn_apex_tpu.league.population import (
+    Genome,
+    genome_from_config,
+    load_genome,
+    overlay_config,
+    save_genome,
+)
+
+# the RoleSupervisor spawn fn exports the incarnation epoch to the child
+# (Config carries no epoch field; the epoch is supervisor state)
+EPOCH_ENV = "RIA_LEAGUE_EPOCH"
+
+
+def graft_tree(template: Any, new_tree: Any) -> Any:
+    """Rebuild ``new_tree``'s leaves in ``template``'s exact container
+    structure (dict vs FrozenDict never matters to the adopting loop).
+    Leaf order is canonical on both sides — `flatten_tree` walks mappings
+    sorted, and jax's dict pytree registry does too — so a path-keyed
+    graft is exact.  Reasoned errors on a shape/key mismatch: adopting
+    weights from a differently-shaped member is a config bug, not a race.
+    """
+    import jax
+    import numpy as np
+
+    from rainbow_iqn_apex_tpu.utils.quantize import flatten_tree
+
+    flat_new = flatten_tree(new_tree)
+    flat_cur = flatten_tree(template)
+    if set(flat_new) != set(flat_cur):
+        missing = sorted(set(flat_cur) - set(flat_new))[:3]
+        extra = sorted(set(flat_new) - set(flat_cur))[:3]
+        raise ValueError(
+            f"adopted tree does not match this member's model: missing "
+            f"{missing}, unexpected {extra} — league members must share "
+            "one architecture (docs/LEAGUE.md)")
+    for path in flat_cur:
+        if flat_new[path].shape != flat_cur[path].shape:
+            raise ValueError(
+                f"adopted leaf {path!r} shape {flat_new[path].shape} != "
+                f"{flat_cur[path].shape} — league members must share one "
+                "architecture (docs/LEAGUE.md)")
+    leaves = [np.asarray(flat_new[p], np.float32)
+              for p in sorted(flat_new)]
+    return jax.tree.unflatten(jax.tree.structure(template), leaves)
+
+
+class LeagueMember:
+    """One member trainer's league state + mailbox endpoints."""
+
+    def __init__(self, cfg):
+        self.cfg = cfg
+        self.member_id = int(cfg.league_member_id)
+        self.league_dir = cfg.league_dir
+        self.epoch = int(os.environ.get(EPOCH_ENV, "0") or 0)
+        self._metrics = None
+        self._registry = None
+        self.adoptions = 0
+        self.adopt_failures = 0
+        self._clamped_from: Optional[int] = None
+        from rainbow_iqn_apex_tpu.parallel.elastic import WeightMailbox
+
+        self.outbox = WeightMailbox(
+            exploit_mod.outbox_path(self.league_dir, self.member_id),
+            base_interval=max(int(cfg.publish_base_interval), 1),
+            host=self.member_id)
+        self.inbox = WeightMailbox(
+            exploit_mod.inbox_path(self.league_dir, self.member_id),
+            host=self.member_id)
+        from rainbow_iqn_apex_tpu.league.population import genome_path
+
+        self._genome_path = genome_path(self.league_dir, self.member_id)
+        loaded = load_genome(self._genome_path)
+        if loaded is not None:
+            self.genome, self.generation = loaded
+        else:
+            # first incarnation before the controller seeded a genome:
+            # the baseline is the config itself (overlay becomes a no-op)
+            self.genome, self.generation = genome_from_config(cfg), 0
+
+    # ------------------------------------------------------------- lifecycle
+    @classmethod
+    def from_config(cls, cfg) -> Optional["LeagueMember"]:
+        """None unless this process is a league member — the one branch the
+        default-off path ever takes."""
+        if not cfg.league_dir or int(cfg.league_member_id) < 0:
+            return None
+        return cls(cfg)
+
+    def overlay(self, cfg):
+        """Genome-driven config overlay (call at loop start, before any
+        component reads the hyperparameters)."""
+        return overlay_config(cfg, self.genome)
+
+    def clamp_n_step(self, max_n: int) -> None:
+        """Clamp the held genome's n_step to the replay geometry (call at
+        loop start, BEFORE overlay).  The explore prior reaches n=10 with
+        no knowledge of any member's ring; unclamped, a small-capacity
+        member would fail the buffer's seg > history + n check at every
+        respawn and crash-loop into eviction.  The clamped genome is
+        persisted so respawns resume a feasible state."""
+        import dataclasses
+
+        max_n = max(int(max_n), 1)
+        if self.genome.n_step <= max_n:
+            return
+        self._clamped_from = self.genome.n_step
+        self.genome = dataclasses.replace(self.genome, n_step=max_n)
+        save_genome(self._genome_path, self.genome, self.generation,
+                    self.member_id)
+
+    def attach_obs(self, metrics=None, registry=None) -> None:
+        self._metrics = metrics
+        self._registry = registry
+        extra = ({"n_step_clamped_from": self._clamped_from}
+                 if self._clamped_from is not None else {})
+        self._row(event="member_up", epoch=self.epoch,
+                  genome=self.genome.to_dict(), **extra)
+        self._gauges()
+
+    def _row(self, **fields) -> None:
+        if self._metrics is not None:
+            self._metrics.log("league", member=self.member_id,
+                              generation=self.generation, **fields)
+
+    def _gauges(self) -> None:
+        if self._registry is None:
+            return
+        role = f"member_m{self.member_id}"
+        self._registry.gauge("league_generation", role).set(self.generation)
+        self._registry.gauge("league_adoptions", role).set(self.adoptions)
+
+    def lease_payload(self) -> Dict[str, Any]:
+        """Fields the member's HeartbeatWriter lease carries (the league
+        controller reads member/generation straight off the lease)."""
+        return {"member": self.member_id, "generation": self.generation}
+
+    # --------------------------------------------------------------- publish
+    def publish(self, host_params: Any, step: int = 0) -> int:
+        """Publish the learner's fp32 params on the outbox chain.  Versions
+        continue monotonically from whatever the outbox FILE holds, so a
+        respawned incarnation (fresh encoder) never publishes backward."""
+        version = self.outbox.version() + 1
+        self.outbox.publish_params(
+            host_params, version, step=int(step),
+            member=self.member_id, generation=self.generation)
+        return version
+
+    # ----------------------------------------------------------------- adopt
+    def pending(self) -> bool:
+        """Cheap drain-boundary probe: is there a directive above the held
+        generation?  (One small-file read per metrics cadence.)"""
+        d = exploit_mod.read_directive(self.league_dir, self.member_id)
+        return d is not None and int(d["generation"]) > self.generation
+
+    def try_adopt(
+        self,
+        step: int,
+        adopt_params: Callable[[Any], None],
+        retune: Optional[Callable[[Genome], None]] = None,
+        max_n_step: Optional[int] = None,
+    ) -> Optional[Dict[str, Any]]:
+        """Adopt the directive's weights + genome (call ONLY after a ring
+        drain).  Returns the directive on success, None when there is
+        nothing to adopt yet; a digest mismatch refuses the adoption (one
+        reasoned ``league`` row) and retries next boundary."""
+        from rainbow_iqn_apex_tpu.utils.quantize import tree_digest
+
+        directive = exploit_mod.read_directive(self.league_dir,
+                                               self.member_id)
+        if directive is None or int(directive["generation"]) <= self.generation:
+            return None
+        row = self.inbox.read()
+        if row is None or int(row.get("version", -1)) != int(
+                directive["generation"]):
+            return None  # inbox not yet at this generation; retry
+        params = self.inbox.read_params()
+        if params is None:
+            return None  # racing the controller's copy; retry
+        digest = tree_digest(params)
+        if digest != directive.get("digest"):
+            self.adopt_failures += 1
+            self._row(event="adopt_refused",
+                      reason="digest_mismatch", step=int(step),
+                      want=directive.get("digest"), got=digest,
+                      source=directive.get("source"))
+            return None
+        new_genome = Genome.from_dict(directive["genome"])
+        if max_n_step is not None and new_genome.n_step > max(max_n_step, 1):
+            # the explore prior reaches n=10 blind to this member's ring;
+            # set_n_step would raise and kill the loop — clamp instead so
+            # the adoption lands (and persists) a feasible genome
+            import dataclasses
+
+            clamped = max(int(max_n_step), 1)
+            self._row(event="genome_clamped", step=int(step),
+                      n_step_from=new_genome.n_step, n_step_to=clamped,
+                      source=int(directive.get("source", -1)))
+            new_genome = dataclasses.replace(new_genome, n_step=clamped)
+        adopt_params(params)
+        if retune is not None:
+            retune(new_genome)
+        self.genome = new_genome
+        self.generation = int(directive["generation"])
+        # persist BOTH so a respawn resumes the adopted state, and a
+        # replayed directive (same generation) reads as already-held
+        save_genome(self._genome_path, self.genome, self.generation,
+                    self.member_id)
+        self.adoptions += 1
+        self._row(event="adopt", step=int(step), digest=digest,
+                  source=int(directive.get("source", -1)),
+                  genome=self.genome.to_dict())
+        self._gauges()
+        return directive

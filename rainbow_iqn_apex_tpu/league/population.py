@@ -1,0 +1,290 @@
+"""Genomes and population state for league/PBT training (docs/LEAGUE.md).
+
+Ape-X (arXiv:1803.00933) fixes one hyperparameter set per run, yet
+Accelerated Methods (arXiv:1803.02811) shows distributed value-learners are
+acutely sensitive to lr / n-step / batch choices at scale.  A *population*
+tunes them online (PBT, arXiv:1711.09846): N member trainers run
+concurrently, each with its own **genome** — the small hyperparameter
+vector below — and the league controller periodically copies a winner's
+weights into a loser and perturbs the loser's genome.
+
+Genes split into two adoption classes:
+
+- **live** genes (``learning_rate``, ``n_step``, ``priority_exponent``)
+  are adopted MID-RUN at safe drain boundaries: the write-back ring is
+  drained (no unverified step in flight), then the learner rebuilds its
+  jitted step / re-fences the replay's n-step eligibility
+  (`PrioritizedReplay.set_n_step`) without restarting the process;
+- **restart** genes (``replay_ratio``, ``multitask_schedule``) change the
+  shape of compiled executables or the replay sample plan — they take
+  effect at the member's next (re)spawn via the genome-file config overlay
+  (`overlay_config`, read at loop start).
+
+Everything here is jax-free and file-backed: a genome is one small JSON
+next to the member's mailboxes, so a respawned incarnation (RoleSupervisor
+epoch+1) reads back the same member id, generation, and genome it died
+with — member death never resets PBT state.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+from typing import Any, Dict, Optional, Tuple
+
+import numpy as np
+
+# gene -> (Config field it overlays, adoption class)
+GENES: Dict[str, Tuple[str, str]] = {
+    "learning_rate": ("learning_rate", "live"),
+    "n_step": ("multi_step", "live"),
+    "priority_exponent": ("priority_exponent", "live"),
+    "replay_ratio": ("replay_ratio", "restart"),
+    "multitask_schedule": ("multitask_schedule", "restart"),
+}
+LIVE_GENES = tuple(g for g, (_f, c) in GENES.items() if c == "live")
+RESTART_GENES = tuple(g for g, (_f, c) in GENES.items() if c == "restart")
+
+# resample priors (explore's fresh-draw ranges; docs/LEAGUE.md genome table)
+LR_PRIOR = (1e-5, 1e-2)  # log-uniform
+N_STEP_PRIOR = (1, 10)
+OMEGA_PRIOR = (0.1, 1.0)
+REPLAY_RATIO_PRIOR = (1, 8)
+
+
+@dataclasses.dataclass(frozen=True)
+class Genome:
+    """One member's hyperparameter vector (the PBT search space)."""
+
+    learning_rate: float
+    n_step: int
+    priority_exponent: float
+    replay_ratio: int = 1
+    # "" = leave cfg.multitask_schedule untouched; otherwise a schedule mode
+    # incl. explicit shares ("fixed:0.6,0.4" — multitask/replay.py)
+    multitask_schedule: str = ""
+
+    def to_dict(self) -> Dict[str, Any]:
+        return dataclasses.asdict(self)
+
+    @staticmethod
+    def from_dict(d: Dict[str, Any]) -> "Genome":
+        return Genome(
+            learning_rate=float(d["learning_rate"]),
+            n_step=int(d["n_step"]),
+            priority_exponent=float(d["priority_exponent"]),
+            replay_ratio=int(d.get("replay_ratio", 1)),
+            multitask_schedule=str(d.get("multitask_schedule", "")),
+        )
+
+
+def genome_from_config(cfg) -> Genome:
+    """The baseline genome: the run's own hyperparameters."""
+    return Genome(
+        learning_rate=float(cfg.learning_rate),
+        n_step=int(cfg.multi_step),
+        priority_exponent=float(cfg.priority_exponent),
+        replay_ratio=max(int(cfg.replay_ratio), 1),
+        multitask_schedule="",
+    )
+
+
+def overlay_config(cfg, genome: Genome):
+    """Genome-driven config overlay: the member trainer's Config with the
+    genome's genes substituted (read at loop start, so restart genes land
+    here too).  A genome equal to the config's own values returns an
+    IDENTICAL config — the no-op overlay changes nothing."""
+    fields: Dict[str, Any] = {
+        "learning_rate": genome.learning_rate,
+        "multi_step": genome.n_step,
+        "priority_exponent": genome.priority_exponent,
+        "replay_ratio": genome.replay_ratio,
+    }
+    if genome.multitask_schedule:
+        fields["multitask_schedule"] = genome.multitask_schedule
+    if all(getattr(cfg, k) == v for k, v in fields.items()):
+        return cfg
+    return cfg.replace(**fields)
+
+
+def _mutate_shares(spec: str, rng: np.random.Generator) -> str:
+    """Jitter explicit 'fixed:w1,w2,...' schedule shares (renormalized)."""
+    shares = np.asarray([float(s) for s in spec.split(":", 1)[1].split(",")])
+    shares = shares * rng.uniform(0.8, 1.25, size=shares.shape)
+    shares = shares / shares.sum()
+    return "fixed:" + ",".join(f"{s:.4f}" for s in shares)
+
+
+def perturb_genome(genome: Genome, rng: np.random.Generator,
+                   factor: float, resample_prob: float = 0.0) -> Genome:
+    """Explore: every continuous gene multiplies or divides by ``factor``
+    (seeded coin) — or, PER GENE with probability ``resample_prob``,
+    redraws fresh from its prior — and discrete genes take a +/-1 step
+    inside their prior range.  Deterministic under a seeded ``rng``; with
+    factor != 1 the result always differs from the source (the soak's
+    perturbed-not-equal gate): a draw where every gene happens to clip
+    back onto its prior corner is retried, and as a last resort the
+    learning rate is stepped INTO the prior interior (always possible —
+    the coin can pin a gene at a bound, but both bounds cannot pin lr at
+    once)."""
+    def cont(v: float, lo: float, hi: float, log: bool = False) -> float:
+        if resample_prob > 0 and rng.random() < resample_prob:
+            if log:
+                return float(np.exp(rng.uniform(np.log(lo), np.log(hi))))
+            return float(rng.uniform(lo, hi))
+        v = v * factor if rng.random() < 0.5 else v / factor
+        return float(np.clip(v, lo, hi))
+
+    def disc(v: int, lo: int, hi: int) -> int:
+        if resample_prob > 0 and rng.random() < resample_prob:
+            return int(rng.integers(lo, hi + 1))
+        return int(np.clip(v + (1 if rng.random() < 0.5 else -1), lo, hi))
+
+    def draw() -> Genome:
+        return Genome(
+            learning_rate=cont(genome.learning_rate, *LR_PRIOR, log=True),
+            n_step=disc(genome.n_step, *N_STEP_PRIOR),
+            priority_exponent=cont(genome.priority_exponent, *OMEGA_PRIOR),
+            replay_ratio=disc(genome.replay_ratio, *REPLAY_RATIO_PRIOR),
+            multitask_schedule=(
+                _mutate_shares(genome.multitask_schedule, rng)
+                if genome.multitask_schedule.startswith("fixed:")
+                else genome.multitask_schedule),
+        )
+
+    for _ in range(8):
+        child = draw()
+        if child != genome or factor == 1.0:
+            return child
+    # every coin pushed its gene into the clip: force lr off the corner
+    lo, hi = LR_PRIOR
+    lr = genome.learning_rate
+    lr = lr / factor if np.clip(lr * factor, lo, hi) == lr else lr * factor
+    return Genome(
+        learning_rate=float(np.clip(lr, lo, hi)),
+        n_step=child.n_step,
+        priority_exponent=child.priority_exponent,
+        replay_ratio=child.replay_ratio,
+        multitask_schedule=child.multitask_schedule,
+    )
+
+
+def resample_genome(rng: np.random.Generator,
+                    schedule: str = "") -> Genome:
+    """A fresh genome drawn from the priors (initial population diversity
+    and the resample half of explore)."""
+    lo, hi = LR_PRIOR
+    return Genome(
+        learning_rate=float(np.exp(rng.uniform(np.log(lo), np.log(hi)))),
+        n_step=int(rng.integers(N_STEP_PRIOR[0], N_STEP_PRIOR[1] + 1)),
+        priority_exponent=float(rng.uniform(*OMEGA_PRIOR)),
+        replay_ratio=1,  # reuse > 1 is an operator escalation, not a prior
+        multitask_schedule=schedule,
+    )
+
+
+# ------------------------------------------------------------- genome files
+def genome_path(league_dir: str, member_id: int) -> str:
+    return os.path.join(league_dir, f"m{int(member_id)}", "genome.json")
+
+
+def save_genome(path: str, genome: Genome, generation: int,
+                member_id: int) -> None:
+    """Atomic write (tmp + rename) so a member mid-read never sees torn
+    JSON; the generation rides with the genome so a respawned incarnation
+    resumes PBT state, not just hyperparameters."""
+    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump({"member": int(member_id), "generation": int(generation),
+                   "genome": genome.to_dict()}, f, indent=2)
+    os.replace(tmp, path)
+
+
+def load_genome(path: str) -> Optional[Tuple[Genome, int]]:
+    """(genome, generation) or None when the file is absent/torn —
+    the member falls back to its config-derived baseline."""
+    try:
+        with open(path) as f:
+            row = json.load(f)
+        return Genome.from_dict(row["genome"]), int(row.get("generation", 0))
+    except (OSError, ValueError, KeyError):
+        return None
+
+
+# --------------------------------------------------------------- validation
+def check_league_config(cfg) -> None:
+    """Reasoned errors for malformed league_* specs, raised at loop start
+    (the check_reuse_cadences house style: every clause names the field,
+    the observed value, and why it cannot work — docs/LEAGUE.md)."""
+    if int(cfg.league_member_id) >= 0 and not cfg.league_dir:
+        raise ValueError(
+            f"league_member_id ({cfg.league_member_id}) without a "
+            "league_dir: a member rendezvouses with its controller through "
+            "the league directory (genome file, mailboxes, directives) — "
+            "set league_dir, or unset league_member_id to train solo "
+            "(docs/LEAGUE.md)")
+    if int(cfg.league_member_id) >= 0 and cfg.league_dir:
+        mdir = os.path.abspath(
+            os.path.join(cfg.league_dir, f"m{int(cfg.league_member_id)}"))
+        rdir = os.path.abspath(cfg.results_dir)
+        if rdir != mdir and not rdir.startswith(mdir + os.sep):
+            raise ValueError(
+                f"results_dir ({cfg.results_dir}) is outside this member's "
+                f"league directory ({mdir}): the controller scores members "
+                "by tailing eval rows under league_dir/m<k>/ — a member "
+                "logging elsewhere is silently never scored and can "
+                "neither win nor be exploited.  Set results_dir under "
+                f"{mdir} (league_soak.py uses m<k>/results) "
+                "(docs/LEAGUE.md)")
+    if not cfg.league_dir and cfg.league_population <= 0:
+        return  # league off: nothing to validate
+    if cfg.league_population == 1:
+        raise ValueError(
+            "league_population (1) must be >= 2: a 1-member population has "
+            "no peer to exploit — truncation selection needs at least one "
+            "member in the top quantile and one in the bottom "
+            "(docs/LEAGUE.md)")
+    if cfg.league_population > 0 and not cfg.league_dir:
+        raise ValueError(
+            f"league_population ({cfg.league_population}) without a "
+            "league_dir: the controller and its members rendezvous through "
+            "the league directory (genomes, mailboxes, directives) — set "
+            "league_dir (docs/LEAGUE.md)")
+    for name in ("league_bottom_quantile", "league_top_quantile"):
+        q = getattr(cfg, name)
+        if not (0.0 < q < 1.0):
+            raise ValueError(
+                f"{name} ({q}) must lie strictly in (0, 1): 0 selects "
+                "nobody and 1 selects everybody — truncation selection "
+                "needs a strict subset on each side (docs/LEAGUE.md)")
+    if cfg.league_bottom_quantile + cfg.league_top_quantile > 1.0:
+        raise ValueError(
+            f"league_bottom_quantile ({cfg.league_bottom_quantile}) + "
+            f"league_top_quantile ({cfg.league_top_quantile}) must not "
+            "exceed 1.0: overlapping quantiles would let a member exploit "
+            "ITSELF (copy its own weights and perturb its own genome — a "
+            "no-op that still burns an exploit slot) (docs/LEAGUE.md)")
+    if cfg.league_perturb_factor <= 0:
+        raise ValueError(
+            f"league_perturb_factor ({cfg.league_perturb_factor}) must be "
+            "> 0: explore multiplies or divides continuous genes by it, so "
+            "a non-positive factor flips gene signs or zeroes them "
+            "(docs/LEAGUE.md)")
+    if not (0.0 <= cfg.league_resample_prob <= 1.0):
+        raise ValueError(
+            f"league_resample_prob ({cfg.league_resample_prob}) must lie "
+            "in [0, 1]: it is the per-gene probability of a fresh prior "
+            "draw instead of a perturbation (docs/LEAGUE.md)")
+    if cfg.league_fitness_window < 1:
+        raise ValueError(
+            f"league_fitness_window ({cfg.league_fitness_window}) must be "
+            ">= 1: fitness is the mean of this many recent eval rows — a "
+            "zero window makes every member fitness-less forever "
+            "(docs/LEAGUE.md)")
+    if cfg.league_exploit_interval_s <= 0:
+        raise ValueError(
+            f"league_exploit_interval_s ({cfg.league_exploit_interval_s}) "
+            "must be > 0: it is the controller's exploit sweep cadence "
+            "(docs/LEAGUE.md)")

@@ -73,10 +73,34 @@ class InterleaveSchedule:
     """
 
     def __init__(self, mode: str, num_games: int, ema: float = 0.95):
-        if mode not in SCHEDULES:
+        # "fixed:w1,...,wG": explicit per-game shares — the league genome's
+        # schedule-shares gene (league/population.py perturbs them;
+        # docs/LEAGUE.md).  Dead games still renormalise over survivors.
+        self.fixed: Optional[np.ndarray] = None
+        if mode.startswith("fixed:"):
+            try:
+                shares = np.asarray(
+                    [float(s) for s in mode.split(":", 1)[1].split(",")],
+                    np.float64)
+            except ValueError:
+                raise ValueError(
+                    f"multitask_schedule {mode!r}: shares must be numbers "
+                    "(\"fixed:0.6,0.4\")")
+            if len(shares) != num_games:
+                raise ValueError(
+                    f"multitask_schedule {mode!r} names {len(shares)} "
+                    f"shares for {num_games} games — one share per game")
+            if (not np.isfinite(shares).all() or (shares < 0).any()
+                    or shares.sum() <= 0):
+                raise ValueError(
+                    f"multitask_schedule {mode!r}: shares must be "
+                    "finite, >= 0 and sum > 0")
+            self.fixed = shares / shares.sum()
+        elif mode not in SCHEDULES:
             raise ValueError(
-                f"unknown multitask_schedule {mode!r} (want {SCHEDULES})")
-        self.mode = mode
+                f"unknown multitask_schedule {mode!r} (want {SCHEDULES} "
+                "or \"fixed:w1,...,wG\")")
+        self.mode = "fixed" if self.fixed is not None else mode
         self.num_games = int(num_games)
         self.ema = float(ema)
         # |TD| EMA starts flat at 1.0: until real TD lands, "loss" == uniform
@@ -105,6 +129,10 @@ class InterleaveSchedule:
             raw = alive.astype(np.float64)
         elif self.mode == "loss":
             raw = np.where(alive, np.maximum(self.td_ema, 1e-12), 0.0)
+        elif self.mode == "fixed":
+            raw = np.where(alive, self.fixed, 0.0)
+            if raw.sum() <= 0:  # every positively-weighted game is dead
+                raw = alive.astype(np.float64)
         else:  # mass
             raw = np.where(alive, game_mass, 0.0)
         return raw / raw.sum()

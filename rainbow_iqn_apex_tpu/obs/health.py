@@ -186,6 +186,33 @@ class RunHealth:
             if b:
                 self.registry.counter("publish_bytes_total", "health").inc(b)
             self.registry.gauge("publish_bytes_last", "health").set(b)
+        elif kind == "league":
+            # population-based training (league/; docs/LEAGUE.md): exploit
+            # and adoption are NORMAL operation (counted, not degrading) —
+            # but a COLLAPSED population (fewer than 2 members alive: the
+            # selection loop has nobody left to select between) and a
+            # refused adoption (digest mismatch: the bit-exact copy
+            # contract broke) degrade the window with the reason named
+            event = row.get("event")
+            if event == "exploit":
+                self.registry.counter("league_exploits_total", "health").inc()
+            elif event == "adopt":
+                self.registry.counter("league_adoptions_total", "health").inc()
+            elif event == "adopt_refused":
+                with self._lock:
+                    self.fault_counts["league_adopt_refused"] += 1
+                    self._win_faults["league_adopt_refused"] += 1
+                self.registry.counter(
+                    "league_adopt_refused_total", "health").inc()
+            if event == "status":
+                alive = row.get("alive")
+                if alive is not None:
+                    self.registry.gauge(
+                        "league_members_alive", "health").set(int(alive))
+                if row.get("collapsed"):
+                    with self._lock:
+                        self.fault_counts["league_collapsed"] += 1
+                        self._win_faults["league_collapsed"] += 1
         elif kind == "lag":
             # propagation-lag budget check (obs/pipeline_trace.py): the
             # budget is max_weight_lag publishes' worth of publish cadence —
@@ -213,6 +240,13 @@ class RunHealth:
                     len(breached))
 
     def note_fault(self, event: str, row: Optional[Dict[str, Any]] = None) -> None:
+        if event == "actor_done":
+            # a clean rc=0 completion (finite league member reached t_max)
+            # is lifecycle, not degradation: counted, never window-degrading
+            with self._lock:
+                self.fault_counts[event] += 1
+            self.registry.counter(f"fault_{event}_total", "supervisor").inc()
+            return
         with self._lock:
             self.fault_counts[event] += 1
             self._win_faults[event] += 1

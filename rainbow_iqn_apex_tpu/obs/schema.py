@@ -95,6 +95,15 @@ REQUIRED_KEYS: Dict[str, frozenset] = {
     "eval_mt": frozenset({"step", "hn_median", "hn_mean"}),  # one suite
     # aggregate per multi-game eval pass (human-normalized median/mean over
     # the played games — the Atari-57 reporting convention)
+    # league rows (league/; docs/LEAGUE.md):
+    "league": frozenset({"event"}),  # population-based training events +
+    # status.  event "status" is the periodic per-member table (members=
+    # {id: {fitness, generation, exploits, restarts, state, ...}}, alive,
+    # exploit_events, collapsed — obs_report's `league:` input; RunHealth
+    # degrades on collapsed=True); event "exploit" is one weight copy
+    # (member/source/generation/digest/genome); "adopt" is the loser-side
+    # confirmation (digest-asserted); "exploit_skipped"/"adopt_refused"
+    # carry a reasoned `reason`; "evicted" is a member's permanent death
     "lag": frozenset({"step"}),  # periodic lag-attribution row: per-metric
     # window percentiles of the always-on lag_* histograms (sample age at
     # learn time, ring retirement, router dispatch, batcher slot wait) plus

@@ -163,6 +163,7 @@ class ApexDriver(QuantPublishMixin):
         self.n_actor_devices = len(adevs)
 
         rep_l, rep_a = replicated(self.lmesh), replicated(self.amesh)
+        self._rep_l = rep_l  # league retune rebuilds the learn jit in place
         self.key = jax.random.PRNGKey(cfg.seed)
         self.key, k_init = jax.random.split(self.key)
         if spec is not None:
@@ -328,6 +329,52 @@ class ApexDriver(QuantPublishMixin):
         state, extra = ckpt.restore(self.state)
         self.load_state(state, extra)
         return extra
+
+    # ------------------------------------------------------- league adoption
+    def adopt_params(self, host_params) -> None:
+        """League exploit adoption (league/member.py, docs/LEAGUE.md):
+        replace online AND target params with the copied member's weights
+        and re-publish so the actor lanes act on them immediately.  Called
+        only at a drained boundary (no unverified step in flight).  Adam
+        moments re-init fresh — the loser's statistics are meaningless at
+        the winner's point in weight space, and a deterministic re-init is
+        reproducible where stale moments are not.  Step counter, PRNG
+        stream, and weight-version counter all continue (the version keeps
+        rising, so out-of-process staleness fences never see a rollback)."""
+        from rainbow_iqn_apex_tpu.league.member import graft_tree
+        from rainbow_iqn_apex_tpu.ops.learn import make_optimizer
+
+        params = graft_tree(host_state(self.state).params, host_params)
+        params = jax.device_put(params, replicated(self.lmesh))
+        self.state = self._state.replace(
+            params=params,
+            target_params=jax.tree.map(jnp.copy, params),
+            opt_state=jax.jit(
+                make_optimizer(self.cfg).init,
+                out_shardings=self._rep_l)(params),
+        )
+        self.publish_weights()
+
+    def retune(self, learning_rate: Optional[float] = None) -> None:
+        """Mid-run live-gene adoption: rebuild the jitted learn step under
+        the new learning rate (one recompile per exploit event — rare by
+        construction).  Replay-side genes (n_step, priority_exponent) are
+        retuned on the replay by the loop; restart genes (replay_ratio,
+        schedule) wait for the next respawn's config overlay."""
+        if learning_rate is None:
+            return
+        self.cfg = self.cfg.replace(learning_rate=float(learning_rate))
+        if self.spec is not None:
+            from rainbow_iqn_apex_tpu.multitask.ops import build_mt_learn_step
+
+            learn_fn = build_mt_learn_step(self.cfg, self.spec)
+        else:
+            learn_fn = build_learn_step(self.cfg, self.num_actions)
+        self._learn = jax.jit(
+            learn_fn,
+            in_shardings=(self._rep_l, self._batch_sh, self._rep_l),
+            donate_argnums=0,
+        )
 
     # ---------------------------------------------------------------- rollback
     def load_snapshot(self, state, key) -> None:
@@ -522,6 +569,26 @@ def train_apex(cfg: Config, max_frames: Optional[int] = None) -> Dict[str, Any]:
     learner_devices == 0 (both roles on every chip) so the weight publish
     stays host-local.
     """
+    # league membership (league/; docs/LEAGUE.md): validate the league_*
+    # spec and overlay this member's genome BEFORE any component reads a
+    # hyperparameter (replay_ratio below derives reuse_k from the overlaid
+    # cfg).  Default-off takes none of this — `member` stays None and the
+    # loop is bitwise the pre-league path (tier-1 asserted).
+    from rainbow_iqn_apex_tpu.league.member import LeagueMember
+    from rainbow_iqn_apex_tpu.league.population import check_league_config
+
+    check_league_config(cfg)
+    member = LeagueMember.from_config(cfg)
+    if member is not None:
+        # genome n_step must respect the ring geometry (per-shard seg =
+        # capacity // lanes regardless of the shard split; members are
+        # single-host so the whole capacity/lane space is this process's)
+        # or the replay build below crash-loops every respawn
+        member.clamp_n_step(
+            cfg.memory_capacity
+            // (cfg.num_actors * cfg.num_envs_per_actor)
+            - cfg.history_length - 1)
+        cfg = member.overlay(cfg)
     total_frames = max_frames or cfg.t_max
     lanes_total = cfg.num_actors * cfg.num_envs_per_actor
     plan = plan_hosts(cfg, lanes_total)
@@ -541,6 +608,12 @@ def train_apex(cfg: Config, max_frames: Optional[int] = None) -> Dict[str, Any]:
         raise ValueError(
             "multi-game apex (cfg.games) is single-host for now — per-host "
             "game partitioning of an SPMD pod is the ROADMAP follow-up")
+    if member is not None and multihost:
+        raise ValueError(
+            "league members (cfg.league_member_id) are single-host for now "
+            "— a member IS one pod's trainer; partitioning one member over "
+            "an SPMD pod while the controller swaps its weights mid-run is "
+            "the ROADMAP follow-up (docs/LEAGUE.md)")
     games_obs = None
     if spec is not None:
         from rainbow_iqn_apex_tpu.multitask.lanes import (
@@ -654,6 +727,20 @@ def train_apex(cfg: Config, max_frames: Optional[int] = None) -> Dict[str, Any]:
     )
 
     heartbeat = monitor = None
+    league_hb = None
+    if member is not None:
+        member.attach_obs(metrics, obs_run.registry)
+        if cfg.heartbeat_interval_s > 0:
+            # member lease under the LEAGUE dir (the controller's watch
+            # point, distinct from this run's own heartbeat below): the
+            # payload carries member id + exploit generation so the
+            # controller reads PBT state straight off the lease
+            league_hb = HeartbeatWriter(
+                os.path.join(cfg.league_dir, "heartbeats"),
+                cfg.league_member_id, cfg.heartbeat_interval_s,
+                role="member", epoch=member.epoch,
+                payload_fn=member.lease_payload,
+            ).start()
     if cfg.heartbeat_interval_s > 0:
         heartbeat = HeartbeatWriter(
             heartbeat_dir(cfg), cfg.process_id, cfg.heartbeat_interval_s,
@@ -662,6 +749,9 @@ def train_apex(cfg: Config, max_frames: Optional[int] = None) -> Dict[str, Any]:
             # host's death/revival fires as a NEW transition instead of
             # being deduped against the previous incarnation's report
             epoch=next_lease_epoch(heartbeat_dir(cfg), cfg.process_id),
+            # league members stamp member/generation into this run-dir
+            # lease too (parallel/elastic.py Lease.member/.generation)
+            payload_fn=member.lease_payload if member is not None else None,
         )
         if spec is not None:
             # lease payloads carry the game set this host serves, so an
@@ -696,6 +786,16 @@ def train_apex(cfg: Config, max_frames: Optional[int] = None) -> Dict[str, Any]:
             # host falls back together (the cfg is identical on all hosts)
             metrics.log("notice", event="device_sampling_fallback",
                         reason="multihost: host sampling path retained")
+        elif member is not None:
+            # the HBM priority mirror stages deltas under the n-step window
+            # geometry it was built with; a mid-run n-step adoption (a LIVE
+            # league gene) would silently desync it — members keep the host
+            # sampling path, which `set_n_step` re-fences correctly
+            metrics.log(
+                "notice", event="device_sampling_fallback",
+                reason="league member: host sampling retained (mid-run "
+                       "n-step adoption does not compose with the device "
+                       "frontier mirror)")
         elif spec is not None and cfg.multitask_schedule != "mass":
             # the frontier's fused HBM draw is proportional to global
             # priority mass — exactly the "mass" schedule and nothing else;
@@ -1022,6 +1122,48 @@ def train_apex(cfg: Config, max_frames: Optional[int] = None) -> Dict[str, Any]:
                         ).set(version)
                         if heartbeat is not None:
                             heartbeat.set_weight_version(version)
+                        if member is not None:
+                            # league outbox publish (the int8-delta chain
+                            # other members adopt from) rides the same
+                            # drained boundary as the actor broadcast
+                            with hostsync.sanctioned():
+                                member.publish(
+                                    host_state(driver.state).params,
+                                    step=step)
+                    if (member is not None
+                            and cadence_hit(step, cfg.metrics_interval,
+                                            reuse_k)
+                            and member.pending()):
+                        # exploit adoption at a SAFE drain boundary: every
+                        # in-flight step retires (and may roll back) before
+                        # the copied weights land; adopt_params republishes
+                        # so the actor lanes swap atomically with the
+                        # learner
+                        if not _drain():
+                            continue
+                        with hostsync.sanctioned():
+                            adopted = member.try_adopt(
+                                step, driver.adopt_params, retune=None,
+                                max_n_step=memory.max_n_step)
+                        if adopted is not None:
+                            genome = member.genome
+                            driver.retune(
+                                learning_rate=genome.learning_rate)
+                            memory.set_n_step(genome.n_step)
+                            memory.set_priority_exponent(
+                                genome.priority_exponent)
+                            if estimator is not None:
+                                # actor-side priority windows are sized by
+                                # n-step: restart the estimator's deques
+                                # (it re-primes within n ticks; fresh
+                                # appends take the max-priority default
+                                # meanwhile, the Ape-X cold-start rule)
+                                estimator = ActorPriorityEstimator(
+                                    lanes, genome.n_step, cfg.gamma)
+                            last_pub = step  # adopt_params republished
+                            if heartbeat is not None:
+                                heartbeat.set_weight_version(
+                                    driver.weights_version)
                     if cadence_hit(step, cfg.metrics_interval, reuse_k):
                         fence.observe(
                             driver.actor_weights_version,
@@ -1158,6 +1300,8 @@ def train_apex(cfg: Config, max_frames: Optional[int] = None) -> Dict[str, Any]:
         obs_run.close(driver.step, frames)
         if heartbeat is not None:
             heartbeat.stop()
+        if league_hb is not None:
+            league_hb.stop()
     if is_main and spec is not None:
         final_eval = _eval_multigame(
             cfg, spec, driver, metrics, driver.step, games_obs)

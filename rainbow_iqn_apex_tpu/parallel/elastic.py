@@ -215,6 +215,12 @@ class Lease:
     # this host's lanes are pinned to — RoleSupervisor respawn decisions and
     # fence monitors stay game-aware without a second discovery channel
     game: Optional[str] = None
+    # league payload (league/; docs/LEAGUE.md): which population member this
+    # host trains and at which exploit generation — the league controller
+    # reads PBT state straight off the lease it already watches, no second
+    # discovery channel (same rationale as `game`)
+    member: Optional[int] = None
+    generation: int = -1
 
 
 # ---------------------------------------------------------- lease monitoring
@@ -284,6 +290,9 @@ class HeartbeatMonitor:
                 buckets=tuple(int(b) for b in payload.get("buckets") or ()),
                 queue_depth=int(payload.get("queue_depth", -1)),
                 game=payload.get("game"),
+                member=(None if payload.get("member") is None
+                        else int(payload["member"])),
+                generation=int(payload.get("generation", -1)),
                 addr=str(payload.get("addr", "") or ""),
                 port=int(payload.get("port", 0) or 0),
             )
@@ -607,12 +616,15 @@ class RoleSupervisor:
     process-like object (``poll()`` -> rc or None, ``kill()``).  ``poll``
     drives the state machine:
 
-        running --exit--> backoff (delay = RetryPolicy schedule, fault row
-                          ``actor_dead``) --due--> running at epoch+1
-                          (fault row ``actor_respawn``)
-        running --exit, budget exhausted--> evicted (permanent; fault row
-                          ``actor_evicted`` — the fleet layer's
+        running --exit rc!=0--> backoff (delay = RetryPolicy schedule,
+                          fault row ``actor_dead``) --due--> running at
+                          epoch+1 (fault row ``actor_respawn``)
+        running --exit rc!=0, budget exhausted--> evicted (permanent;
+                          fault row ``actor_evicted`` — the fleet layer's
                           ``train_aborted``)
+        running --exit rc=0--> done (terminal SUCCESS — a finite role,
+                          e.g. a league member reaching t_max; fault row
+                          ``actor_done``, never window-degrading)
 
     The backoff schedule comes from `faults.RetryPolicy.delays()` — the one
     retry policy training IO and serving hot-swap already share — so two
@@ -673,7 +685,7 @@ class RoleSupervisor:
         self._roles[role_id] = {
             "spawn": spawn, "proc": proc, "epoch": int(epoch),
             "state": "running", "due": 0.0, "meta": dict(meta or {}),
-            "since": self.clock(),
+            "since": self.clock(), "restarts": 0, "exits": 0,
         }
         self._observe()
         return proc
@@ -706,9 +718,32 @@ class RoleSupervisor:
                         # consecutive crash loops, not lifetime preemptions
                         self.budget.clear(role_id)
                     continue
+                if rc == 0:
+                    # a clean completion (finite role — e.g. a league member
+                    # reaching t_max) is terminal SUCCESS: no strike, no
+                    # respawn-from-scratch, no eviction — treating it as a
+                    # crash would retrain completed members forever and then
+                    # report a healthy population as collapsed
+                    r["state"] = "done"
+                    r["exits"] += 1
+                    self.budget.clear(role_id)
+                    ev = {"event": "actor_done", "role": role_id, "rc": 0,
+                          "epoch": r["epoch"], "step": step, **r["meta"]}
+                    self._report(**ev)
+                    events.append(ev)
+                    continue
                 n = self.budget.record(role_id)
+                r["exits"] += 1
+                if self.registry is not None:
+                    # per-role exit/restart/evict counters (league/ needs to
+                    # distinguish a CRASHING member from a LOSING one — a
+                    # loser trains fine and scores low, a crasher restarts;
+                    # obs_report reads the same counters off `league` rows)
+                    self.registry.counter("role_exits", role_id).inc()
                 if self.budget.poisoned(role_id):
                     r["state"] = "evicted"
+                    if self.registry is not None:
+                        self.registry.counter("role_evictions", role_id).inc()
                     ev = {"event": "actor_evicted", "role": role_id, "rc": rc,
                           "failures": n, "epoch": r["epoch"], "step": step,
                           **r["meta"]}
@@ -726,6 +761,9 @@ class RoleSupervisor:
                 r["proc"] = r["spawn"](r["epoch"])
                 r["state"] = "running"
                 r["since"] = self.clock()
+                r["restarts"] += 1
+                if self.registry is not None:
+                    self.registry.counter("role_restarts", role_id).inc()
                 ev = {"event": "actor_respawn", "role": role_id,
                       "epoch": r["epoch"],
                       "attempt": self.budget.failures(role_id), "step": step,
@@ -745,6 +783,23 @@ class RoleSupervisor:
         self._observe()
 
     # ------------------------------------------------------------- inspection
+    def stats(self, role_id: Optional[str] = None) -> Dict[str, Any]:
+        """Per-role lifecycle counters: {role: {state, epoch, restarts,
+        exits, failures}} (or one role's dict when ``role_id`` is given).
+        The league controller uses these to tell a CRASHING member (climbing
+        restarts) from a LOSING one (healthy process, low fitness) — the
+        two need opposite responses (docs/LEAGUE.md triage)."""
+        def one(rid: str, r: Dict[str, Any]) -> Dict[str, Any]:
+            return {
+                "state": r["state"], "epoch": r["epoch"],
+                "restarts": r["restarts"], "exits": r["exits"],
+                "failures": self.budget.failures(rid),
+            }
+
+        if role_id is not None:
+            return one(role_id, self._roles[role_id])
+        return {rid: one(rid, r) for rid, r in self._roles.items()}
+
     def state(self, role_id: str) -> str:
         return self._roles[role_id]["state"]
 

@@ -546,6 +546,27 @@ class ShardedReplay:
         if self._frontier is not None:
             self._frontier.refresh_from_host(dead=self._dead)
 
+    # ------------------------------------------------------------- live retune
+    @property
+    def max_n_step(self) -> int:
+        """Largest n every shard's geometry admits (league genome clamp)."""
+        return min(s.max_n_step for s in self.shards)
+
+    def set_n_step(self, n_step: int) -> None:
+        """Mid-run n-step adoption (league/ live gene): every shard
+        re-fences its eligibility under the new window.  Callers adopt at a
+        drain boundary with the device frontier OFF — the HBM mirror stages
+        deltas under the old window geometry (league member loops fall back
+        to host sampling, parallel/apex.py)."""
+        for shard in self.shards:
+            shard.set_n_step(n_step)
+
+    def set_priority_exponent(self, omega: float) -> None:
+        """Mid-run omega adoption (league/ live gene): future write-backs
+        use the new exponent on every shard."""
+        for shard in self.shards:
+            shard.set_priority_exponent(omega)
+
     # -------------------------------------------------------------- priorities
     def update_priorities(self, idx: np.ndarray, td_abs: np.ndarray) -> None:
         shard_of = idx // self.shard_capacity

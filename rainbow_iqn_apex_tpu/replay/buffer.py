@@ -212,6 +212,95 @@ class PrioritizedReplay:
         self.filled = min(self.filled + 1, self.seg)
         return slots
 
+    # ------------------------------------------------------------- live retune
+    def set_priority_exponent(self, omega: float) -> None:
+        """Mid-run omega adoption (league/ live gene): applies to every
+        FUTURE append/write-back; existing tree values keep their old
+        exponent until rewritten — Ape-X already tolerates priorities that
+        stale (the write-back ring lags them anyway)."""
+        with self._lock:
+            self.omega = float(omega)
+
+    @property
+    def max_n_step(self) -> int:
+        """Largest n the ring geometry admits (constructor + set_n_step
+        require seg > history + n) — league genomes clamp to this so an
+        explore draw near the prior ceiling can never crash-loop a member
+        into eviction."""
+        return self.seg - self.history - 1
+
+    def set_n_step(self, n_step: int) -> None:
+        """Mid-run n-step adoption (league/ live gene, adopted at drain
+        boundaries).  Assembly recomputes every window from raw per-step
+        rewards, so EXISTING transitions re-read correctly under the new n
+        — what changes is *eligibility*: which slots have a complete,
+        cut-legal n-step future.  Eligibility is therefore recomputed for
+        the whole ring (vectorised, one pass) instead of trusting marks
+        made under the old n:
+
+        - slots within n of the write cursor lose eligibility (future now
+          incomplete) until the cursor moves past them — and since append
+          only marks the slot exactly n back, slots in the old-n..new-n gap
+          would otherwise stay marked with a short future;
+        - slots whose NEW window hits a truncation before any terminal are
+          fenced (the unbiased time-limit rule, re-applied under new n);
+        - newly-eligible slots (n shrank) enter at ``max_priority``, the
+          fresh-item default.
+        """
+        n = int(n_step)
+        if n < 1:
+            raise ValueError(f"n_step ({n}) must be >= 1")
+        with self._lock:
+            if n == self.n_step:
+                return
+            if self.seg <= self.history + n:
+                raise ValueError(
+                    f"per-lane segment {self.seg} too small for history "
+                    f"{self.history} + n_step {n} — a smaller replay or a "
+                    f"shorter n is required (league genomes must respect "
+                    "the buffer geometry)")
+            self.n_step = n
+            self._gammas = self.gamma ** np.arange(n + 1, dtype=np.float32)
+            self._refresh_eligibility_locked()
+
+    def _refresh_eligibility_locked(self, chunk: int = 8192) -> None:
+        """Recompute tree eligibility for every slot under the current
+        n_step/history.  Vectorised in offset CHUNKS: the window gather is
+        [lanes, chunk, n] — an Atari-scale ring (1M slots, n up to the
+        genome prior's 10) would otherwise materialize ~100MB of transient
+        index/bool arrays inside the buffer lock for one rare retune."""
+        if self.filled == 0:
+            return
+        steps = np.arange(self.n_step)
+        for lo in range(0, self.seg, chunk):
+            offs = np.arange(lo, min(lo + chunk, self.seg))
+            written = (np.ones(offs.size, bool) if self.filled >= self.seg
+                       else offs < self.filled)
+            # future complete: the newest written slot is (pos-1) % seg;
+            # slot `off` needs n appends after it, i.e. age >= n
+            future_ok = ((self.pos - 1 - offs) % self.seg) >= self.n_step
+            # lookback dead zone: stacks ending here would cross the cursor
+            look_dead = ((offs - self.pos) % self.seg) < self.history
+            ok_off = written & future_ok & ~look_dead
+            # unbiased time-limit rule under the NEW window: first cut
+            # inside [off, off+n) being a truncation fences the slot
+            w_offs = (offs[:, None] + steps[None, :]) % self.seg
+            slots = (self._lane_base[:, None, None]
+                     + w_offs[None, :, :])  # [L, chunk, n]
+            cuts_w = self.cuts[slots]
+            term_w = self.terminals[slots]
+            first_cut = cuts_w.argmax(axis=2)
+            has_cut = cuts_w.any(axis=2)
+            first_is_trunc = ~np.take_along_axis(
+                term_w, first_cut[..., None], axis=2)[..., 0]
+            eligible = ok_off[None, :] & ~(has_cut & first_is_trunc)
+            idx = (self._lane_base[:, None] + offs[None, :]).ravel()
+            current = self.tree.get(idx)
+            flat = eligible.ravel()
+            self.tree.set(idx, np.where(
+                flat, np.where(current > 0, current, self.max_priority),
+                0.0))
+
     def append(self, frame, action, reward, terminal, priority=None) -> int:
         """Single-lane convenience (reference's per-process API shape)."""
         pri = None if priority is None else np.asarray([priority])

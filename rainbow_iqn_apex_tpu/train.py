@@ -52,6 +52,24 @@ def priority_beta(cfg: Config, frames: int) -> float:
 
 def train(cfg: Config, max_frames: Optional[int] = None) -> Dict[str, Any]:
     """Runs training; returns a summary dict (final eval, fps, steps)."""
+    # league membership (league/; docs/LEAGUE.md): validate the league_*
+    # spec, then overlay this member's genome onto the config BEFORE any
+    # component reads a hyperparameter.  Default-off (league_dir unset /
+    # league_member_id < 0) takes none of this: `member` is None, the
+    # overlay never runs, and the loop below is bitwise the pre-league path
+    # (tier-1 asserted).
+    from rainbow_iqn_apex_tpu.league.member import LeagueMember
+    from rainbow_iqn_apex_tpu.league.population import check_league_config
+
+    check_league_config(cfg)
+    member = LeagueMember.from_config(cfg)
+    if member is not None:
+        # genome n_step must respect the ring geometry (seg > history + n)
+        # or the buffer constructor below crash-loops every respawn
+        member.clamp_n_step(
+            cfg.memory_capacity // cfg.num_envs_per_actor
+            - cfg.history_length - 1)
+        cfg = member.overlay(cfg)
     total_frames = max_frames or cfg.t_max
     lanes = cfg.num_envs_per_actor
     env = make_vector_env(cfg.env_id, lanes, seed=cfg.seed)
@@ -120,6 +138,30 @@ def train(cfg: Config, max_frames: Optional[int] = None) -> Dict[str, Any]:
     reuse_k = agent.reuse_k
     check_reuse_cadences(cfg, "metrics_interval", "eval_interval",
                          "checkpoint_interval", "guard_snapshot_interval")
+    heartbeat = None
+    if member is not None:
+        member.attach_obs(metrics, obs_run.registry)
+        # the publish cadence is live in member mode (outbox publishes)
+        check_reuse_cadences(cfg, "weight_publish_interval")
+        if cfg.heartbeat_interval_s > 0:
+            # member lease under the LEAGUE dir (the controller's watch
+            # point): payload carries member id + exploit generation
+            from rainbow_iqn_apex_tpu.parallel.elastic import HeartbeatWriter
+
+            heartbeat = HeartbeatWriter(
+                os.path.join(cfg.league_dir, "heartbeats"),
+                cfg.league_member_id, cfg.heartbeat_interval_s,
+                role="member", epoch=member.epoch,
+                payload_fn=member.lease_payload,
+            ).start()
+
+    def _member_retune(genome) -> None:
+        """Live-gene adoption at a drained boundary: lr rebuilds the learn
+        jit, n-step re-fences replay eligibility, omega applies to future
+        write-backs.  Restart genes wait for the next respawn's overlay."""
+        agent.retune(learning_rate=genome.learning_rate)
+        memory.set_n_step(genome.n_step)
+        memory.set_priority_exponent(genome.priority_exponent)
 
     try:
         while frames < total_frames:
@@ -178,6 +220,31 @@ def train(cfg: Config, max_frames: Optional[int] = None) -> Dict[str, Any]:
 
                     step = agent.step
                     obs_run.after_learn_step(step, units=reuse_k)
+                    if member is not None and cadence_hit(
+                            step, cfg.weight_publish_interval, reuse_k):
+                        # outbox publish (the copy source other members
+                        # adopt from) — drained first so the chain never
+                        # carries an unverified step's params
+                        if not _drain():
+                            continue
+                        from rainbow_iqn_apex_tpu.utils import hostsync
+
+                        with hostsync.sanctioned():
+                            member.publish(agent.state.params, step=step)
+                    if (member is not None
+                            and cadence_hit(step, cfg.metrics_interval,
+                                            reuse_k)
+                            and member.pending()):
+                        # exploit adoption at a SAFE drain boundary: no
+                        # unverified step in flight when the weights swap
+                        if not _drain():
+                            continue
+                        from rainbow_iqn_apex_tpu.utils import hostsync
+
+                        with hostsync.sanctioned():
+                            member.try_adopt(step, agent.adopt_params,
+                                             retune=_member_retune,
+                                             max_n_step=memory.max_n_step)
                     if cadence_hit(step, cfg.metrics_interval, reuse_k):
                         metrics.log(
                             "learn",
@@ -220,6 +287,8 @@ def train(cfg: Config, max_frames: Optional[int] = None) -> Dict[str, Any]:
     finally:
         if prefetcher is not None:
             prefetcher.close()
+        if heartbeat is not None:
+            heartbeat.stop()
         sup.close()
         obs_run.close(agent.step, frames)
     final_eval = evaluate(cfg, agent, seed=cfg.seed + 977)

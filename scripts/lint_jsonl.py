@@ -26,7 +26,10 @@ run dir lints before its `net:` report section is read.  Replay-reuse runs
 ``replay_ratio``/``reuse_index``/``clip_frac``/``reuse_clip_frac`` — all
 optional payload keys under the same strict-JSON rules (obs/schema.py
 documents them on the learn kind), and the ``replay_reuse`` bench row's
-fields ride through the bench JSONL the perf-smoke target lints.
+fields ride through the bench JSONL the perf-smoke target lints.  League
+runs add the ``league`` kind (event-keyed: status/exploit/adopt/... —
+league/, docs/LEAGUE.md), so a league-smoke dir — controller AND member
+JSONL — lints before its `league:` report section is read.
 """
 
 from __future__ import annotations

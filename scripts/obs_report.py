@@ -168,6 +168,33 @@ def _games_section(by_kind: Dict[str, List[Dict[str, Any]]]) -> Dict[str, Any]:
     }
 
 
+def _league_section(by_kind: Dict[str, List[Dict[str, Any]]]) -> Dict[str, Any]:
+    """Fold league rows (league/; docs/LEAGUE.md): the newest status row's
+    per-member table (fitness, generation, exploit/explore counts, restarts,
+    last copy source), exploit/adoption event totals, and whether the
+    population ever collapsed.  Empty dict for league-less runs."""
+    league = by_kind.get("league", [])
+    if not league:
+        return {}
+    status = [r for r in league if r.get("event") == "status"]
+    last = status[-1] if status else {}
+    events: Dict[str, int] = {}
+    for row in league:
+        ev = str(row.get("event", "unknown"))
+        events[ev] = events.get(ev, 0) + 1
+    return {
+        "rows": len(league),
+        "events": events,
+        "exploits": events.get("exploit", 0),
+        "adoptions": events.get("adopt", 0),
+        "adopt_refused": events.get("adopt_refused", 0),
+        "skips": events.get("exploit_skipped", 0),
+        "alive": last.get("alive"),
+        "collapsed_ever": any(r.get("collapsed") for r in status),
+        "members": last.get("members") or {},
+    }
+
+
 def _net_section(by_kind: Dict[str, List[Dict[str, Any]]]) -> Dict[str, Any]:
     """Fold cross-host serving rows (serving/net/): per-peer transport
     health — newest rtt/bytes from the periodic stats rows, flap counts
@@ -398,6 +425,9 @@ def aggregate(rows: List[Dict[str, Any]]) -> Dict[str, Any]:
         # multi-game runs (multitask/): per-game learn share / replay
         # occupancy / latest eval + suite human-normalized aggregates
         "games": _games_section(by_kind),
+        # league runs (league/): per-member fitness/generation/exploits +
+        # event totals (the PBT story in counts)
+        "league": _league_section(by_kind),
         "shed_total": shed_total,
         "final_eval": {
             k: v for k, v in last_eval.items()
@@ -546,6 +576,27 @@ def render(report: Dict[str, Any]) -> str:
                 f"eval={snap.get('score_mean')} "
                 f"hn={snap.get('human_normalized')}"
                 + (" DEAD" if snap.get("dead") else "")
+            )
+    lg = report.get("league") or {}
+    if lg:
+        lines.append(
+            f"league:  members={len(lg['members'])} alive={lg['alive']} "
+            f"exploits={lg['exploits']} adoptions={lg['adoptions']} "
+            f"refused={lg['adopt_refused']} skips={lg['skips']}"
+            + (" COLLAPSED" if lg.get("collapsed_ever") else "")
+        )
+        for mid, snap in sorted(lg["members"].items(),
+                                key=lambda kv: int(kv[0])):
+            fit = snap.get("fitness")
+            lines.append(
+                f"  member m{mid}: fitness="
+                f"{round(fit, 4) if fit is not None else None} "
+                f"gen={snap.get('generation')} "
+                f"exploits={snap.get('exploits')} "
+                f"restarts={snap.get('restarts')} "
+                f"state={snap.get('state')} "
+                f"last_copy_source={snap.get('last_copy_source')} "
+                f"lr={snap.get('lr')} n_step={snap.get('n_step')}"
             )
     e = report["elastic"]
     if any(e.values()):

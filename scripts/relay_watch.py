@@ -247,6 +247,12 @@ def health_attribution(metrics_glob) -> dict:
     # its phase_done row
     reuse = {"rows": 0}
     reuse_last: dict = {}
+    # league rows (league/; docs/LEAGUE.md): a phase that drove a PBT
+    # population gets its selection story attributed — exploit/adoption
+    # counts, refused adoptions (the bit-exact copy contract breaking),
+    # and the newest member count — straight off its phase_done row
+    league = {"rows": 0, "exploits": 0, "adoptions": 0, "refused": 0}
+    league_last: dict = {}
     span_rows = []
     last = None
     for path in sorted(_glob.glob(metrics_glob)):
@@ -287,6 +293,20 @@ def health_attribution(metrics_glob) -> dict:
                         snap["score_mean"] = row.get("score_mean")
                         if row.get("human_normalized") is not None:
                             snap["human_normalized"] = row["human_normalized"]
+                    elif kind == "league":
+                        league["rows"] += 1
+                        ev = row.get("event")
+                        if ev == "exploit":
+                            league["exploits"] += 1
+                        elif ev == "adopt":
+                            league["adoptions"] += 1
+                        elif ev == "adopt_refused":
+                            league["refused"] += 1
+                        elif ev == "status":
+                            league_last = {
+                                "alive": row.get("alive"),
+                                "collapsed": row.get("collapsed"),
+                            }
                     elif kind == "learn" and row.get("replay_ratio"):
                         reuse["rows"] += 1
                         reuse_last = {
@@ -317,6 +337,8 @@ def health_attribution(metrics_glob) -> dict:
                         "aggregate": last_hn}
     if reuse["rows"]:
         out["reuse"] = {**reuse, **reuse_last}
+    if league["rows"]:
+        out["league"] = {**league, **league_last}
     return out
 
 
