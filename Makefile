@@ -2,11 +2,20 @@
 
 PY ?= python
 
-.PHONY: test test-fast serve-smoke serve-bench chaos-smoke obs-smoke soak-smoke perf-smoke fleet-smoke quant-smoke trace-smoke multitask-smoke net-smoke league-smoke
+.PHONY: test test-fast serve-smoke serve-bench chaos-smoke obs-smoke soak-smoke perf-smoke fleet-smoke quant-smoke trace-smoke multitask-smoke net-smoke league-smoke static-smoke
 
 # tier-1: fast unit + integration tests on the virtual 8-device CPU mesh
 test-fast:
 	JAX_PLATFORMS=cpu $(PY) -m pytest tests/ -q -m "not slow"
+
+# static-invariant smoke (docs/OBSERVABILITY.md "Static invariants"): the
+# `static`-marked analyzer tests (golden fixtures + the finding-free
+# meta-test — tier-1 too), then the full-package analyzer run against the
+# checked-in EMPTY baseline (exit 1 on any finding).  The CLI deliberately
+# imports jax-free — the jax-free checker self-hosts that claim.
+static-smoke:
+	JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_analysis.py -q -m static
+	$(PY) scripts/static_analysis.py
 
 test:
 	JAX_PLATFORMS=cpu $(PY) -m pytest tests/ -q

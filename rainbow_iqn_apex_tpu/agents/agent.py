@@ -121,7 +121,10 @@ class Agent:
         is resampled every call (reference per-step resample, SURVEY §3.2)."""
         fn = self._act_eval if eval_mode else self._act
         actions, _ = fn(self.state.params, put_frames(stacked_obs), self._next_key())
-        return np.asarray(actions)
+        # the actor->env hand-off is an OBLIGATORY host materialization (the
+        # env lives on host) — same sanctioned sync as ApexDriver.act
+        with hostsync.sanctioned():
+            return np.asarray(actions)
 
     # ---------------------------------------------------------------- learning
     def learn(self, sample: SampledBatch) -> Dict[str, Any]:

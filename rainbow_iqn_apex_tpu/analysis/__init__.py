@@ -1,0 +1,45 @@
+"""analysis/ — house-invariant static analyzers (docs/OBSERVABILITY.md
+"Static invariants").
+
+Four stdlib-``ast`` analyzers over the whole package, run as a tier-1 test
+and via ``make static-smoke`` / ``scripts/static_analysis.py``:
+
+  locks.py          lock discipline for thread-shared attribute writes
+                    (+ the ``*_locked`` caller-holds-the-lock contract)
+  hostsync_lint.py  the utils/hostsync.py forbidden set declared statically
+  imports.py        jax-free import claims, transitively verified
+  configcheck.py    cfg.* reads vs Config fields, emitted row kinds vs
+                    obs/schema.py + the docs row-kind table, default-off
+                    flag families, and backticked ``cfg.<name>`` doc refs
+
+core.py is the shared finding/pragma/baseline framework; runner.py composes
+the full-package run against the checked-in (empty) baseline.txt.
+
+Exports resolve lazily (PEP 562, the house pattern) and every submodule
+imports jax-free — imports.py verifies that about this package itself.
+"""
+
+from typing import TYPE_CHECKING
+
+_EXPORTS = {
+    "Finding": "rainbow_iqn_apex_tpu.analysis.core",
+    "load_baseline": "rainbow_iqn_apex_tpu.analysis.core",
+    "render_report": "rainbow_iqn_apex_tpu.analysis.core",
+    "run_all": "rainbow_iqn_apex_tpu.analysis.runner",
+    "BASELINE_PATH": "rainbow_iqn_apex_tpu.analysis.runner",
+}
+
+__all__ = sorted(_EXPORTS)
+
+
+def __getattr__(name: str):
+    module = _EXPORTS.get(name)
+    if module is None:
+        raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
+    import importlib
+
+    return getattr(importlib.import_module(module), name)
+
+
+def __dir__():
+    return __all__

@@ -1,0 +1,213 @@
+"""Shared finding/report/baseline framework for the house static analyzers.
+
+The analyzers (docs/OBSERVABILITY.md "Static invariants") machine-enforce
+invariants this repo previously re-derived by hand in every review round:
+lock discipline around thread-shared attributes (analysis/locks.py), the
+host-sync ban in hot-path functions (analysis/hostsync_lint.py), jax-free
+import claims (analysis/imports.py), and config/schema/doc drift
+(analysis/configcheck.py).  Everything here is stdlib-``ast`` based and
+imports jax-free — the jax-free checker verifies that about this package
+itself (the self-hosting check).
+
+Shared machinery:
+
+- ``Finding``: one violation — analyzer id, file:line, a line-number-free
+  ``key`` (stable across unrelated edits) used for baseline matching, and a
+  human reason.
+- Pragmas: each analyzer has a suppression comment tag (``unlocked-ok``,
+  ``host-sync-ok``, ``jax-ok``, ``drift-ok``).  A pragma ONLY counts with a
+  reason after the colon — ``# unlocked-ok:`` alone is itself a finding
+  (``pragma-reason``), so every suppression is reviewable.
+- Baseline: a checked-in file of finding keys (one per line, ``#`` comments
+  allowed).  It ships EMPTY — any finding anywhere fails tier-1 — and
+  exists so an emergency can land with an explicitly-listed debt line
+  rather than by weakening an analyzer.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import io
+import os
+import re
+import tokenize
+from typing import Dict, Iterable, List, Optional, Sequence
+
+# analyzer id -> suppression pragma tag (written ``# <tag>: <reason>`` on
+# the offending line or the line directly above it)
+PRAGMA_TAGS: Dict[str, str] = {
+    "lock-discipline": "unlocked-ok",
+    "host-sync": "host-sync-ok",
+    "jax-free": "jax-ok",
+    "config-drift": "drift-ok",
+    "doc-drift": "drift-ok",
+}
+
+# the colon is REQUIRED: "# unlocked-ok racy on purpose" (colon forgotten)
+# is not a pragma at all — the finding stays live, pointing at the typo
+_PRAGMA_RE = re.compile(r"#\s*(?P<tag>[a-z][a-z-]*-ok)\s*:\s*(?P<reason>.*)$")
+
+
+@dataclasses.dataclass(frozen=True)
+class Finding:
+    analyzer: str  # id, e.g. "lock-discipline"
+    path: str  # repo-relative, forward slashes
+    line: int  # 1-based
+    key: str  # stable baseline key: no line numbers, no volatile text
+    message: str
+
+    def render(self) -> str:
+        return f"{self.path}:{self.line}: [{self.analyzer}] {self.message}"
+
+
+class SourceModule:
+    """One parsed source file: text, AST, and per-line pragma index."""
+
+    def __init__(self, path: str, repo_root: str):
+        self.abspath = os.path.abspath(path)
+        self.path = os.path.relpath(self.abspath, repo_root).replace(os.sep, "/")
+        with open(self.abspath, "r", encoding="utf-8") as fh:
+            self.text = fh.read()
+        self.lines = self.text.splitlines()
+        self.tree = ast.parse(self.text, filename=self.path)
+        # line -> (tag, reason) for every pragma-shaped REAL comment —
+        # extracted via tokenize, so a docstring/string literal that merely
+        # quotes "# host-sync-ok: ..." can never suppress a finding
+        self.pragmas: Dict[int, tuple] = {}
+        for lineno, comment in self._comments():
+            m = _PRAGMA_RE.search(comment)
+            if m:
+                self.pragmas[lineno] = (m.group("tag"), m.group("reason").strip())
+
+    def _comments(self):
+        try:
+            tokens = tokenize.generate_tokens(io.StringIO(self.text).readline)
+            return [
+                (tok.start[0], tok.string)
+                for tok in tokens
+                if tok.type == tokenize.COMMENT
+            ]
+        except (tokenize.TokenError, IndentationError, SyntaxError):
+            # unterminated constructs etc: fall back to the raw-line scan
+            return list(enumerate(self.lines, 1))
+
+    def pragma_at(self, lineno: int, tag: str) -> Optional[str]:
+        """The pragma reason suppressing ``tag`` at ``lineno`` (same line or
+        the line directly above), or None.  An empty reason returns ""."""
+        for ln in (lineno, lineno - 1):
+            got = self.pragmas.get(ln)
+            if got is not None and got[0] == tag:
+                return got[1]
+        return None
+
+
+def apply_pragmas(
+    module: SourceModule, findings: Iterable[Finding]
+) -> List[Finding]:
+    """Drop findings suppressed by a reasoned pragma; a reason-less pragma
+    converts the finding into a ``pragma-reason`` finding instead of
+    silencing it."""
+    out: List[Finding] = []
+    for f in findings:
+        tag = PRAGMA_TAGS.get(f.analyzer)
+        reason = module.pragma_at(f.line, tag) if tag else None
+        if reason is None:
+            out.append(f)
+        elif not reason:
+            out.append(
+                Finding(
+                    analyzer=f.analyzer,
+                    path=f.path,
+                    line=f.line,
+                    key=f.key + ":pragma-reason",
+                    message=(
+                        f"pragma '# {tag}:' suppressing [{f.analyzer}] needs "
+                        f"a reason after the colon ({f.message})"
+                    ),
+                )
+            )
+    return out
+
+
+def iter_package_files(
+    repo_root: str,
+    subdirs: Sequence[str] = ("rainbow_iqn_apex_tpu",),
+    extra: Sequence[str] = (),
+) -> List[str]:
+    """Every .py file under the given package subdirs (sorted, repo-relative
+    inputs resolved against ``repo_root``), plus explicit extras."""
+    paths: List[str] = []
+    for sub in subdirs:
+        base = os.path.join(repo_root, sub)
+        for dirpath, dirnames, filenames in os.walk(base):
+            dirnames[:] = [d for d in dirnames if d != "__pycache__"]
+            for name in sorted(filenames):
+                if name.endswith(".py"):
+                    paths.append(os.path.join(dirpath, name))
+    for rel in extra:
+        paths.append(os.path.join(repo_root, rel))
+    return sorted(set(paths))
+
+
+def load_modules(repo_root: str, paths: Iterable[str]) -> List[SourceModule]:
+    return [SourceModule(p, repo_root) for p in paths]
+
+
+# ------------------------------------------------------------------ baseline
+def load_baseline(path: str) -> frozenset:
+    """Finding keys grandfathered by the checked-in baseline file.  Missing
+    file = empty baseline (nothing grandfathered)."""
+    if not os.path.exists(path):
+        return frozenset()
+    keys = set()
+    with open(path, "r", encoding="utf-8") as fh:
+        for line in fh:
+            line = line.strip()
+            if line and not line.startswith("#"):
+                keys.add(line)
+    return frozenset(keys)
+
+
+def filter_baseline(
+    findings: Iterable[Finding], baseline: frozenset
+) -> List[Finding]:
+    return [f for f in findings if f.key not in baseline]
+
+
+def render_report(findings: Sequence[Finding]) -> str:
+    """Human report: one line per finding plus a per-analyzer tally."""
+    lines = [f.render() for f in findings]
+    by_analyzer: Dict[str, int] = {}
+    for f in findings:
+        by_analyzer[f.analyzer] = by_analyzer.get(f.analyzer, 0) + 1
+    tally = ", ".join(f"{k}={v}" for k, v in sorted(by_analyzer.items()))
+    lines.append(
+        f"static-analysis: {len(findings)} finding(s)"
+        + (f" ({tally})" if tally else "")
+    )
+    return "\n".join(lines)
+
+
+# --------------------------------------------------------------- AST helpers
+def self_attr(node: ast.AST) -> Optional[str]:
+    """'x' when node is ``self.x``, else None."""
+    if (
+        isinstance(node, ast.Attribute)
+        and isinstance(node.value, ast.Name)
+        and node.value.id == "self"
+    ):
+        return node.attr
+    return None
+
+
+def dotted_name(node: ast.AST) -> Optional[str]:
+    """Flatten an attribute chain to 'a.b.c' (None when not a plain chain)."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None

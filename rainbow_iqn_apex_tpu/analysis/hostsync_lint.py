@@ -1,0 +1,266 @@
+"""Host-sync static lint (id ``host-sync``).
+
+The runtime half of this invariant lives in ``utils/hostsync.py``:
+``forbid_host_sync()`` makes a blocking device->host materialization raise
+on the guarded thread, and tier-1 runs the real train loops under it.  The
+runtime guard only sees the paths a test happens to execute; this analyzer
+declares the forbidden set STATICALLY — the modules/functions below are the
+learner/actor hot path, and inside them every host-materialization shape
+(``float()`` / ``int()`` / ``bool()`` on a non-config value, ``.item()``,
+``np.asarray`` / ``np.array``, ``jax.device_get``, ``.block_until_ready()``)
+must sit inside a ``with hostsync.sanctioned():`` scope or go through the
+sanctioned seam calls (``hostsync.to_host`` / ``hostsync.scalar``), which
+re-check at runtime.
+
+``np.asarray`` matters even though the runtime guard cannot catch it on the
+CPU backend (zero-copy through the buffer protocol below any Python hook —
+the hole the hostsync docstring records): statically it is just a call
+node, so the lint closes exactly the gap the runtime guard leaves open.
+
+False-positive escape: ``# host-sync-ok: <reason>`` on (or directly above)
+the call line; the reason is mandatory.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Sequence, Tuple
+
+from rainbow_iqn_apex_tpu.analysis.core import (
+    Finding,
+    SourceModule,
+    apply_pragmas,
+    dotted_name,
+)
+
+ANALYZER = "host-sync"
+
+# The statically-declared hot path: module -> qualname prefixes ("*" = the
+# whole module).  This is the utils/hostsync.py forbidden set written down:
+# the write-back ring and the device sample frontier run inside the
+# zero-sync learner loop wholesale; the drivers/agents contribute their
+# act/learn/step surfaces (their cold paths — restore, eval, checkpoint —
+# stay out, matching where forbid_host_sync() actually brackets them).
+HOT_PATH: Dict[str, Sequence[str]] = {
+    "rainbow_iqn_apex_tpu/utils/writeback.py": ("*",),
+    "rainbow_iqn_apex_tpu/replay/frontier.py": ("*",),
+    "rainbow_iqn_apex_tpu/agents/agent.py": (
+        "Agent.act",
+        "Agent.learn",
+        "Agent.learn_batch",
+        "Agent.step",
+        "FrameStacker.push",
+        "put_frames",
+        "to_device_batch",
+    ),
+    "rainbow_iqn_apex_tpu/parallel/apex.py": (
+        "ActorPriorityEstimator.push",
+        "ApexDriver.act",
+        "ApexDriver.act_async",
+        "ApexDriver.act_frames",
+        "ApexDriver.act_local",
+        "ApexDriver.learn",
+        "ApexDriver.learn_batch",
+        "ApexDriver.learn_local",
+        "ApexDriver.step",
+    ),
+}
+
+_SYNC_NAME_CALLS = frozenset({"float", "int", "bool"})
+_SYNC_ATTR_CALLS = frozenset({"item", "block_until_ready"})
+_SYNC_DOTTED = frozenset(
+    {"np.asarray", "np.array", "numpy.asarray", "numpy.array",
+     "jax.device_get"}
+)
+# arguments float()/int()/bool() may legally take in a hot function: config
+# reads and host-side bookkeeping that never touch a device value
+_CFG_ROOTS = frozenset({"cfg", "config", "_cfg", "_config", "args"})
+_HOST_CALL_LEAVES = frozenset({"len", "time", "monotonic", "perf_counter",
+                               "scalar", "to_host"})
+# builtins that stay host-side when their arguments do
+_HOST_FOLD_LEAVES = frozenset({"max", "min", "abs", "round", "len"})
+_SCALAR_ANNOTATIONS = frozenset({"int", "float", "bool"})
+_NDARRAY_ANNOTATIONS = frozenset({"np.ndarray", "numpy.ndarray", "ndarray"})
+
+
+def _param_annotations(fn: ast.AST) -> Dict[str, str]:
+    """name -> dotted annotation string for the function's parameters.
+    A parameter annotated ``int``/``float``/``bool`` or ``np.ndarray`` is a
+    HOST value by declaration — the signature is the hot function's
+    contract with its callers, so coercing it is not a device sync."""
+    out: Dict[str, str] = {}
+    args = getattr(fn, "args", None)
+    if args is None:
+        return out
+    for a in list(args.posonlyargs) + list(args.args) + list(args.kwonlyargs):
+        ann = a.annotation
+        if ann is None:
+            continue
+        # Optional[int] declares the same host contract as int
+        if isinstance(ann, ast.Subscript) and (
+            dotted_name(ann.value) or ""
+        ).rsplit(".", 1)[-1] == "Optional":
+            ann = ann.slice
+        if isinstance(ann, ast.Constant) and isinstance(ann.value, str):
+            out[a.arg] = ann.value
+        else:
+            name = dotted_name(ann)
+            if name:
+                out[a.arg] = name
+    return out
+
+
+def _is_sanctioned_with(node: ast.With) -> bool:
+    for item in node.items:
+        expr = item.context_expr
+        if isinstance(expr, ast.Call):
+            name = dotted_name(expr.func) or ""
+            if name.rsplit(".", 1)[-1] == "sanctioned":
+                return True
+    return False
+
+
+def _benign_scalar_arg(arg: ast.AST, params: Dict[str, str]) -> bool:
+    """True when float()/int()/bool() is over a value that cannot be a
+    device array: literals, config attribute reads, len()/clock calls,
+    parameters ANNOTATED as host scalars, or arithmetic over those."""
+    if isinstance(arg, ast.Constant):
+        return True
+    if isinstance(arg, ast.Name):
+        ann = params.get(arg.id, "")
+        return ann.rsplit(".", 1)[-1] in _SCALAR_ANNOTATIONS
+    if isinstance(arg, ast.Attribute):
+        root = arg
+        while isinstance(root, ast.Attribute):
+            root = root.value
+        if isinstance(root, ast.Name) and (
+            root.id in _CFG_ROOTS or root.id == "self"
+        ):
+            # self.<x> scalars are host mirrors by construction in the hot
+            # classes (the PR-5 step-mirror pattern); device values live in
+            # locals between dispatch and retirement
+            return True
+        return False
+    if isinstance(arg, ast.Call):
+        name = dotted_name(arg.func) or ""
+        leaf = name.rsplit(".", 1)[-1]
+        if leaf in _HOST_CALL_LEAVES:
+            return True
+        if leaf in _HOST_FOLD_LEAVES:
+            return all(_benign_scalar_arg(a, params) for a in arg.args)
+        if leaf == "getattr" and arg.args:
+            first = arg.args[0]
+            return isinstance(first, ast.Name) and (
+                first.id in _CFG_ROOTS or first.id == "self"
+            )
+        return False
+    if isinstance(arg, ast.BinOp):
+        return _benign_scalar_arg(arg.left, params) and _benign_scalar_arg(
+            arg.right, params
+        )
+    if isinstance(arg, ast.BoolOp):
+        return all(_benign_scalar_arg(v, params) for v in arg.values)
+    if isinstance(arg, ast.UnaryOp):
+        return _benign_scalar_arg(arg.operand, params)
+    return False
+
+
+def _benign_asarray_arg(arg: ast.AST, params: Dict[str, str]) -> bool:
+    """np.asarray over a parameter annotated np.ndarray is host->host
+    staging (the act-path frame inputs), not a device pull."""
+    if isinstance(arg, ast.Name):
+        return params.get(arg.id, "") in _NDARRAY_ANNOTATIONS
+    if isinstance(arg, ast.UnaryOp):
+        return _benign_asarray_arg(arg.operand, params)
+    return False
+
+
+def _match_hot(qualname: str, prefixes: Sequence[str]) -> bool:
+    if "*" in prefixes:
+        return True
+    return any(
+        qualname == p or qualname.startswith(p + ".") for p in prefixes
+    )
+
+
+def check_module(
+    module: SourceModule, hot_path: Dict[str, Sequence[str]] = None
+) -> List[Finding]:
+    hot_path = HOT_PATH if hot_path is None else hot_path
+    prefixes = hot_path.get(module.path)
+    if not prefixes:
+        return []
+
+    findings: List[Finding] = []
+
+    def flag(node: ast.Call, what: str, qualname: str) -> None:
+        findings.append(
+            Finding(
+                analyzer=ANALYZER,
+                path=module.path,
+                line=node.lineno,
+                key=f"{ANALYZER}:{module.path}:{qualname}:{what}",
+                message=(
+                    f"{what} in hot-path function {qualname}() outside a "
+                    f"sanctioned() scope — a blocking device->host sync "
+                    f"re-serializes the learner pipeline; use "
+                    f"hostsync.to_host()/scalar() under sanctioned(), or "
+                    f"move the materialization to the ring/drain boundary"
+                ),
+            )
+        )
+
+    def scan_call(
+        node: ast.Call, qualname: str, params: Dict[str, str]
+    ) -> None:
+        func = node.func
+        if isinstance(func, ast.Name) and func.id in _SYNC_NAME_CALLS:
+            if len(node.args) == 1 and not _benign_scalar_arg(
+                node.args[0], params
+            ):
+                flag(node, f"{func.id}()", qualname)
+            return
+        if isinstance(func, ast.Attribute):
+            if func.attr in _SYNC_ATTR_CALLS:
+                flag(node, f".{func.attr}()", qualname)
+                return
+            name = dotted_name(func)
+            if name in _SYNC_DOTTED:
+                if node.args and _benign_asarray_arg(node.args[0], params):
+                    return
+                flag(node, f"{name}()", qualname)
+
+    def visit(
+        node: ast.AST,
+        stack: Tuple[str, ...],
+        sanctioned: bool,
+        params: Dict[str, str],
+    ) -> None:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            sub = stack + (node.name,)
+            sub_params = _param_annotations(node)
+            for child in node.body:
+                visit(child, sub, sanctioned, sub_params)
+            return
+        if isinstance(node, ast.ClassDef):
+            sub = stack + (node.name,)
+            for child in node.body:
+                visit(child, sub, sanctioned, {})
+            return
+        if isinstance(node, ast.With):
+            inner = sanctioned or _is_sanctioned_with(node)
+            for item in node.items:
+                visit(item.context_expr, stack, sanctioned, params)
+            for child in node.body:
+                visit(child, stack, inner, params)
+            return
+        if isinstance(node, ast.Call) and not sanctioned:
+            qualname = ".".join(stack) if stack else "<module>"
+            if _match_hot(qualname, prefixes):
+                scan_call(node, qualname, params)
+        for child in ast.iter_child_nodes(node):
+            visit(child, stack, sanctioned, params)
+
+    for top in module.tree.body:
+        visit(top, (), False, {})
+    return apply_pragmas(module, findings)

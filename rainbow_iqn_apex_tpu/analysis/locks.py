@@ -1,0 +1,328 @@
+"""Lock-discipline analyzer (id ``lock-discipline``).
+
+The invariant (docs/OBSERVABILITY.md "Static invariants"): in a class that
+runs code on more than one thread — it spawns a ``threading.Thread`` whose
+target is one of its own methods (or a function nested in one), or it hands
+a bound method to another thread as a callback (``add_observer``/
+``add_done_callback`` argument, or any ``target=``/``*_fn=``/``*_cb=``/
+``*_callback=``/``*_hook=`` keyword, the ``HeartbeatWriter(payload_fn=...)``
+shape behind PR 7's heartbeat-payload race) — every attribute that is
+WRITTEN both from the thread-entry-reachable method set and from the
+public-method-reachable set must be written under a held lock-family
+attribute (``with self._lock:`` / ``_swap_lock`` / ``_cv`` ... — any
+``with`` whose subject name matches ``lock|cv|cond|mutex``).
+
+Scope rules that keep the signal honest:
+
+- ``__init__`` writes are exempt: construction happens before the object is
+  shared (the thread does not exist yet).
+- Reachability is the closure of ``self.m()`` calls inside the class, from
+  thread entries on one side and from public (non-underscore) methods on
+  the other.  A helper reachable from both sides counts on both.
+- An attribute written on only one side is single-writer and allowed —
+  that is the ``# unlocked-ok:`` story made structural.
+- A write site that IS reachable from both sides flags even when it is the
+  only site: two threads can race through the same statement.
+- The house ``*_locked`` suffix convention (FrontRouter._release_locked,
+  ShardedReplay._append_locked, ...) is understood AND enforced: a
+  ``*_locked`` method's body counts as lock-held, and in exchange every
+  ``self.<name>_locked()`` call site must itself sit inside a held lock
+  scope (or inside another ``*_locked`` method) — in EVERY class, threaded
+  or not, since the suffix is the documented contract.
+
+Sanctioned exceptions take ``# unlocked-ok: <reason>`` on (or directly
+above) the write; a reason is mandatory (analysis/core.py pragma rules).
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Dict, List, Optional, Set, Tuple
+
+from rainbow_iqn_apex_tpu.analysis.core import (
+    Finding,
+    SourceModule,
+    apply_pragmas,
+    dotted_name,
+    self_attr,
+)
+
+ANALYZER = "lock-discipline"
+
+# segment-anchored: `_lock`, `_swap_lock`, `_wlock`, `_cv`, `_cond` are
+# lock-family; `clock`, `seconds`, `blocked` are NOT (an unanchored match
+# would silently exempt racy writes to them from tracking)
+_LOCK_NAME_RE = re.compile(
+    r"(^|_)[rw]?(lock|cv|cond|mutex)(_|$)", re.IGNORECASE
+)
+_CALLBACK_KWARG_RE = re.compile(r"(^|_)(fn|cb|callback|target|hook)$")
+_CALLBACK_REGISTRARS = frozenset({"add_observer", "add_done_callback"})
+
+
+def _is_lock_expr(node: ast.AST) -> bool:
+    """True for a ``with`` subject that names a lock-family object."""
+    if isinstance(node, ast.Call):  # e.g. ``with self._cv_for(x):``
+        node = node.func
+    name = dotted_name(node)
+    if name is None:
+        return False
+    return bool(_LOCK_NAME_RE.search(name.rsplit(".", 1)[-1]))
+
+
+class _MethodFacts:
+    """Writes / self-calls / thread-entry registrations in one method body
+    (nested thread-target functions are split out as pseudo-methods)."""
+
+    def __init__(self, qualname: str, node: ast.AST):
+        self.qualname = qualname
+        self.node = node
+        # attr -> [(lineno, locked)]
+        self.writes: Dict[str, List[Tuple[int, bool]]] = {}
+        self.self_calls: Set[str] = set()
+        self.entries: Set[str] = set()  # methods this body hands to a thread
+        self.local_thread_funcs: Set[str] = set()
+        # self.<x>_locked() invoked while no lock is held: [(callee, lineno)]
+        self.bare_locked_calls: List[Tuple[str, int]] = []
+
+    def add_write(self, attr: str, lineno: int, locked: bool) -> None:
+        if _LOCK_NAME_RE.search(attr):
+            return  # creating/replacing the lock object itself
+        self.writes.setdefault(attr, []).append((lineno, locked))
+
+
+def _collect_method(
+    qualname: str,
+    fn: ast.AST,
+    method_names: Set[str],
+    split_nested: Optional[Set[str]] = None,
+    initial_locked: bool = False,
+) -> _MethodFacts:
+    """Walk one function body tracking lock scope.  Nested function names in
+    ``split_nested`` are skipped (collected separately as pseudo-methods).
+    ``initial_locked`` marks a ``*_locked`` method whose caller holds the
+    lock by contract."""
+    facts = _MethodFacts(qualname, fn)
+    split_nested = split_nested or set()
+
+    def record_target(node: ast.AST, lineno: int, locked: bool) -> None:
+        if isinstance(node, (ast.Tuple, ast.List)):
+            for elt in node.elts:
+                record_target(elt, lineno, locked)
+            return
+        if isinstance(node, ast.Starred):
+            record_target(node.value, lineno, locked)
+            return
+        attr = self_attr(node)
+        if attr is not None:
+            facts.add_write(attr, lineno, locked)
+
+    def visit(node: ast.AST, locked: bool) -> None:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            if node is not fn:
+                if node.name in split_nested:
+                    return  # its own pseudo-method
+                # a plain closure: its writes belong to this method, but a
+                # fresh lock scope — the surrounding ``with`` is not held
+                # when the closure later runs
+                for child in node.body:
+                    visit(child, False)
+                return
+        if isinstance(node, ast.With):
+            inner = locked or any(
+                _is_lock_expr(item.context_expr) for item in node.items
+            )
+            for item in node.items:
+                visit(item.context_expr, locked)
+            for child in node.body:
+                visit(child, inner)
+            return
+        if isinstance(node, ast.Assign):
+            for tgt in node.targets:
+                record_target(tgt, node.lineno, locked)
+        elif isinstance(node, (ast.AugAssign, ast.AnnAssign)):
+            if getattr(node, "value", None) is not None or isinstance(
+                node, ast.AugAssign
+            ):
+                record_target(node.target, node.lineno, locked)
+        elif isinstance(node, ast.Call):
+            _scan_call(node, locked)
+        else:
+            attr = self_attr(node)
+            if attr is not None and isinstance(node.ctx, (ast.Store, ast.Del)):
+                facts.add_write(attr, node.lineno, locked)
+        for child in ast.iter_child_nodes(node):
+            visit(child, locked)
+
+    def _scan_call(call: ast.Call, locked: bool) -> None:
+        func_name = dotted_name(call.func) or ""
+        leaf = func_name.rsplit(".", 1)[-1]
+        if self_attr(call.func) in method_names:
+            facts.self_calls.add(call.func.attr)
+            if call.func.attr.endswith("_locked") and not locked:
+                facts.bare_locked_calls.append((call.func.attr, call.lineno))
+        if leaf == "Thread":
+            for kw in call.keywords:
+                if kw.arg == "target":
+                    tgt_attr = self_attr(kw.value)
+                    if tgt_attr in method_names:
+                        facts.entries.add(tgt_attr)
+                    elif isinstance(kw.value, ast.Name):
+                        facts.local_thread_funcs.add(kw.value.id)
+            return
+        # bound methods escaping to another thread's context
+        if leaf in _CALLBACK_REGISTRARS:
+            for arg in call.args:
+                if self_attr(arg) in method_names:
+                    facts.entries.add(arg.attr)
+        for kw in call.keywords:
+            if (
+                kw.arg
+                and _CALLBACK_KWARG_RE.search(kw.arg)
+                and self_attr(kw.value) in method_names
+            ):
+                facts.entries.add(kw.value.attr)
+
+    for child in fn.body:
+        visit(child, initial_locked)
+    return facts
+
+
+def _reachable(
+    entry: Set[str], facts_by_name: Dict[str, _MethodFacts]
+) -> Set[str]:
+    seen: Set[str] = set()
+    frontier = [m for m in entry if m in facts_by_name]
+    while frontier:
+        m = frontier.pop()
+        if m in seen:
+            continue
+        seen.add(m)
+        for callee in facts_by_name[m].self_calls:
+            if callee in facts_by_name and callee not in seen:
+                frontier.append(callee)
+    return seen
+
+
+def _analyze_class(module: SourceModule, cls: ast.ClassDef) -> List[Finding]:
+    methods = {
+        n.name: n
+        for n in cls.body
+        if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
+    }
+    method_names = set(methods)
+    facts_by_name: Dict[str, _MethodFacts] = {}
+
+    # first pass: find nested functions used as thread targets per method
+    nested_targets: Dict[str, Set[str]] = {}
+    for name, fn in methods.items():
+        probe = _collect_method(name, fn, method_names)
+        nested_defs = {
+            n.name
+            for n in ast.walk(fn)
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
+            and n is not fn
+        }
+        nested_targets[name] = probe.local_thread_funcs & nested_defs
+
+    thread_entries: Set[str] = set()
+    for name, fn in methods.items():
+        facts = _collect_method(
+            name,
+            fn,
+            method_names,
+            nested_targets[name],
+            initial_locked=name.endswith("_locked"),
+        )
+        facts_by_name[name] = facts
+        thread_entries |= facts.entries
+        for nested_name in nested_targets[name]:
+            for n in ast.walk(fn):
+                if (
+                    isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
+                    and n.name == nested_name
+                ):
+                    pseudo = f"{name}.<{nested_name}>"
+                    facts_by_name[pseudo] = _collect_method(
+                        pseudo, n, method_names
+                    )
+                    thread_entries.add(pseudo)
+                    break
+
+    findings: List[Finding] = []
+    # the *_locked call-site contract holds in every class, threaded or not
+    for name, facts in facts_by_name.items():
+        for callee, lineno in facts.bare_locked_calls:
+            findings.append(
+                Finding(
+                    analyzer=ANALYZER,
+                    path=module.path,
+                    line=lineno,
+                    key=f"{ANALYZER}:{module.path}:{cls.name}:"
+                    f"{name}->{callee}",
+                    message=(
+                        f"{cls.name}.{name}() calls self.{callee}() without "
+                        f"a held lock — the _locked suffix is the "
+                        f"caller-holds-the-lock contract"
+                    ),
+                )
+            )
+
+    if not thread_entries:
+        return findings
+
+    thread_side = _reachable(thread_entries, facts_by_name)
+    public = {
+        m
+        for m in facts_by_name
+        if not m.startswith("_") and "." not in m
+    }
+    public_side = _reachable(public, facts_by_name)
+
+    # attr -> write sites per side ( __init__ exempt: pre-sharing )
+    def side_writes(side: Set[str]) -> Dict[str, List[Tuple[str, int, bool]]]:
+        out: Dict[str, List[Tuple[str, int, bool]]] = {}
+        for m in side:
+            if m == "__init__":
+                continue
+            for attr, sites in facts_by_name[m].writes.items():
+                for lineno, locked in sites:
+                    out.setdefault(attr, []).append((m, lineno, locked))
+        return out
+
+    t_writes = side_writes(thread_side)
+    p_writes = side_writes(public_side)
+
+    for attr in sorted(set(t_writes) & set(p_writes)):
+        sites = {
+            (m, lineno, locked)
+            for m, lineno, locked in t_writes[attr] + p_writes[attr]
+        }
+        for m, lineno, locked in sorted(sites, key=lambda s: s[1]):
+            if locked:
+                continue
+            findings.append(
+                Finding(
+                    analyzer=ANALYZER,
+                    path=module.path,
+                    line=lineno,
+                    key=f"{ANALYZER}:{module.path}:{cls.name}.{attr}:{m}",
+                    message=(
+                        f"{cls.name}.{attr} is written by both the thread "
+                        f"side ({', '.join(sorted(set(s[0] for s in t_writes[attr])))}) "
+                        f"and the public side "
+                        f"({', '.join(sorted(set(s[0] for s in p_writes[attr])))}); "
+                        f"this write in {m}() is not under a self._lock-"
+                        f"family lock"
+                    ),
+                )
+            )
+    return findings
+
+
+def check_module(module: SourceModule) -> List[Finding]:
+    findings: List[Finding] = []
+    for node in ast.walk(module.tree):
+        if isinstance(node, ast.ClassDef):
+            findings.extend(_analyze_class(module, node))
+    return apply_pragmas(module, findings)

@@ -34,6 +34,14 @@ ENVELOPE_KEYS = frozenset({"t", "ts", "host", "run", "kind", "schema"})
 # Per-kind required payload keys (beyond the envelope).  Kinds not listed
 # here are free-form but still get the envelope + sanitisation.
 REQUIRED_KEYS: Dict[str, frozenset] = {
+    "notice": frozenset({"event"}),  # reasoned one-shot operational notices
+    # (quant_fallback_multihost, device_sampling_fallback, ... — a path
+    # declined a feature and says why; counted, never health-degrading)
+    "actor": frozenset({"tick"}),  # chaos-soak actor-child cadence row
+    # (acted/lag/weight_version/produced/shed_frames — scripts/chaos_soak.py)
+    "adopt": frozenset({"tick", "version"}),  # out-of-process weight
+    # adoption (MailboxSubscriber consumers: version/prev_version/checksum/
+    # chain_len/resyncs — the bit-exactness witness chaos_soak asserts)
     "learn": frozenset({"step", "frames", "loss"}),  # per-interval train row
     # (replay-reuse runs — cfg.replay_ratio > 1 — additionally carry
     # `replay_ratio`, `reuse_index` (last completed pass of the newest
@@ -113,6 +121,14 @@ REQUIRED_KEYS: Dict[str, frozenset] = {
 
 HEALTH_STATUSES = ("ok", "degraded", "failing")
 
+# THE registry of known row kinds.  Every ``kind`` this repo emits must be
+# a REQUIRED_KEYS entry (free-form payloads register with an empty set) —
+# the config-drift analyzer (analysis/configcheck.py) enforces the
+# emission side statically, and lint_jsonl enforces the consumption side
+# with ``require_known_kind=True``, so a new kind can never be valid in
+# one place and unknown in the other.
+KNOWN_KINDS = frozenset(REQUIRED_KEYS)
+
 
 def sanitize(value: Any) -> Any:
     """Recursively make ``value`` strict-JSON serialisable: non-finite floats
@@ -140,9 +156,13 @@ def sanitize(value: Any) -> Any:
     return str(value)  # last resort: never let dumps() raise mid-run
 
 
-def validate_row(row: Dict[str, Any]) -> List[str]:
+def validate_row(
+    row: Dict[str, Any], require_known_kind: bool = False
+) -> List[str]:
     """Schema errors for one parsed row ([] = valid).  Checks the envelope,
-    the schema version, and the kind's required payload keys."""
+    the schema version, and the kind's required payload keys.
+    ``require_known_kind=True`` (lint_jsonl) additionally rejects kinds
+    absent from KNOWN_KINDS — the registry IS the valid set."""
     errors = []
     for key in ("kind", "schema", "ts", "host", "run"):
         if key not in row:
@@ -150,6 +170,11 @@ def validate_row(row: Dict[str, Any]) -> List[str]:
     if row.get("schema") not in (None, SCHEMA_VERSION):
         errors.append(f"unknown schema version {row.get('schema')!r}")
     kind = row.get("kind")
+    if require_known_kind and kind not in KNOWN_KINDS:
+        errors.append(
+            f"unknown row kind {kind!r} (not registered in "
+            f"obs/schema.py REQUIRED_KEYS)"
+        )
     for key in REQUIRED_KEYS.get(kind, frozenset()):
         if key not in row:
             errors.append(f"'{kind}' row missing required key '{key}'")

@@ -138,7 +138,8 @@ class HeartbeatWriter:
             hb = self.injector.fire("heartbeat_loss")
             ll = self.injector.fire("lease_lost")
             if hb or ll:
-                self.suppressed += 1
+                with self._payload_lock:
+                    self.suppressed += 1
                 return
         os.makedirs(self.directory, exist_ok=True)
         dynamic: Dict = {}
@@ -160,7 +161,8 @@ class HeartbeatWriter:
         with open(tmp, "w") as f:
             json.dump(row, f)
         os.replace(tmp, self.path)
-        self.beats += 1
+        with self._payload_lock:
+            self.beats += 1
 
     def _run(self) -> None:
         while not self._stop.is_set():

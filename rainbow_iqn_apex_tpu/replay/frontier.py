@@ -245,6 +245,8 @@ class DeviceSampleFrontier:
         if not changed:
             return 0
         shard_of = np.asarray(idx).ravel() // self.cap
+        # materializing drawn indices at gather time is the design (PR 6):
+        # host-sync-ok: runs on the pusher worker thread, not the learner
         return int(np.isin(shard_of, changed).sum())
 
     # ------------------------------------------------------------------ draw
@@ -342,6 +344,7 @@ class DeviceSampleFrontier:
         host restored or re-seeded)."""
         jnp = self._jnp
         tree = self.trees[k]
+        # host-sync-ok: host sum-tree slice on the cold readmission path
         vals = np.asarray(
             tree.tree[tree.span:tree.span + self.cap], np.float32
         )
@@ -422,6 +425,7 @@ def make_batch_assembler(memory, to_device: Callable[[Any], Any],
         ok = memory.eligible_mask(idx)
         if not ok.all():
             if c_stale is not None:
+                # host-sync-ok: host eligible_mask ndarray, pusher thread
                 c_stale.inc(int((~ok).sum()))
             weight = np.where(ok, weight, 0.0).astype(np.float32)
         sample = memory.assemble_global(idx, weight)

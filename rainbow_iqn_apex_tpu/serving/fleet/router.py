@@ -547,9 +547,12 @@ class FrontRouter:
         events = self.registry.poll()
         self._drain_retries()
         now = self.clock()
-        if (self.metrics_interval_s > 0
-                and now - self._t_last_emit >= self.metrics_interval_s):
-            self._t_last_emit = now
+        with self._lock:
+            due = (self.metrics_interval_s > 0
+                   and now - self._t_last_emit >= self.metrics_interval_s)
+            if due:
+                self._t_last_emit = now
+        if due:
             self.emit_route_row()
         return events
 

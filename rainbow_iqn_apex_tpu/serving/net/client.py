@@ -316,7 +316,8 @@ class RemoteTransport:
         if "depth" in header:
             self._depth = int(header["depth"])
         if "version" in header:
-            self._version = int(header["version"])
+            with self._lock:
+                self._version = int(header["version"])
         if "lanes" in header:
             self.lanes = max(int(header["lanes"]), 1)
         if "buckets" in header:
@@ -407,7 +408,8 @@ class RemoteTransport:
         return self._version
 
     def set_version(self, version: int) -> None:
-        self._version = int(version)
+        with self._lock:
+            self._version = int(version)
 
     # --------------------------------------------------------- wire-only ops
     def request(self, header: Dict[str, Any], blob: bytes = b"",

@@ -151,13 +151,14 @@ class RouterGossip:
             snap = dict(self.snapshot_fn())
         except Exception:
             return 0  # a flaky snapshot must not kill the gossip loop
-        self._seq += 1
+        with self._lock:
+            self._seq += 1
+            seq = self._seq
+            peers = list(self._peers)
         data = framing.encode_frame({
-            "op": "gossip", "router": self.router_id, "seq": self._seq,
+            "op": "gossip", "router": self.router_id, "seq": seq,
             "ts": round(time.time(), 3), "snap": snap,
         })
-        with self._lock:
-            peers = list(self._peers)
         reached = 0
         for peer in peers:
             try:
@@ -165,8 +166,10 @@ class RouterGossip:
                 reached += 1
             except OSError:
                 pass
-        self.sent += 1
-        if self.sent % self.row_every == 0:
+        with self._lock:
+            self.sent += 1
+            emit = self.sent % self.row_every == 0
+        if emit:
             self._emit_row()
         return reached
 
@@ -174,7 +177,8 @@ class RouterGossip:
         try:
             frames = framing.FrameReader(_MAX_DATAGRAM).feed(data)
         except framing.FrameError:
-            self.bad_frames += 1
+            with self._lock:
+                self.bad_frames += 1
             return
         for header, _blob in frames:
             if header.get("op") != "gossip":
@@ -197,7 +201,7 @@ class RouterGossip:
                 snap = dict(header.get("snap") or {})
                 snap["_seq"] = int(header.get("seq", 0))
                 self._view[int(peer_id)] = (snap, now)
-            self.received += 1
+                self.received += 1
 
     def poll_once(self, budget_s: float = 0.2) -> None:
         """Drain pending datagrams inline (thread-less mode for tests and

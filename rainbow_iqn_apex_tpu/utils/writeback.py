@@ -217,6 +217,7 @@ def reuse_learn_row(reuse_k: int,
     ri = scalars.get("reuse_index")
     return {
         "replay_ratio": reuse_k,
+        # host-sync-ok: ring-retired host scalars, already materialized
         "reuse_index": None if ri is None else int(ri),
         "clip_frac": scalars.get("clip_frac"),
     }

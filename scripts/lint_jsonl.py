@@ -15,21 +15,19 @@ Importable: ``lint_line(line) -> Optional[str]`` and
 ``lint_file(path) -> List[str]`` are what the test suite and obs_report use.
 Exit codes: 0 = clean, 1 = any error (each printed as ``path:line: why``).
 
-The validated kind set includes the elasticity rows (``host_alive``,
-``shard_readmit``, ``actor_fenced`` — obs/schema.py REQUIRED_KEYS), so a
-chaos-soak run dir lints as strictly as a training run dir, and the
-pipeline-tracing rows (``span_link``/``lag`` — obs/pipeline_trace.py), so a
-traced run dir lints before trace_export/obs_report consume it, and the
-cross-host serving rows (``net``/``gossip`` — serving/net/), so a net-smoke
-run dir lints before its `net:` report section is read.  Replay-reuse runs
-(cfg.replay_ratio > 1) extend ``learn``/``health``/``lag`` rows with
-``replay_ratio``/``reuse_index``/``clip_frac``/``reuse_clip_frac`` — all
-optional payload keys under the same strict-JSON rules (obs/schema.py
-documents them on the learn kind), and the ``replay_reuse`` bench row's
-fields ride through the bench JSONL the perf-smoke target lints.  League
-runs add the ``league`` kind (event-keyed: status/exploit/adopt/... —
-league/, docs/LEAGUE.md), so a league-smoke dir — controller AND member
-JSONL — lints before its `league:` report section is read.
+The valid kind set is NOT maintained here: it is exactly
+``obs/schema.py REQUIRED_KEYS`` (``KNOWN_KINDS``), validated with
+``require_known_kind=True`` — so a chaos-soak, traced, net-smoke, or
+league run dir lints against the same registry the emitters and the
+golden-schema test use, and a kind can never be valid in one layer and
+unknown in another.  The static config-drift analyzer
+(rainbow_iqn_apex_tpu/analysis/configcheck.py) closes the loop from the
+emission side: every ``logger.log("<kind>", ...)`` literal in the package
+and scripts/ must name a registered kind, so registry and emitters move
+in the same commit.  Replay-reuse runs (cfg.replay_ratio > 1) extend
+``learn``/``health``/``lag`` rows with optional payload keys under the
+same strict-JSON rules; the bench rows perf-smoke lints carry no ``kind``
+and skip schema validation by design.
 """
 
 from __future__ import annotations
@@ -65,7 +63,13 @@ def lint_line(line: str, check_schema: bool = True) -> Optional[str]:
     if not isinstance(row, dict):
         return f"row is {type(row).__name__}, expected object"
     if check_schema and "kind" in row:
-        errs = validate_row(row)
+        # require_known_kind: the schema registry (obs/schema.py
+        # REQUIRED_KEYS) is the ONE list of valid kinds — this linter
+        # carries none of its own, so a kind added to the registry is valid
+        # here in the same commit and an unregistered kind fails both the
+        # static config-drift analyzer (emission side) and this lint
+        # (consumption side)
+        errs = validate_row(row, require_known_kind=True)
         if errs:
             return "; ".join(errs)
     return None

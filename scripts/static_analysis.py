@@ -1,0 +1,76 @@
+#!/usr/bin/env python
+"""static_analysis: run the house static analyzers over the repo.
+
+    python scripts/static_analysis.py                 # full run, baseline-filtered
+    python scripts/static_analysis.py --analyzer lock-discipline
+    python scripts/static_analysis.py --no-baseline   # include grandfathered keys
+    python scripts/static_analysis.py --list          # analyzer ids
+
+Analyzers (rainbow_iqn_apex_tpu/analysis/; docs/OBSERVABILITY.md "Static
+invariants"): lock-discipline, host-sync, jax-free, config-drift,
+doc-drift.  Exit codes: 0 = finding-free, 1 = findings, 2 = usage error.
+
+jax-free itself: this CLI imports only the analysis package + stdlib, so
+it runs on boxes with no jax install (the checker self-hosts that claim).
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO)
+
+from rainbow_iqn_apex_tpu.analysis import core, runner  # noqa: E402
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        description="house-invariant static analyzers"
+    )
+    parser.add_argument(
+        "--analyzer",
+        action="append",
+        default=None,
+        help="restrict to this analyzer id (repeatable)",
+    )
+    parser.add_argument(
+        "--repo-root", default=_REPO, help="repository root to analyze"
+    )
+    parser.add_argument(
+        "--baseline",
+        default=None,
+        help="baseline file (default: the checked-in "
+        f"{runner.BASELINE_PATH})",
+    )
+    parser.add_argument(
+        "--no-baseline",
+        action="store_true",
+        help="ignore the baseline (report grandfathered findings too)",
+    )
+    parser.add_argument(
+        "--list", action="store_true", help="print analyzer ids and exit"
+    )
+    args = parser.parse_args(argv)
+
+    if args.list:
+        for aid in runner.ANALYZER_IDS:
+            print(aid)
+        return 0
+
+    baseline = "" if args.no_baseline else args.baseline
+    try:
+        findings = runner.run_all(
+            args.repo_root, analyzers=args.analyzer, baseline_path=baseline
+        )
+    except ValueError as e:
+        print(f"static_analysis: {e}", file=sys.stderr)
+        return 2
+    print(core.render_report(findings))
+    return 1 if findings else 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,8 @@
+"""Golden fixture: config-drift CLEAN — real Config fields, a registered
+row kind."""
+
+
+def report(cfg, logger):
+    x = cfg.batch_size + cfg.replay_ratio
+    logger.log("notice", event="fixture", value=x)
+    return cfg.replace(batch_size=x)

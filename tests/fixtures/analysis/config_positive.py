@@ -1,0 +1,8 @@
+"""Golden fixture: config-drift POSITIVE — a cfg read that resolves to no
+Config field and an unregistered emitted row kind."""
+
+
+def report(cfg, logger):
+    x = cfg.not_a_real_field  # no such Config field
+    logger.log("bogus_kind_xyz", value=x)  # unregistered row kind
+    return x

@@ -1,0 +1,9 @@
+"""Golden fixture: config-drift PRAGMA — same drift shapes, suppressed
+with reasons."""
+
+
+def report(cfg, logger):
+    x = cfg.not_a_real_field  # drift-ok: fixture — duck-typed test config
+    # drift-ok: fixture — harness-local row, never reaches a lint dir
+    logger.log("bogus_kind_xyz", value=x)
+    return x

@@ -1,0 +1,15 @@
+"""Golden fixture: host-sync CLEAN — materializations through the
+sanctioned seam, benign host-scalar coercions left bare."""
+
+from rainbow_iqn_apex_tpu.utils import hostsync
+
+
+def hot_learn(info, batch_size: int, frames: "np.ndarray"):
+    import numpy as np
+
+    n = int(batch_size)  # annotated host scalar: benign
+    staged = np.asarray(frames)  # annotated np.ndarray param: benign
+    with hostsync.sanctioned():
+        loss = float(info["loss"])  # sanctioned scope
+    pri = hostsync.to_host(info["priorities"])  # the seam re-checks itself
+    return n, staged, loss, pri

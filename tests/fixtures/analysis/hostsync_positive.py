@@ -1,0 +1,11 @@
+"""Golden fixture: host-sync POSITIVE — bare materializations of device
+values inside a declared hot-path function."""
+
+import numpy as np
+
+
+def hot_learn(info):
+    loss = float(info["loss"])  # the classic BENCH_r01-r05 regression
+    pri = np.asarray(info["priorities"])  # device pull outside sanctioned()
+    steps = info["steps"].item()  # scalar sync
+    return loss, pri, steps

@@ -1,0 +1,12 @@
+"""Golden fixture: host-sync PRAGMA — same shapes, suppressed with reasons
+(plus one reasonless pragma that must surface as pragma-reason)."""
+
+import numpy as np
+
+
+def hot_learn(info):
+    # host-sync-ok: fixture — runs on the worker thread by contract
+    loss = float(info["loss"])
+    pri = np.asarray(info["priorities"])  # host-sync-ok: fixture — host list
+    steps = info["steps"].item()  # host-sync-ok:
+    return loss, pri, steps

@@ -1,0 +1,19 @@
+"""Golden fixture: jax-free CLEAN — stdlib + jax-free package imports only;
+jax appears only lazily (function-local) and under TYPE_CHECKING."""
+
+import json
+from typing import TYPE_CHECKING
+
+from rainbow_iqn_apex_tpu.obs import schema
+
+if TYPE_CHECKING:  # not eager: does not count
+    import jax
+
+
+def lazy_use():
+    import jax  # function-local: not eager
+
+    return jax
+
+
+__all__ = ["json", "schema", "lazy_use"]

@@ -1,0 +1,6 @@
+"""Golden fixture: jax-free POSITIVE — claims jax-free but imports a
+jax-tainted package module at top level (transitive reach)."""
+
+from rainbow_iqn_apex_tpu.ops import learn  # tainted: ops/learn imports jax
+
+__all__ = ["learn"]

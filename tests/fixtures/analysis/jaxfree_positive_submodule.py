@@ -1,0 +1,7 @@
+"""Golden fixture: jax-free POSITIVE (submodule-import form) — ``from pkg
+import sub`` executes the submodule even when the package __init__ is a
+lazy PEP-562 shell; the checker must resolve the composite module path."""
+
+from rainbow_iqn_apex_tpu.parallel import apex  # lazy pkg, tainted submodule
+
+__all__ = ["apex"]

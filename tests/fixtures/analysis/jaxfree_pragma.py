@@ -1,0 +1,5 @@
+"""Golden fixture: jax-free PRAGMA — a sanctioned direct jax import."""
+
+import jax  # jax-ok: fixture — this module is the declared jax-facing half
+
+__all__ = ["jax"]

@@ -1,0 +1,27 @@
+"""Golden fixture: lock-discipline CLEAN — the same shape with every
+shared write under the lock, and the *_locked contract honoured."""
+
+import threading
+
+
+class Disciplined:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.count = 0
+        self._thread = None
+
+    def _run(self):
+        while True:
+            with self._lock:
+                self.count += 1
+
+    def start(self):
+        self._thread = threading.Thread(target=self._run, daemon=True)
+        self._thread.start()
+
+    def _reset_locked(self):
+        self.count = 0
+
+    def reset(self):
+        with self._lock:
+            self._reset_locked()

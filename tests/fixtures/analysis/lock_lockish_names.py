@@ -1,0 +1,31 @@
+"""Golden fixture: lock-discipline anchoring — attributes whose names merely
+CONTAIN lock-family substrings (clock, seconds, blocked) are ordinary
+shared state, not locks: racy writes to them must still flag, and a
+``with self.clock:`` must not count as a held lock."""
+
+import threading
+
+
+class LockishNames:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.clock = 0.0
+        self.seconds = 0
+        self.blocked = 0
+        self._thread = None
+
+    def _run(self):
+        while True:
+            self.clock += 1.0  # 'clock' contains 'lock' — still a finding
+            self.seconds += 1  # 'seconds' contains 'cond' — still a finding
+            with self.clock:  # NOT a lock: writes inside stay unlocked
+                self.blocked += 1
+
+    def start(self):
+        self._thread = threading.Thread(target=self._run, daemon=True)
+        self._thread.start()
+
+    def reset(self):
+        self.clock = 0.0
+        self.seconds = 0
+        self.blocked = 0

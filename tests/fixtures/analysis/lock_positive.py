@@ -1,0 +1,28 @@
+"""Golden fixture: lock-discipline POSITIVE — a thread-shared attribute
+written unlocked on both sides, plus a bare *_locked call."""
+
+import threading
+
+
+class Racy:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.count = 0
+        self._thread = None
+
+    def _run(self):
+        while True:
+            self.count += 1  # thread-side unlocked write -> finding
+
+    def start(self):
+        self._thread = threading.Thread(target=self._run, daemon=True)
+        self._thread.start()
+
+    def reset(self):
+        self.count = 0  # public-side unlocked write -> finding
+
+    def _release_locked(self):
+        self.count = 0
+
+    def stop(self):
+        self._release_locked()  # bare *_locked call -> finding

@@ -1,0 +1,28 @@
+"""Golden fixture: lock-discipline PRAGMA — the same race shape, suppressed
+by reasoned ``# unlocked-ok:`` pragmas (plus one REASONLESS pragma that must
+surface as a pragma-reason finding)."""
+
+import threading
+
+
+class SingleWriter:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.count = 0
+        self.other = 0
+        self._thread = None
+
+    def _run(self):
+        while True:
+            # unlocked-ok: fixture — single writer by protocol
+            self.count += 1
+            self.other += 1  # unlocked-ok:
+
+    def start(self):
+        self._thread = threading.Thread(target=self._run, daemon=True)
+        self._thread.start()
+
+    def reset(self):
+        self.count = 0  # unlocked-ok: fixture — reset only before start()
+        # unlocked-ok: fixture — reset only before start()
+        self.other = 0
