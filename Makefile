@@ -2,7 +2,7 @@
 
 PY ?= python
 
-.PHONY: test test-fast serve-smoke serve-bench chaos-smoke obs-smoke soak-smoke perf-smoke fleet-smoke quant-smoke trace-smoke multitask-smoke net-smoke league-smoke static-smoke
+.PHONY: test test-fast serve-smoke serve-bench chaos-smoke obs-smoke soak-smoke perf-smoke fleet-smoke quant-smoke trace-smoke multitask-smoke net-smoke replaynet-smoke league-smoke static-smoke
 
 # tier-1: fast unit + integration tests on the virtual 8-device CPU mesh
 test-fast:
@@ -63,6 +63,24 @@ net-smoke:
 	JAX_PLATFORMS=cpu $(PY) scripts/bench_serve.py --fleet-soak --net \
 	  --engines 2 --duration 8 --out /tmp/ria_net_soak
 	$(PY) scripts/lint_jsonl.py /tmp/ria_net_soak
+
+# cross-host replay smoke (docs/RESILIENCE.md "replay plane"): the
+# `net`-marked replay plane tests (framing hoist, append/sample/update
+# round trip, bitwise twin + chi-square sampling parity, epoch fencing,
+# drop/readmit, step-fenced snapshots — tier-1 too), then the REAL
+# multi-process soak: 2 actor hosts + 1 learner + 2 shard-server
+# processes discovered purely via lease files, one server SIGKILLed
+# mid-load and respawned at a bumped epoch; gates (self-asserted, exit
+# 1): the learner never stalls, zero appended-and-acked rows lost on the
+# survivor, readmit restores sampling from the revived incarnation, the
+# step-fenced server-side snapshot acked — and the run dir lints as
+# strict schema-versioned JSONL (replay_net rows included)
+replaynet-smoke:
+	JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_replay_net.py -q -m net
+	rm -rf /tmp/ria_replaynet_smoke
+	JAX_PLATFORMS=cpu $(PY) scripts/replay_net_smoke.py --duration 12 \
+	  --out /tmp/ria_replaynet_smoke
+	$(PY) scripts/lint_jsonl.py /tmp/ria_replaynet_smoke
 
 # chaos smoke: every named fault-injection point exercised end to end
 # (NaN rollback, corrupt-checkpoint fallback, torn-snapshot CRC, retried

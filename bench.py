@@ -167,7 +167,12 @@ def measure() -> None:
             print(json.dumps(row), flush=True)
         for row in _run_row_budgeted(
             "sample_path", "replay_sample_path_batches_per_sec",
-            _measure_sample_path, left, share=0.9,
+            _measure_sample_path, left, share=0.7,
+        ):
+            print(json.dumps(row), flush=True)
+        for row in _run_row_budgeted(
+            "replay_net_path", "replay_net_sample_batches_per_sec",
+            _measure_replay_net_path, left, share=0.9,
         ):
             print(json.dumps(row), flush=True)
         return
@@ -295,7 +300,12 @@ def measure() -> None:
                 print(json.dumps(row), flush=True)
             for row in _run_row_budgeted(
                 "sample_path", "replay_sample_path_batches_per_sec",
-                _measure_sample_path, left, share=0.7,
+                _measure_sample_path, left, share=0.6,
+            ):
+                print(json.dumps(row), flush=True)
+            for row in _run_row_budgeted(
+                "replay_net_path", "replay_net_sample_batches_per_sec",
+                _measure_replay_net_path, left, share=0.7,
             ):
                 print(json.dumps(row), flush=True)
         else:
@@ -1035,6 +1045,98 @@ def _measure_sample_path(left=None) -> list:
         "speedup_vs_host": round(best_f / max(best_h, 1e-9), 3),
         "n_iters": iters,
         "reps": rep,
+    }]
+
+
+def _measure_replay_net_path(left=None) -> list:
+    """Cross-host replay sample-path micro bench (ISSUE 16): pipelined
+    `SampleClient` batches over a REAL loopback socket against a
+    `ReplayShardServer` vs the in-process host sum-tree path over the SAME
+    shard block, one row with both rates and ``ratio_vs_host``.
+
+    Report-only (bench_diff REPORTED, not GATED): loopback frame encode +
+    TCP round trips price the disaggregation tax, and that tax is machine
+    weather on a shared sandbox — the trajectory records it; promote once
+    a few rounds exist.  The wire side stays competitive because the
+    client keeps ``depth`` sample requests in flight, so the server's
+    sample+encode overlaps the client's decode of the previous batch."""
+    if left is None:
+        left = lambda: float("inf")  # noqa: E731
+    import numpy as np
+
+    from rainbow_iqn_apex_tpu.parallel.sharded_replay import ShardedReplay
+    from rainbow_iqn_apex_tpu.replay.net.client import (
+        ReplayPeer,
+        SampleClient,
+    )
+    from rainbow_iqn_apex_tpu.replay.net.server import ReplayShardServer
+
+    shards = int(os.environ.get("BENCH_RN_SHARDS", "2"))
+    cap = int(os.environ.get("BENCH_RN_CAP", str(1 << 12)))
+    lanes = int(os.environ.get("BENCH_RN_LANES", "8"))
+    iters = int(os.environ.get("BENCH_RN_ITERS", "150"))
+    B, beta = 32, 0.4
+
+    memory = ShardedReplay.build(
+        shards, cap, lanes, frame_shape=(84, 84), history=4, n_step=3,
+        seed=0,
+    )
+    rng = np.random.default_rng(0)
+    pool = [rng.integers(0, 255, (lanes, 84, 84), dtype=np.uint8)
+            for _ in range(8)]
+    for t in range(cap // lanes):
+        if left() < 30:
+            print("bench child: replay_net_path budget exhausted during "
+                  "fill", file=sys.stderr, flush=True)
+            return []
+        memory.append_batch(
+            pool[t % 8],
+            rng.integers(0, 18, lanes),
+            rng.normal(size=lanes).astype(np.float32),
+            rng.random(lanes) < 0.01,
+            priorities=rng.random(lanes) + 0.05,
+        )
+
+    def run_host(n: int) -> float:
+        t0 = time.perf_counter()
+        for _ in range(n):
+            memory.sample(B, beta)
+        return n / (time.perf_counter() - t0)
+
+    srv = ReplayShardServer(memory, shard_base=0, host="127.0.0.1",
+                            port=0).start()
+    sc = SampleClient({0: ReplayPeer("127.0.0.1", srv.port, peer_id=0)},
+                      B, lambda: beta, depth=3, seed=0)
+    try:
+        for _ in range(4):  # warm the pipeline + both socket directions
+            sc.get(timeout=30)
+        run_host(4)  # touch the host path caches
+        if left() < 20:
+            print("bench child: replay_net_path budget exhausted after "
+                  "warmup", file=sys.stderr, flush=True)
+            return []
+        host_rate = run_host(iters)
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            sc.get(timeout=30)
+        wire_rate = iters / (time.perf_counter() - t0)
+    finally:
+        sc.close()
+        srv.stop()
+    return [{
+        "metric": "replay_net_sample_batches_per_sec",
+        "value": round(wire_rate, 2),
+        "unit": (
+            f"wire sample batches/s (batch={B}, 84x84x4 Atari shape, "
+            f"{shards}-shard block behind one loopback ReplayShardServer, "
+            f"{cap} slots; pipelined SampleClient depth=3 vs the same "
+            f"memory's in-process sum-tree sample path; {iters} iters)"
+        ),
+        "vs_baseline": None,  # micro-path — not a learn-steps/s number
+        "path": "replay_net_path",
+        "host_batches_per_sec": round(host_rate, 2),
+        "ratio_vs_host": round(wire_rate / max(host_rate, 1e-9), 3),
+        "n_iters": iters,
     }]
 
 

@@ -71,6 +71,10 @@ DEFAULT_OFF: Dict[str, object] = {
     "serve_net_advertise": "",
     "serve_net_gossip_port": 0,
     "serve_net_gossip_peers": "",
+    "replay_net_host": "",
+    "replay_net_port": 0,
+    "replay_net_advertise": "",
+    "replay_net_remote": False,
     "mesh_shape": "",
     "coordinator_address": "",
     "snapshot_replay": False,

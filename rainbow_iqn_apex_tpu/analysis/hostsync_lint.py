@@ -53,6 +53,15 @@ HOT_PATH: Dict[str, Sequence[str]] = {
         "put_frames",
         "to_device_batch",
     ),
+    # the wire replay plane's learner-side hot path: decode/gather of
+    # pipelined sample batches and the write-back routing math both run
+    # inside the zero-sync learn loop, so their host materializations must
+    # sit under sanctioned() exactly like the frontier's gathers
+    "rainbow_iqn_apex_tpu/replay/net/client.py": (
+        "SampleClient.get",
+        "SampleClient._decode_batch",
+        "SampleClient.update_priorities",
+    ),
     "rainbow_iqn_apex_tpu/parallel/apex.py": (
         "ActorPriorityEstimator.push",
         "ApexDriver.act",

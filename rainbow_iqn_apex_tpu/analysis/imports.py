@@ -57,9 +57,11 @@ JAX_FREE_MODULES: Tuple[str, ...] = (
     "rainbow_iqn_apex_tpu/obs/health.py",
     "rainbow_iqn_apex_tpu/obs/pipeline_trace.py",
     "rainbow_iqn_apex_tpu/obs/registry.py",
+    "rainbow_iqn_apex_tpu/netcore/",
     "rainbow_iqn_apex_tpu/obs/schema.py",
     "rainbow_iqn_apex_tpu/parallel/elastic.py",
     "rainbow_iqn_apex_tpu/parallel/sharded_replay.py",
+    "rainbow_iqn_apex_tpu/replay/net/",
     "rainbow_iqn_apex_tpu/serving/batcher.py",
     "rainbow_iqn_apex_tpu/serving/fleet/",
     "rainbow_iqn_apex_tpu/serving/metrics.py",
@@ -77,7 +79,10 @@ JAX_FREE_MODULES: Tuple[str, ...] = (
 LAZY_PACKAGE_INITS: Tuple[str, ...] = (
     "rainbow_iqn_apex_tpu/analysis/__init__.py",
     "rainbow_iqn_apex_tpu/league/__init__.py",
+    "rainbow_iqn_apex_tpu/netcore/__init__.py",
     "rainbow_iqn_apex_tpu/parallel/__init__.py",
+    "rainbow_iqn_apex_tpu/replay/__init__.py",
+    "rainbow_iqn_apex_tpu/replay/net/__init__.py",
     "rainbow_iqn_apex_tpu/serving/__init__.py",
     "rainbow_iqn_apex_tpu/serving/fleet/__init__.py",
     "rainbow_iqn_apex_tpu/serving/net/__init__.py",

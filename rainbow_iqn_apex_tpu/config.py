@@ -302,6 +302,37 @@ class Config:
     # routers; "" = solo router, federation off (no gossip socket at all)
     serve_net_gossip_interval_s: float = 1.0  # snapshot broadcast cadence
 
+    # ---- cross-host replay plane (replay/net/; docs/RESILIENCE.md) ----------------
+    replay_net_host: str = ""  # bind address for this process's replay shard
+    # server ("" = no shard server in this process, the default; a shard
+    # server process sets it and registers a `replay_shard` lease carrying
+    # addr:port + shard range + epoch)
+    replay_net_port: int = 0  # listen port; 0 = ephemeral — the lease payload
+    # advertises whatever was bound, same discovery as serve_net_port
+    replay_net_advertise: str = ""  # address peers dial ("" = the bind host;
+    # set it when binding a wildcard or behind NAT)
+    replay_net_remote: bool = False  # learner/actor client gate: True swaps
+    # the in-process ShardedReplay for the cross-host plane (appends spool to
+    # AppendClients, samples pipeline through a SampleClient, priorities ride
+    # batched update frames).  False — the default — keeps replay in-process
+    # and every code path bitwise the pre-plane behaviour (tier-1 asserted).
+    replay_net_max_frame_mb: int = 64  # frames declaring more than this are
+    # rejected BEFORE allocation with a reasoned error (netcore/framing)
+    replay_net_spool: int = 4096  # actor-side spool capacity in ticks: the
+    # buffering horizon an unreachable shard server is ridden out over; a
+    # FULL spool sheds the newest tick with a reasoned row (actors never
+    # block on the wire)
+    replay_net_inflight: int = 4  # bounded in-flight append blocks per
+    # AppendClient — the backpressure window between spool and wire
+    replay_net_probe_timeout_s: float = 0.5  # bounded per-probe budget for
+    # plane liveness pings (one hung shard server never stalls the sweep)
+    replay_net_shard_base: int = 0  # first GLOBAL shard id this process's
+    # shard server owns — multitask pins game-major shard blocks to servers
+    # by spacing bases (shards-per-game apart), the multi-host multi-game
+    # composition
+    replay_net_shard_count: int = 0  # shards this server owns; 0 = all
+    # `replay_shards` (the single-server topology)
+
     # ---- league / population-based training (league/; docs/LEAGUE.md) -------------
     league_dir: str = ""  # shared league state directory (genomes, per-member
     # weight mailboxes, exploit directives).  "" = league OFF everywhere — the

@@ -1,0 +1,70 @@
+"""netcore: the shared jax-free wire layer under both cross-host planes.
+
+`serving/net/` (PR 11, the serving plane) and `replay/net/` (the replay
+plane) both speak the same length-prefixed CRC-checked frame protocol; the
+codec lives here so neither plane imports the other's package.  The old
+import path ``rainbow_iqn_apex_tpu.serving.net.framing`` remains a
+back-compat re-export of `netcore.framing`.
+
+Exports resolve lazily (PEP 562, the parallel/ pattern) even though
+everything below is jax-free — the house rule is that package ``__init__``s
+stay import-cheap so a process that wants only one symbol never pays for
+siblings.
+"""
+
+from typing import TYPE_CHECKING
+
+_EXPORTS = {
+    "framing": "rainbow_iqn_apex_tpu.netcore",
+    "FrameError": "rainbow_iqn_apex_tpu.netcore.framing",
+    "FrameProtocol": "rainbow_iqn_apex_tpu.netcore.framing",
+    "FrameTooLarge": "rainbow_iqn_apex_tpu.netcore.framing",
+    "FrameCorrupt": "rainbow_iqn_apex_tpu.netcore.framing",
+    "FrameTruncated": "rainbow_iqn_apex_tpu.netcore.framing",
+    "FrameReader": "rainbow_iqn_apex_tpu.netcore.framing",
+    "DEFAULT_MAX_FRAME": "rainbow_iqn_apex_tpu.netcore.framing",
+    "encode_frame": "rainbow_iqn_apex_tpu.netcore.framing",
+    "recv_frame": "rainbow_iqn_apex_tpu.netcore.framing",
+    "send_frame": "rainbow_iqn_apex_tpu.netcore.framing",
+    "encode_ndarray": "rainbow_iqn_apex_tpu.netcore.framing",
+    "decode_ndarray": "rainbow_iqn_apex_tpu.netcore.framing",
+    "pack_blobs": "rainbow_iqn_apex_tpu.netcore.framing",
+    "unpack_blobs": "rainbow_iqn_apex_tpu.netcore.framing",
+}
+
+__all__ = sorted(_EXPORTS)
+
+
+def __getattr__(name: str):
+    module = _EXPORTS.get(name)
+    if module is None:
+        raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
+    import importlib
+
+    if name == "framing":
+        return importlib.import_module(f"{module}.framing")
+    return getattr(importlib.import_module(module), name)
+
+
+def __dir__():
+    return __all__
+
+
+if TYPE_CHECKING:  # static analyzers see the eager imports
+    from rainbow_iqn_apex_tpu.netcore import framing  # noqa: F401
+    from rainbow_iqn_apex_tpu.netcore.framing import (  # noqa: F401
+        DEFAULT_MAX_FRAME,
+        FrameCorrupt,
+        FrameError,
+        FrameProtocol,
+        FrameReader,
+        FrameTooLarge,
+        FrameTruncated,
+        decode_ndarray,
+        encode_frame,
+        encode_ndarray,
+        pack_blobs,
+        recv_frame,
+        send_frame,
+        unpack_blobs,
+    )

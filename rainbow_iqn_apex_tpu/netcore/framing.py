@@ -1,0 +1,246 @@
+"""Length-prefixed binary framing shared by the cross-host planes.
+
+Hoisted out of ``serving/net/`` (PR 11) so the replay plane (replay/net/)
+and the serving plane stop depending on each other's package: both speak
+this one codec, and ``serving.net.framing`` remains a back-compat re-export.
+
+The wire is deliberately boring: stdlib ``socket`` bytes, no serialization
+dependency (the container bakes only the jax_graft toolchain — same no-deps
+contract as the ``/healthz`` server in obs/export.py).  One frame is
+
+    MAGIC(2) | VER(1) | header_len u32 | blob_len u32 | header | blob | crc32 u32
+
+big-endian, where ``header`` is one strict-JSON object (the op + small
+fields) and ``blob`` is an optional opaque binary payload (an observation
+frame, a Q-vector, a `WeightPacket` npz, a batch of replay transitions).
+The CRC32 trailer covers header+blob, so a frame that survived TCP but was
+corrupted by a buggy middlebox or a torn writer is rejected instead of
+decoded into garbage.
+
+Hardening contract (tests/test_net.py, tests/test_replay_net.py):
+
+- **torn / partial reads**: `recv_frame` loops until the full frame arrived;
+  a connection that dies MID-frame raises `FrameTruncated` (distinct from a
+  clean EOF *between* frames, which returns None).  The non-blocking
+  `FrameReader` buffers arbitrary byte dribbles and only yields complete
+  frames.
+- **oversize rejection**: a declared length past ``max_frame_bytes`` raises
+  `FrameTooLarge` with a reasoned message (the declared size, the limit, and
+  the knob that raises it) BEFORE any allocation — a malicious or corrupt
+  length header cannot OOM the receiver.
+- **checksum**: any header/blob corruption raises `FrameCorrupt`; a wrong
+  magic or version raises `FrameProtocol` (a peer speaking something else —
+  e.g. HTTP probing the port — is told apart from a corrupted peer).
+
+Everything here is jax-free (numpy only): router front-ends, gossip
+daemons, actor spoolers and replay shard servers import it without the
+device runtime.
+"""
+
+from __future__ import annotations
+
+import json
+import struct
+import zlib
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+MAGIC = b"RN"
+VERSION = 1
+_PREFIX = struct.Struct(">2sBII")  # magic, version, header_len, blob_len
+_TRAILER = struct.Struct(">I")  # crc32(header + blob)
+PREFIX_BYTES = _PREFIX.size
+TRAILER_BYTES = _TRAILER.size
+# 64 MiB; per-plane knob: Config.serve_net_max_frame_mb /
+# Config.replay_net_max_frame_mb
+DEFAULT_MAX_FRAME = 64 << 20
+
+
+class FrameError(RuntimeError):
+    """Base class: the connection's framing is broken (caller should drop
+    the connection — stream state past a framing error is unrecoverable)."""
+
+
+class FrameProtocol(FrameError):
+    """Bad magic/version: the peer is not speaking this protocol."""
+
+
+class FrameTooLarge(FrameError):
+    """Declared frame size exceeds the receiver's bound."""
+
+
+class FrameCorrupt(FrameError):
+    """CRC mismatch or undecodable header: bytes were damaged in flight."""
+
+
+class FrameTruncated(FrameError):
+    """The stream ended mid-frame (peer died with a frame half-sent)."""
+
+
+def encode_frame(header: Dict[str, Any], blob: bytes = b"") -> bytes:
+    """One wire frame for ``header`` (strict JSON) + optional ``blob``."""
+    hdr = json.dumps(header, allow_nan=False,
+                     separators=(",", ":")).encode("utf-8")
+    body = hdr + blob
+    return b"".join((
+        _PREFIX.pack(MAGIC, VERSION, len(hdr), len(blob)),
+        body,
+        _TRAILER.pack(zlib.crc32(body) & 0xFFFFFFFF),
+    ))
+
+
+def _check_prefix(prefix: bytes, max_frame_bytes: int) -> Tuple[int, int]:
+    magic, version, header_len, blob_len = _PREFIX.unpack(prefix)
+    if magic != MAGIC:
+        raise FrameProtocol(
+            f"bad frame magic {magic!r} (expected {MAGIC!r}): peer is not "
+            "speaking the netcore frame protocol")
+    if version != VERSION:
+        raise FrameProtocol(
+            f"frame protocol version {version} != supported {VERSION}")
+    total = header_len + blob_len
+    if total > max_frame_bytes:
+        raise FrameTooLarge(
+            f"frame declares {total} bytes (header {header_len} + blob "
+            f"{blob_len}), over the {max_frame_bytes}-byte bound — refusing "
+            "before allocation; raise this transport's max-frame knob "
+            "(serve_net_max_frame_mb / replay_net_max_frame_mb) if this "
+            "peer's payloads are legitimately this large")
+    return header_len, blob_len
+
+
+def _decode_body(body: bytes, header_len: int,
+                 crc: int) -> Tuple[Dict[str, Any], bytes]:
+    if (zlib.crc32(body) & 0xFFFFFFFF) != crc:
+        raise FrameCorrupt(
+            "frame checksum mismatch: header/blob bytes were damaged in "
+            "flight (dropping the connection — stream state is unrecoverable)")
+    try:
+        header = json.loads(body[:header_len].decode("utf-8"))
+    except (UnicodeDecodeError, ValueError) as e:
+        raise FrameCorrupt(f"frame header is not strict JSON: {e}")
+    if not isinstance(header, dict):
+        raise FrameCorrupt(
+            f"frame header is {type(header).__name__}, expected object")
+    return header, bytes(body[header_len:])
+
+
+class FrameReader:
+    """Incremental decoder for a non-blocking stream: ``feed(bytes)`` returns
+    every complete (header, blob) frame the buffer now holds.  Partial frames
+    stay buffered; framing errors raise (and poison the reader — drop the
+    connection)."""
+
+    def __init__(self, max_frame_bytes: int = DEFAULT_MAX_FRAME):
+        self.max_frame_bytes = int(max_frame_bytes)
+        self._buf = bytearray()
+
+    def feed(self, data: bytes) -> List[Tuple[Dict[str, Any], bytes]]:
+        self._buf += data
+        out: List[Tuple[Dict[str, Any], bytes]] = []
+        while True:
+            if len(self._buf) < PREFIX_BYTES:
+                return out
+            header_len, blob_len = _check_prefix(
+                bytes(self._buf[:PREFIX_BYTES]), self.max_frame_bytes)
+            need = PREFIX_BYTES + header_len + blob_len + TRAILER_BYTES
+            if len(self._buf) < need:
+                return out
+            body = self._buf[PREFIX_BYTES:need - TRAILER_BYTES]
+            (crc,) = _TRAILER.unpack(
+                bytes(self._buf[need - TRAILER_BYTES:need]))
+            out.append(_decode_body(bytes(body), header_len, crc))
+            del self._buf[:need]
+
+    def pending_bytes(self) -> int:
+        return len(self._buf)
+
+
+def recv_exact(sock, n: int) -> Optional[bytes]:
+    """Read exactly ``n`` bytes from a blocking socket.  None on clean EOF
+    with ZERO bytes read; `FrameTruncated` on EOF mid-read (torn frame)."""
+    chunks: List[bytes] = []
+    got = 0
+    while got < n:
+        chunk = sock.recv(min(n - got, 1 << 16))
+        if not chunk:
+            if got == 0:
+                return None
+            raise FrameTruncated(
+                f"stream ended {n - got} bytes short mid-frame (peer died "
+                "with a frame half-sent)")
+        chunks.append(chunk)
+        got += len(chunk)
+    return b"".join(chunks)
+
+
+def recv_frame(sock, max_frame_bytes: int = DEFAULT_MAX_FRAME
+               ) -> Optional[Tuple[Dict[str, Any], bytes]]:
+    """Blocking read of one frame; None on clean EOF at a frame boundary."""
+    prefix = recv_exact(sock, PREFIX_BYTES)
+    if prefix is None:
+        return None
+    header_len, blob_len = _check_prefix(prefix, max_frame_bytes)
+    body = recv_exact(sock, header_len + blob_len + TRAILER_BYTES)
+    if body is None:
+        raise FrameTruncated("stream ended after the frame prefix")
+    (crc,) = _TRAILER.unpack(body[-TRAILER_BYTES:])
+    return _decode_body(body[:-TRAILER_BYTES], header_len, crc)
+
+
+def send_frame(sock, header: Dict[str, Any], blob: bytes = b"") -> int:
+    """sendall one frame; returns the bytes written (caller serialises
+    concurrent writers with its own per-connection lock)."""
+    data = encode_frame(header, blob)
+    sock.sendall(data)
+    return len(data)
+
+
+# ------------------------------------------------------------ ndarray codec
+def encode_ndarray(arr: np.ndarray) -> Tuple[Dict[str, Any], bytes]:
+    """(meta fields, raw bytes) for one array — meta rides the frame header
+    (spread into it by the caller), bytes ride the blob."""
+    arr = np.ascontiguousarray(arr)
+    return {"dtype": str(arr.dtype), "shape": list(arr.shape)}, arr.tobytes()
+
+
+def decode_ndarray(meta: Dict[str, Any], blob: bytes) -> np.ndarray:
+    """Inverse of `encode_ndarray`.  The returned array VIEWS the blob
+    (read-only); callers that mutate must copy."""
+    dtype = np.dtype(str(meta["dtype"]))
+    shape = tuple(int(d) for d in meta["shape"])
+    expect = int(np.prod(shape, dtype=np.int64)) * dtype.itemsize
+    if len(blob) != expect:
+        raise FrameCorrupt(
+            f"ndarray blob is {len(blob)} bytes, meta declares {expect} "
+            f"(dtype={dtype}, shape={shape})")
+    return np.frombuffer(blob, dtype=dtype).reshape(shape)
+
+
+# ----------------------------------------------------------- blob sequences
+def pack_blobs(blobs: List[bytes]) -> bytes:
+    """Concatenate N binary payloads with u32 length prefixes (a packet
+    chain in one frame)."""
+    out = bytearray()
+    for blob in blobs:
+        out += struct.pack(">I", len(blob))
+        out += blob
+    return bytes(out)
+
+
+def unpack_blobs(data: bytes) -> List[bytes]:
+    out: List[bytes] = []
+    off = 0
+    while off < len(data):
+        if off + 4 > len(data):
+            raise FrameCorrupt("blob sequence truncated in a length prefix")
+        (n,) = struct.unpack_from(">I", data, off)
+        off += 4
+        if off + n > len(data):
+            raise FrameCorrupt(
+                f"blob sequence declares {n} bytes, only "
+                f"{len(data) - off} remain")
+        out.append(data[off:off + n])
+        off += n
+    return out

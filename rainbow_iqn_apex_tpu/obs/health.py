@@ -147,6 +147,21 @@ class RunHealth:
                     self.fault_counts["net_flap"] += 1
                     self._win_faults["net_flap"] += 1
                 self.registry.counter("net_flaps_total", "health").inc()
+        elif kind == "replay_net":
+            # cross-host replay plane flaps (replay/net/): a disconnect /
+            # reconnect / probe timeout / torn frame means replay capacity
+            # came or went (the learner re-routes to survivors), and a
+            # spool shed means actor experience is being DROPPED — both are
+            # things a human should know about, so a flap storm holds the
+            # run degraded window after window like the serving plane's
+            event = row.get("event")
+            if event in ("disconnect", "reconnect", "probe_timeout",
+                         "bad_frame", "spool_shed", "peer_dead"):
+                with self._lock:
+                    self.fault_counts["replay_net_flap"] += 1
+                    self._win_faults["replay_net_flap"] += 1
+                self.registry.counter(
+                    "replay_net_flaps_total", "health").inc()
         elif kind == "gossip":
             # federation visibility only: stale peers skew dispatch but the
             # router stays correct (its own view is authoritative), so the

@@ -76,6 +76,15 @@ REQUIRED_KEYS: Dict[str, frozenset] = {
     # rtt_ms/reconnects/bytes_sent/bytes_recv — obs_report's `net:` input.
     # RunHealth folds the flap events as window-degraded: a reconnect storm
     # is capacity silently coming and going)
+    # cross-host replay plane rows (replay/net/; docs/RESILIENCE.md):
+    "replay_net": frozenset({"event"}),  # replay transport lifecycle +
+    # stats (event: connect/disconnect/reconnect/probe_timeout/bad_frame/
+    # spool_shed/peer_discovered/peer_dead/peer_readmit/stale_lease_ignored/
+    # snapshot/snapshot_failed/restored/restore_failed carry `peer`/`server`;
+    # event "stats" is the periodic plane snapshot with peers/dead_peers/
+    # size/rtt_ms/spool_depth/acked_rows/shed_ticks/fenced_rows/batches/
+    # updates_sent — obs_report's `replaynet:` input.  RunHealth folds the
+    # flap + shed events as window-degraded, same story as `net`)
     "gossip": frozenset({"peers"}),  # router-federation health: declared
     # peers vs fresh/stale snapshot counts + sent/received/bad_frames —
     # a federated router whose peers all read stale is dispatching blind

@@ -814,6 +814,54 @@ def train_apex(cfg: Config, max_frames: Optional[int] = None) -> Dict[str, Any]:
                 memory, registry=obs_run.registry, seed=cfg.seed + 31
             )
 
+    # cross-host replay plane (replay/net/): appends, samples and priority
+    # write-backs ride the framed-socket transport to disaggregated replay
+    # shard servers discovered via leases (docs/RESILIENCE.md).  Default-
+    # off; every composition hazard declines with a reasoned notice and
+    # keeps the in-process path bitwise intact.
+    rplane = None
+    if cfg.replay_net_remote:
+        if multihost:
+            # per-host lane->shard pinning across a pod is a follow-up; an
+            # SPMD pod must not diverge on a per-host capability, so every
+            # host falls back together
+            metrics.log("notice", event="replay_net_fallback",
+                        reason="multihost: in-process replay retained")
+        elif member is not None:
+            metrics.log(
+                "notice", event="replay_net_fallback",
+                reason="league member: in-process replay retained (a "
+                       "mid-run n-step adoption mutates the window "
+                       "geometry the remote shards were built with)")
+        elif spec is not None:
+            # game-major shard blocks pin to servers structurally (a
+            # server owns shard_base..+shards, which ARE game blocks),
+            # but the learner-side game-quota interleave is a host draw
+            # the wire client doesn't reproduce yet
+            metrics.log(
+                "notice", event="replay_net_fallback",
+                reason="multitask: in-process replay retained (wire "
+                       "game-quota interleave is a follow-up)")
+        elif frontier is not None:
+            metrics.log(
+                "notice", event="replay_net_fallback",
+                reason="device_sampling: the HBM priority mirror needs "
+                       "the in-process shard trees")
+        elif cfg.serve_quantize != "off":
+            metrics.log(
+                "notice", event="replay_net_fallback",
+                reason="serve_quantize: calibration samples the local "
+                       "memory, which stays empty under a remote plane")
+        else:
+            from rainbow_iqn_apex_tpu.replay.net.plane import (
+                RemoteReplayPlane,
+            )
+
+            rplane = RemoteReplayPlane.from_config(
+                cfg, lanes, metrics=metrics,
+                obs_registry=obs_run.registry,
+            )
+
     frames = 0
     last_pub = 0
     restored = maybe_resume(cfg, ckpt, driver.state)
@@ -822,7 +870,10 @@ def train_apex(cfg: Config, max_frames: Optional[int] = None) -> Dict[str, Any]:
         driver.load_state(state, extra)
         frames = int(extra.get("frames", 0))
         last_pub = driver.step
-        maybe_restore_replay(cfg, memory)
+        if rplane is None:
+            maybe_restore_replay(cfg, memory)
+        # (remote plane: shard servers restore their own snapshots at
+        # spawn, fenced by the learner's checkpoint step — nothing local)
         metrics.log("resume", step=driver.step, frames=frames)
 
     estimator = (
@@ -850,7 +901,12 @@ def train_apex(cfg: Config, max_frames: Optional[int] = None) -> Dict[str, Any]:
         materialize_priorities=frontier is None,
         tracer=ptrace,
     )
-    if frontier is not None and spec is not None:
+    if rplane is not None:
+        # wire write-back: the ring's retired |TD| rows route to shard
+        # servers as batched update frames keyed by GLOBAL slot id — the
+        # same id space memory.update_priorities routes on in-process
+        _update_target = rplane.update_priorities
+    elif frontier is not None and spec is not None:
         # device sampling bypasses memory.update_priorities (the |TD| stays
         # a device array retiring into the HBM mirror), so the per-game
         # learn-share counters the `games` row reports are fed from the
@@ -867,7 +923,13 @@ def train_apex(cfg: Config, max_frames: Optional[int] = None) -> Dict[str, Any]:
         _update_target,
         sup,
         driver.load_snapshot,
-        on_drain=frontier.reconcile if frontier is not None else None,
+        on_drain=(
+            frontier.reconcile if frontier is not None
+            # drain boundary doubles as write-back flush: every in-flight
+            # update frame is acked before a snapshot/publish proceeds
+            else rplane.flush_writebacks if rplane is not None
+            else None
+        ),
     )
     last_scalars = committer.scalars  # newest RETIRED step's host scalars
     _commit, _drain = committer.commit, committer.drain
@@ -899,6 +961,13 @@ def train_apex(cfg: Config, max_frames: Optional[int] = None) -> Dict[str, Any]:
         lanes, env.frame_shape, cfg.history_length
     )
     prev_cuts = np.zeros(lanes, bool)
+    # append seam: one callable serves the pipelined and straight paths —
+    # the remote plane spools lane blocks to shard servers, the local path
+    # appends in-process.  With the plane active memory.append_ticks stays
+    # 0, so actor trace tick ids degenerate to a constant: wire appends
+    # are not causally traced yet (accepted; the learn-side links degrade
+    # to unlinked spans, nothing breaks).
+    _append = memory.append_batch if rplane is None else rplane.append_batch
     pending = None  # pipelined: device (actions, q) dispatched last tick
     held = None  # pipelined: completed transition awaiting its Q for append
     try:
@@ -947,7 +1016,7 @@ def train_apex(cfg: Config, max_frames: Optional[int] = None) -> Dict[str, Any]:
                     # (one append per tick), so tick_tid is its id — the
                     # trace carries the pipeline's own one-tick lag
                     with ptrace.span("append", tick_tid):
-                        memory.append_batch(
+                        _append(
                             h_obs, h_act, h_rew, h_term, pri, truncations=h_trunc
                         )
                 held = (obs, actions, rewards, terminals, truncs, nxt[1])
@@ -955,7 +1024,7 @@ def train_apex(cfg: Config, max_frames: Optional[int] = None) -> Dict[str, Any]:
             else:
                 pri = estimator.push(q, actions, rewards, cuts) if estimator else None
                 with ptrace.span("append", tick_tid):
-                    memory.append_batch(obs, actions, rewards, terminals, pri, truncations=truncs)
+                    _append(obs, actions, rewards, terminals, pri, truncations=truncs)
             if not use_dstack:
                 stacker.reset_lanes(cuts)
             prev_cuts = cuts
@@ -964,11 +1033,17 @@ def train_apex(cfg: Config, max_frames: Optional[int] = None) -> Dict[str, Any]:
             for r in ep_returns[~np.isnan(ep_returns)]:
                 returns.append(float(r))
 
-            warm = (
-                frames - frames_at_start >= cfg.learn_start
-                if multihost
-                else len(memory) >= learn_start and memory.sampleable
-            )
+            if rplane is not None:
+                # remote warm-up: the servers' aggregate size/sampleable
+                # ride the piggyback state on every reply — no extra RPC
+                warm = (rplane.size() >= learn_start
+                        and rplane.sampleable())
+            else:
+                warm = (
+                    frames - frames_at_start >= cfg.learn_start
+                    if multihost
+                    else len(memory) >= learn_start and memory.sampleable
+                )
             if warm:
                 if driver.wants_calibration():
                     # calibration from replay observation statistics: one
@@ -982,7 +1057,17 @@ def train_apex(cfg: Config, max_frames: Optional[int] = None) -> Dict[str, Any]:
                     )
                     driver.set_calibration(
                         calib.obs, game=getattr(calib, "game", None))
-                if frontier is not None and prefetcher is None:
+                if rplane is not None and prefetcher is None:
+                    # wire sample-ahead: the SampleClient already keeps
+                    # `sample_ahead_depth` requests in flight; the shim
+                    # only overlaps decode + device_put with the dispatch
+                    prefetcher = rplane.make_prefetcher(
+                        local_batch,
+                        lambda: priority_beta(cfg, frames),
+                        to_device_batch,
+                        registry=obs_run.registry,
+                    )
+                elif frontier is not None and prefetcher is None:
                     # sample-ahead pusher: device-drawn index blocks,
                     # host-DRAM frame gather, staged device batches PUSHED
                     # into the bounded queue — the learner only pops
@@ -1187,7 +1272,10 @@ def train_apex(cfg: Config, max_frames: Optional[int] = None) -> Dict[str, Any]:
                         obs_run.periodic(
                             step,
                             frames,
-                            replay_size=len(memory),
+                            replay_size=(
+                                rplane.size() if rplane is not None
+                                else len(memory)
+                            ),
                             # survivors-aware occupancy maintained by
                             # ShardedReplay._observe on this same registry —
                             # recomputing it here would double-count dead
@@ -1260,6 +1348,11 @@ def train_apex(cfg: Config, max_frames: Optional[int] = None) -> Dict[str, Any]:
                                     epoch=lease.epoch, step=step,
                                     frames=frames,
                                 )
+                        if rplane is not None:
+                            # replay-plane lifecycle: lease edges map to
+                            # drop/readmit on the sampler, plus the
+                            # periodic `replay_net` stats row
+                            rplane.poll(step)
                     if cadence_hit(step, cfg.eval_interval, reuse_k):
                         # the drain runs on EVERY host (the cadence is a
                         # function of the lockstep step counter) so a
@@ -1289,13 +1382,20 @@ def train_apex(cfg: Config, max_frames: Optional[int] = None) -> Dict[str, Any]:
                             {"frames": frames, "weights_version": driver.weights_version,
                              **rng_extra(driver.key)},
                         )
-                        sup.save_replay(cfg, memory)  # per-host shard
+                        if rplane is None:
+                            sup.save_replay(cfg, memory)  # per-host shard
+                        else:
+                            # server-side snapshots, fenced by this step so
+                            # a rewound learner can't re-trigger older ones
+                            rplane.request_snapshot(step)
         # end of run: the still-in-flight tail retires (write-back + guard)
         # before the final eval/checkpoint read the state
         _drain()
     finally:
         if prefetcher is not None:
             prefetcher.close()
+        if rplane is not None:
+            rplane.close()
         sup.close()
         obs_run.close(driver.step, frames)
         if heartbeat is not None:
@@ -1319,7 +1419,10 @@ def train_apex(cfg: Config, max_frames: Optional[int] = None) -> Dict[str, Any]:
         # the final drain may have been skipped by a rollback: catch the
         # cold-path trees up before they are persisted
         frontier.reconcile()
-    sup.save_replay(cfg, memory, critical=True)
+    if rplane is None:
+        sup.save_replay(cfg, memory, critical=True)
+    else:
+        rplane.request_snapshot(driver.step)
     ckpt.wait()
     metrics.close()
     return {

@@ -1,13 +1,49 @@
-from rainbow_iqn_apex_tpu.replay.buffer import PrioritizedReplay, SampledBatch
-from rainbow_iqn_apex_tpu.replay.frontier import DeviceSampleFrontier
-from rainbow_iqn_apex_tpu.replay.native import NativeSumTree, native_available
-from rainbow_iqn_apex_tpu.replay.sumtree import SumTree
+"""Prioritized replay: host structures, the device sample frontier, and
+the cross-host replay plane (replay/net/).
 
-__all__ = [
-    "PrioritizedReplay",
-    "SampledBatch",
-    "SumTree",
-    "NativeSumTree",
-    "native_available",
-    "DeviceSampleFrontier",
-]
+Exports resolve lazily (PEP 562, the parallel/ pattern): `frontier` is
+jax-facing, and eagerly importing it here would taint every jax-free
+consumer of the host-side structures — replay/net's shard servers and
+actor spoolers import `replay.buffer` from processes with no device
+runtime at all (analysis/imports.py declares the contract)."""
+
+from typing import TYPE_CHECKING
+
+_EXPORTS = {
+    "PrioritizedReplay": "rainbow_iqn_apex_tpu.replay.buffer",
+    "SampledBatch": "rainbow_iqn_apex_tpu.replay.buffer",
+    "SumTree": "rainbow_iqn_apex_tpu.replay.sumtree",
+    "NativeSumTree": "rainbow_iqn_apex_tpu.replay.native",
+    "native_available": "rainbow_iqn_apex_tpu.replay.native",
+    "DeviceSampleFrontier": "rainbow_iqn_apex_tpu.replay.frontier",
+}
+
+__all__ = sorted(_EXPORTS)
+
+
+def __getattr__(name: str):
+    module = _EXPORTS.get(name)
+    if module is None:
+        raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
+    import importlib
+
+    return getattr(importlib.import_module(module), name)
+
+
+def __dir__():
+    return __all__
+
+
+if TYPE_CHECKING:  # static analyzers see the eager imports
+    from rainbow_iqn_apex_tpu.replay.buffer import (  # noqa: F401
+        PrioritizedReplay,
+        SampledBatch,
+    )
+    from rainbow_iqn_apex_tpu.replay.frontier import (  # noqa: F401
+        DeviceSampleFrontier,
+    )
+    from rainbow_iqn_apex_tpu.replay.native import (  # noqa: F401
+        NativeSumTree,
+        native_available,
+    )
+    from rainbow_iqn_apex_tpu.replay.sumtree import SumTree  # noqa: F401

@@ -1,0 +1,71 @@
+"""replay/net: the cross-host replay plane (disaggregated Ape-X replay).
+
+Shard servers (`ReplayShardServer`) own blocks of the global prioritized
+replay and speak the netcore frame protocol; actors feed them through
+`AppendClient` spoolers, the learner drains assembled batches through
+`SampleClient` pipelines, and `RemoteReplayPlane` wires discovery + the
+drop/readmit failure lifecycle into parallel/apex.py behind the
+all-default-off ``replay_net_*`` config.
+
+Exports resolve lazily (PEP 562): every module here is jax-free, but the
+house rule keeps package ``__init__``s import-cheap regardless.
+"""
+
+from typing import TYPE_CHECKING
+
+_EXPORTS = {
+    "protocol": "rainbow_iqn_apex_tpu.replay.net",
+    "client": "rainbow_iqn_apex_tpu.replay.net",
+    "server": "rainbow_iqn_apex_tpu.replay.net",
+    "plane": "rainbow_iqn_apex_tpu.replay.net",
+    "ReplayNetError": "rainbow_iqn_apex_tpu.replay.net.protocol",
+    "PeerDead": "rainbow_iqn_apex_tpu.replay.net.protocol",
+    "ReplayShardServer": "rainbow_iqn_apex_tpu.replay.net.server",
+    "ReplayPeer": "rainbow_iqn_apex_tpu.replay.net.client",
+    "AppendClient": "rainbow_iqn_apex_tpu.replay.net.client",
+    "SampleClient": "rainbow_iqn_apex_tpu.replay.net.client",
+    "RemoteReplayPlane": "rainbow_iqn_apex_tpu.replay.net.plane",
+}
+
+__all__ = sorted(_EXPORTS)
+
+_SUBMODULES = ("protocol", "client", "server", "plane")
+
+
+def __getattr__(name: str):
+    module = _EXPORTS.get(name)
+    if module is None:
+        raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
+    import importlib
+
+    if name in _SUBMODULES:
+        return importlib.import_module(f"{module}.{name}")
+    return getattr(importlib.import_module(module), name)
+
+
+def __dir__():
+    return __all__
+
+
+if TYPE_CHECKING:  # static analyzers see the eager imports
+    from rainbow_iqn_apex_tpu.replay.net import (  # noqa: F401
+        client,
+        plane,
+        protocol,
+        server,
+    )
+    from rainbow_iqn_apex_tpu.replay.net.client import (  # noqa: F401
+        AppendClient,
+        ReplayPeer,
+        SampleClient,
+    )
+    from rainbow_iqn_apex_tpu.replay.net.plane import (  # noqa: F401
+        RemoteReplayPlane,
+    )
+    from rainbow_iqn_apex_tpu.replay.net.protocol import (  # noqa: F401
+        PeerDead,
+        ReplayNetError,
+    )
+    from rainbow_iqn_apex_tpu.replay.net.server import (  # noqa: F401
+        ReplayShardServer,
+    )

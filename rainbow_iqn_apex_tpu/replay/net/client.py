@@ -1,0 +1,826 @@
+"""Client half of the cross-host replay plane.
+
+Three layers, mirroring the serving plane's client (serving/net/client.py):
+
+- `ReplayPeer` — one TCP connection to one shard server, demultiplexed by a
+  reader thread: requests are settled by rid, connection loss fails every
+  in-flight request fast with `PeerDead`, re-dials ride the shared
+  `RetryPolicy` backoff, and every reply's piggyback state (size/mass/
+  epoch/shard range) is folded into cheap attributes the callers rank on.
+- `AppendClient` — the actor side: ``append()`` never blocks the env loop
+  (it spools the tick locally and returns), a worker thread coalesces
+  spooled ticks into batched CRC-framed append blocks and ships them with
+  bounded in-flight; a FULL spool sheds the newest tick with a reasoned,
+  rate-limited row (backpressure never wedges acting — the serving plane's
+  shed story, append edition).  Blocks refused by the server's epoch fence
+  are DROPPED (a stale incarnation's spool must not resurrect priorities);
+  blocks that died in flight re-spool and re-ship after reconnect, so an
+  acked row is never lost and an unacked one is never silently dropped
+  while the server lives.
+- `SampleClient` — the learner side: pipelines ``depth`` sample requests
+  over the wire (``sample_ahead_depth``), hands back assembled host batches
+  + global indices, routes batched priority write-backs to the owning peer
+  by global slot range, and exposes ``flush()`` for the `WritebackRing`
+  drain boundary.  A dead peer's in-flight requests re-route to survivors
+  (survivors-only sampling); ``drop_peer``/``readmit_peer`` are the wire
+  twins of ``ShardedReplay.drop_shard``/``readmit_shard``.
+
+jax-free: the actor spool runs in processes with no device runtime, and the
+learner-side gathers are plain numpy under ``hostsync`` discipline
+(analysis/hostsync_lint.py declares the hot path).
+"""
+
+from __future__ import annotations
+
+import collections
+import socket
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from rainbow_iqn_apex_tpu.netcore import framing
+from rainbow_iqn_apex_tpu.replay.buffer import SampledBatch
+from rainbow_iqn_apex_tpu.replay.net import protocol
+from rainbow_iqn_apex_tpu.replay.net.protocol import PeerDead
+from rainbow_iqn_apex_tpu.utils import hostsync
+from rainbow_iqn_apex_tpu.utils.faults import RetryPolicy
+
+
+class _Pending:
+    """One in-flight request: settled by the reader thread with the reply
+    (header, blob) or an error."""
+
+    __slots__ = ("event", "header", "blob", "error")
+
+    def __init__(self):
+        self.event = threading.Event()
+        self.header: Optional[Dict[str, Any]] = None
+        self.blob: bytes = b""
+        self.error: Optional[BaseException] = None
+
+
+class ReplayPeer:
+    """One connection to one replay shard server.
+
+    The piggyback attributes (``size``/``sampleable``/``mass``/``epoch``/
+    ``shard_base``/``shards``/``capacity``) refresh on every reply frame, so
+    ranking and routing across N peers costs zero dedicated RPCs; ``epoch``
+    is what append/update frames must stamp to pass the server's fence.
+    """
+
+    def __init__(self, host: str, port: int, peer_id: Optional[int] = None,
+                 retry: Optional[RetryPolicy] = None,
+                 probe_timeout_s: float = 0.5,
+                 ack_timeout_s: float = 10.0,
+                 max_frame_bytes: int = framing.DEFAULT_MAX_FRAME,
+                 logger=None, obs_registry=None, connect: bool = True):
+        self.host = str(host)
+        self.port = int(port)
+        self.peer_id = peer_id
+        self.peer = f"{self.host}:{self.port}"
+        self.retry = retry if retry is not None else RetryPolicy(
+            attempts=6, base_delay_s=0.2, max_delay_s=5.0)
+        self.probe_timeout_s = float(probe_timeout_s)
+        self.ack_timeout_s = float(ack_timeout_s)
+        self.max_frame_bytes = int(max_frame_bytes)
+        self.logger = logger
+        self.obs_registry = obs_registry
+        # piggyback state: unknown until the first reply teaches us
+        self.size = 0
+        self.sampleable = False
+        self.mass = 0.0
+        self.epoch: Optional[int] = None
+        self.shard_base = 0
+        self.shards = 0
+        self.capacity = 0
+        # counters (the plane's periodic `replay_net` stats row)
+        self.bytes_sent = 0
+        self.bytes_recv = 0
+        self.reconnects = 0
+        self.probe_timeouts = 0
+        self.rtt_ms: Optional[float] = None
+        self._lock = threading.Lock()  # socket lifecycle + pending map
+        self._wlock = threading.Lock()  # serialises frame writes
+        self._sock: Optional[socket.socket] = None
+        self._gen = 0  # connection generation (reader threads self-retire)
+        self._rid = 0
+        self._pending: Dict[int, _Pending] = {}
+        self._ever_connected = False
+        self._closed = False
+        # backoff state: the shared RetryPolicy schedule, clamped at its
+        # ceiling — a dead server is retried forever at the ceiling;
+        # eviction is the PLANE's call via the lease, not the socket's
+        self._delays = list(self.retry.delays()) or [self.retry.base_delay_s]
+        self._fail_streak = 0
+        self._next_dial = 0.0
+        if connect:
+            self.connect()
+
+    # ---------------------------------------------------------- connection
+    def _log(self, event: str, **fields: Any) -> None:
+        if self.logger is not None:
+            try:
+                self.logger.log("replay_net", event=event, peer=self.peer,
+                                server=self.peer_id, **fields)
+            except Exception:
+                pass  # telemetry must never break the transport
+
+    def _count(self, name: str, n: int = 1) -> None:
+        if self.obs_registry is not None:
+            self.obs_registry.counter(name, "replay_net").inc(n)
+
+    def connect(self, timeout_s: Optional[float] = None) -> bool:
+        """One bounded dial attempt; True when a connection is live."""
+        with self._lock:
+            if self._closed:
+                return False
+            if self._sock is not None:
+                return True
+        try:
+            sock = socket.create_connection(
+                (self.host, self.port),
+                timeout=self.probe_timeout_s if timeout_s is None
+                else timeout_s)
+            sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            sock.settimeout(None)  # reader blocks; writes are sendall
+        except OSError:
+            with self._lock:
+                self._fail_streak += 1
+                delay = self._delays[
+                    min(self._fail_streak - 1, len(self._delays) - 1)]
+                self._next_dial = time.monotonic() + delay
+            return False
+        with self._lock:
+            if self._closed:
+                sock.close()
+                return False
+            self._sock = sock
+            self._gen += 1
+            gen = self._gen
+            self._fail_streak = 0
+            reconnected = self._ever_connected
+            self._ever_connected = True
+            if reconnected:
+                self.reconnects += 1
+        threading.Thread(
+            target=self._read_loop, args=(sock, gen),
+            name=f"replaynet-client-{self.peer}", daemon=True).start()
+        self._log("reconnect" if reconnected else "connect")
+        if reconnected:
+            self._count("replaynet_reconnects_total")
+        return True
+
+    def _ensure_connected(self) -> bool:
+        """Connected, or one dial attempt if the backoff schedule is due."""
+        with self._lock:
+            if self._sock is not None:
+                return True
+            if self._closed or time.monotonic() < self._next_dial:
+                return False
+        return self.connect()
+
+    def connected(self) -> bool:
+        with self._lock:
+            return self._sock is not None
+
+    def alive(self) -> bool:
+        if self._closed:
+            return False
+        return self._ensure_connected()
+
+    def _drop(self, sock: socket.socket, gen: int, why: str) -> None:
+        """Tear the connection down once; fail every in-flight request."""
+        with self._lock:
+            if gen != self._gen or self._sock is not sock:
+                return  # an older generation already replaced
+            self._sock = None
+            pending, self._pending = self._pending, {}
+            self._next_dial = time.monotonic()  # first re-dial is immediate
+        try:
+            sock.close()
+        except OSError:
+            pass
+        err = PeerDead(f"connection to replay server {self.peer} "
+                       f"lost ({why})")
+        for p in pending.values():
+            p.error = err
+            p.event.set()
+        if not self._closed:
+            self._log("disconnect", why=why, inflight=len(pending))
+            self._count("replaynet_disconnects_total")
+
+    def close(self) -> None:
+        with self._lock:
+            self._closed = True
+            sock, gen = self._sock, self._gen
+        if sock is not None:
+            self._drop(sock, gen, "closed")
+
+    # ---------------------------------------------------------- frame I/O
+    def _send(self, sock: socket.socket, gen: int,
+              header: Dict[str, Any], blob: bytes = b"") -> None:
+        try:
+            with self._wlock:
+                self.bytes_sent += framing.send_frame(sock, header, blob)
+        except OSError as e:
+            self._drop(sock, gen, f"send failed: {e}")
+            raise PeerDead(
+                f"replay server {self.peer} unreachable mid-send: "
+                f"{e}") from e
+
+    def _read_loop(self, sock: socket.socket, gen: int) -> None:
+        while True:
+            try:
+                frame = framing.recv_frame(sock, self.max_frame_bytes)
+            except (OSError, framing.FrameError) as e:
+                self._drop(sock, gen, f"{type(e).__name__}: {e}")
+                return
+            if frame is None:
+                self._drop(sock, gen, "peer closed")
+                return
+            header, blob = frame
+            self.bytes_recv += (framing.PREFIX_BYTES + framing.TRAILER_BYTES
+                                + len(blob) + 64)  # header ~estimated
+            try:
+                self._on_frame(header, blob)
+            except Exception:
+                pass  # one malformed-but-framed reply must not kill the link
+
+    def _refresh(self, header: Dict[str, Any]) -> None:
+        """Fold the state every server reply piggybacks."""
+        if "size" in header:
+            self.size = int(header["size"])
+        if "sampleable" in header:
+            self.sampleable = bool(header["sampleable"])
+        if "mass" in header:
+            self.mass = float(header["mass"])
+        if "epoch" in header:
+            self.epoch = int(header["epoch"])
+        if "shard_base" in header:
+            self.shard_base = int(header["shard_base"])
+        if "shards" in header:
+            self.shards = int(header["shards"])
+        if "capacity" in header:
+            self.capacity = int(header["capacity"])
+
+    def slot_range(self) -> Tuple[int, int]:
+        """The GLOBAL slot-id interval this peer's shard block owns (for
+        write-back routing).  (0, 0) until the first reply taught us."""
+        lo = self.shard_base * self.capacity
+        return lo, lo + self.shards * self.capacity
+
+    def _on_frame(self, header: Dict[str, Any], blob: bytes) -> None:
+        self._refresh(header)
+        rid = header.get("rid")
+        p = self._pending.pop(rid, None) if rid is not None else None
+        if p is None:
+            return
+        if header.get("op") == "rerr":
+            p.error = protocol.wire_error(header.get("etype", ""),
+                                          header.get("msg", "server error"))
+        else:
+            p.header, p.blob = header, blob
+        p.event.set()
+
+    # ------------------------------------------------------------- requests
+    def start_request(self, header: Dict[str, Any],
+                      blob: bytes = b"") -> _Pending:
+        """Send one request; the returned pending settles with the reply (or
+        `PeerDead` the moment the connection dies)."""
+        if not self._ensure_connected():
+            raise PeerDead(f"replay server {self.peer} unreachable")
+        p = _Pending()
+        with self._lock:
+            if self._sock is None:
+                raise PeerDead(f"no connection to replay server {self.peer}")
+            sock, gen = self._sock, self._gen
+            rid = self._rid = self._rid + 1
+            self._pending[rid] = p
+        self._send(sock, gen, {**header, "rid": rid}, blob)
+        return p
+
+    def wait(self, p: _Pending, timeout_s: Optional[float] = None
+             ) -> Tuple[Dict[str, Any], bytes]:
+        """Block until ``p`` settles; returns (header, blob) or raises the
+        mapped wire error / TimeoutError."""
+        budget = self.ack_timeout_s if timeout_s is None else timeout_s
+        if not p.event.wait(budget):
+            raise TimeoutError(
+                f"replay server {self.peer} did not answer within "
+                f"{budget}s (hung or dying)")
+        if p.error is not None:
+            raise p.error
+        assert p.header is not None
+        return p.header, p.blob
+
+    def request(self, header: Dict[str, Any], blob: bytes = b"",
+                timeout_s: Optional[float] = None
+                ) -> Tuple[Dict[str, Any], bytes]:
+        """One synchronous RPC."""
+        return self.wait(self.start_request(header, blob), timeout_s)
+
+    def probe(self, timeout_s: Optional[float] = None) -> Optional[float]:
+        """Bounded liveness probe: ping -> rtt_ms, refreshing the cached
+        piggyback state.  None on timeout or a dead link — never blocks
+        past the bound."""
+        budget = self.probe_timeout_s if timeout_s is None else timeout_s
+        t0 = time.monotonic()
+        try:
+            self.request({"op": "ping"}, timeout_s=budget)
+        except TimeoutError:
+            # connected but not answering: a WEDGED server — distinct from
+            # unreachable (whose disconnect row tells that story already)
+            self.probe_timeouts += 1
+            self._log("probe_timeout", budget_s=budget)
+            self._count("replaynet_probe_timeouts_total")
+            return None
+        except PeerDead:
+            return None
+        self.rtt_ms = round((time.monotonic() - t0) * 1e3, 3)
+        return self.rtt_ms
+
+    def stats(self) -> Dict[str, Any]:
+        return {"peer": self.peer, "server": self.peer_id,
+                "connected": self.connected(), "rtt_ms": self.rtt_ms,
+                "reconnects": self.reconnects,
+                "probe_timeouts": self.probe_timeouts,
+                "bytes_sent": self.bytes_sent,
+                "bytes_recv": self.bytes_recv}
+
+    @classmethod
+    def from_lease(cls, lease, **kwargs: Any) -> "ReplayPeer":
+        """Build from a ``replay_shard`` lease advertising addr:port
+        (grown by ``ReplayShardServer.attach_lease``)."""
+        if not lease.addr or not lease.port:
+            raise ValueError(
+                f"lease for host {lease.host} carries no addr:port "
+                "(not serving replay over the net)")
+        return cls(lease.addr, lease.port, peer_id=lease.host, **kwargs)
+
+
+class AppendClient:
+    """Actor-side spooler: ``append()`` is non-blocking (env loops never
+    wait on the wire), a worker thread ships coalesced epoch-stamped append
+    blocks with bounded in-flight, and a full spool sheds with a reasoned
+    row instead of backpressuring the actor into a stall."""
+
+    def __init__(self, peer: ReplayPeer, spool_ticks: int = 4096,
+                 inflight: int = 4, coalesce: int = 4,
+                 logger=None, obs_registry=None, own_peer: bool = True):
+        self.peer = peer
+        self.spool_ticks = max(int(spool_ticks), 1)
+        self.inflight = max(int(inflight), 1)
+        self.coalesce = max(int(coalesce), 1)
+        self.logger = logger
+        self.obs_registry = obs_registry
+        self._own_peer = own_peer
+        self._spool: "collections.deque" = collections.deque()
+        self._lock = threading.Lock()
+        self._wake = threading.Event()
+        self._stop = threading.Event()
+        # counters (the smoke's zero-loss bookkeeping + obs rows)
+        self.spooled_ticks = 0
+        self.acked_rows = 0
+        self.fenced_rows = 0
+        self.shed_ticks = 0
+        self._inflight = 0  # blocks shipped, ack outstanding (worker-owned)
+        self._last_shed_log = 0.0
+        self._thread = threading.Thread(
+            target=self._run, name=f"replaynet-append-{peer.peer}",
+            daemon=True)
+        self._thread.start()
+
+    # ------------------------------------------------------------ producer
+    def append(self, frames: np.ndarray, actions: np.ndarray,
+               rewards: np.ndarray, terminals: np.ndarray,
+               priorities: Optional[np.ndarray] = None,
+               truncations: Optional[np.ndarray] = None) -> bool:
+        """Spool one lockstep lane tick (the `ShardedReplay.append_batch`
+        row shape).  Returns False — and sheds the tick with a rate-limited
+        reasoned row — when the spool is full (server dead or slow past the
+        spool's buffering horizon); the actor keeps acting either way."""
+        with self._lock:
+            if len(self._spool) >= self.spool_ticks:
+                self.shed_ticks += 1
+                shed = self.shed_ticks
+            else:
+                # copy: callers reuse their staging buffers per tick
+                self._spool.append((
+                    np.array(frames, copy=True), np.array(actions, copy=True),
+                    np.array(rewards, copy=True),
+                    np.array(terminals, copy=True),
+                    None if priorities is None else np.array(priorities,
+                                                             copy=True),
+                    None if truncations is None else np.array(truncations,
+                                                              copy=True)))
+                self.spooled_ticks += 1
+                shed = None
+        if shed is None:
+            self._wake.set()
+            return True
+        if self.obs_registry is not None:
+            self.obs_registry.counter(
+                "replaynet_shed_ticks_total", "replay_net").inc()
+        now = time.monotonic()
+        if now - self._last_shed_log > 5.0 and self.logger is not None:
+            self._last_shed_log = now
+            try:
+                self.logger.log(
+                    "replay_net", event="spool_shed", peer=self.peer.peer,
+                    shed_ticks=shed, spool=self.spool_ticks,
+                    why="spool full: server unreachable or appends "
+                        "outpacing the wire; newest tick dropped so the "
+                        "actor keeps acting")
+            except Exception:
+                pass
+        return False
+
+    def spool_depth(self) -> int:
+        with self._lock:
+            return len(self._spool)
+
+    # ------------------------------------------------------------- shipper
+    def _take_block(self) -> Optional[List[tuple]]:
+        """Pop up to ``coalesce`` ticks sharing one optional-column
+        signature (priorities/truncations present-or-not must be uniform
+        inside a block)."""
+        with self._lock:
+            if not self._spool:
+                return None
+            block = [self._spool.popleft()]
+            sig = (block[0][4] is not None, block[0][5] is not None)
+            while (self._spool and len(block) < self.coalesce
+                   and (self._spool[0][4] is not None,
+                        self._spool[0][5] is not None) == sig):
+                block.append(self._spool.popleft())
+        return block
+
+    def _respool(self, block: List[tuple]) -> None:
+        """Put an unacked block back at the FRONT (ship-after-reconnect:
+        ring order inside the spool is preserved)."""
+        with self._lock:
+            for tick in reversed(block):
+                self._spool.appendleft(tick)
+
+    def _encode_block(self, block: List[tuple]
+                      ) -> Tuple[Dict[str, Any], bytes]:
+        arrays = {
+            "frames": np.stack([t[0] for t in block]),
+            "actions": np.stack([t[1] for t in block]),
+            "rewards": np.stack([t[2] for t in block]),
+            "terminals": np.stack([t[3] for t in block]),
+        }
+        if block[0][4] is not None:
+            arrays["priorities"] = np.stack([t[4] for t in block])
+        if block[0][5] is not None:
+            arrays["truncations"] = np.stack([t[5] for t in block])
+        metas, blob = protocol.encode_arrays(arrays)
+        header: Dict[str, Any] = {"op": "append", "ticks": len(block),
+                                  "arrays": metas}
+        if self.peer.epoch is not None:
+            # stamp the incarnation we believe owns the shard block; a
+            # respawned server fences this and the ack's piggyback teaches
+            # us the new epoch (the block is DROPPED by design — stale
+            # spool contents must not land on the revived incarnation)
+            header["epoch"] = self.peer.epoch
+        return header, blob
+
+    def _run(self) -> None:
+        # (_Pending, rows, block) — the block travels with its ack so a
+        # connection death can re-spool everything still unacked
+        pending: List[Tuple[Any, int, List[tuple]]] = []
+        while True:
+            # settle the oldest in-flight ack once the window is full, the
+            # spool is empty, or we are draining: bounded in-flight IS the
+            # backpressure
+            while pending and (len(pending) >= self.inflight
+                               or self._stop.is_set()
+                               or not self.spool_depth()):
+                p, rows, block = pending[0]
+                try:
+                    header, _ = self.peer.wait(p)
+                except (PeerDead, protocol.ReplayNetError, TimeoutError):
+                    # connection died with blocks in flight: re-spool ALL of
+                    # them, order preserved, and re-ship after reconnect.
+                    # At-least-once: an ack lost AFTER the server applied
+                    # the block re-ships as a duplicate tick (a replay ring
+                    # absorbs that); an acked row is never lost.
+                    for _p, _r, b in reversed(pending):
+                        self._respool(b)
+                    pending.clear()
+                    self._inflight = 0
+                    time.sleep(0.05)
+                    break
+                pending.pop(0)
+                self._inflight = len(pending)
+                if header.get("ok"):
+                    self.acked_rows += int(header.get("rows", rows))
+                elif header.get("fenced"):
+                    # refused by the epoch fence: the rows are DROPPED by
+                    # design (stale spool must not resurrect priorities on
+                    # the revived incarnation) — the piggyback already
+                    # refreshed peer.epoch, so the NEXT block ships live
+                    self.fenced_rows += rows
+                if not pending and not self.spool_depth():
+                    break
+            block = self._take_block()
+            if block is None:
+                if self._stop.is_set() and not pending:
+                    return
+                self._wake.wait(0.05)
+                self._wake.clear()
+                continue
+            rows = sum(int(t[1].shape[0]) for t in block)
+            header, blob = self._encode_block(block)
+            try:
+                pending.append(
+                    (self.peer.start_request(header, blob), rows, block))
+                self._inflight = len(pending)
+            except PeerDead:
+                # unreachable: re-spool and let the peer's backoff schedule
+                # pace the retries (shed, if it comes, happens at append())
+                self._respool(block)
+                time.sleep(0.05)
+
+    def flush(self, timeout_s: float = 30.0) -> bool:
+        """Wait for the spool AND the in-flight window to drain (smoke /
+        shutdown determinism).  True when fully drained in time."""
+        deadline = time.monotonic() + timeout_s
+        while time.monotonic() < deadline:
+            with self._lock:
+                empty = not self._spool
+            if empty and self._inflight == 0:
+                return True
+            time.sleep(0.02)
+        return False
+
+    def stats(self) -> Dict[str, Any]:
+        return {"spooled_ticks": self.spooled_ticks,
+                "acked_rows": self.acked_rows,
+                "fenced_rows": self.fenced_rows,
+                "shed_ticks": self.shed_ticks,
+                "spool_depth": self.spool_depth(),
+                **self.peer.stats()}
+
+    def close(self) -> None:
+        self._stop.set()
+        self._wake.set()
+        self._thread.join(timeout=10)
+        if self._own_peer:
+            self.peer.close()
+
+
+class SampleClient:
+    """Learner-side sampler: keeps ``depth`` sample requests in flight
+    across the alive peers (each peer drawn ∝ its advertised priority mass
+    — the proportional split `ShardedReplay.sample` computes in-process,
+    here at server granularity), decodes replies into host `SampledBatch`es
+    (GLOBAL indices), and routes priority write-backs to the owning peer."""
+
+    def __init__(self, peers: Dict[int, ReplayPeer], batch_size: int,
+                 beta_fn: Callable[[], float], depth: int = 2,
+                 wb_inflight: int = 4, seed: int = 0,
+                 logger=None, obs_registry=None):
+        self.peers = dict(peers)
+        self.batch_size = int(batch_size)
+        self.beta_fn = beta_fn
+        self.depth = max(int(depth), 1)
+        self.wb_inflight = max(int(wb_inflight), 1)
+        self.logger = logger
+        self.obs_registry = obs_registry
+        self.rng = np.random.default_rng(seed)
+        self._dead: set = set()
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self._ready: "collections.deque" = collections.deque()
+        self._ready_sem = threading.Semaphore(0)
+        self._space = threading.Semaphore(self.depth)
+        self._probe_unknown_at = 0.0  # next not-yet-sampleable peer probe
+        # write-back channel state (learner thread only)
+        self._wb_pending: List[Tuple[ReplayPeer, _Pending]] = []
+        # counters
+        self.batches_received = 0
+        self.rows_sampled = 0
+        self.updates_sent = 0
+        self.updates_dropped = 0
+        self.rerouted = 0
+        self._thread = threading.Thread(
+            target=self._run, name="replaynet-sample", daemon=True)
+        self._thread.start()
+
+    # ---------------------------------------------------------- peer set
+    def _alive_peers(self) -> List[ReplayPeer]:
+        with self._lock:
+            return [p for pid, p in self.peers.items()
+                    if pid not in self._dead]
+
+    def drop_peer(self, pid: int) -> None:
+        """Stop sampling from / writing back to ``pid`` (its server's lease
+        expired).  The wire twin of ``ShardedReplay.drop_shard`` — but
+        dropping the LAST peer is allowed here: the learner then blocks in
+        ``get()`` until a peer readmits, which the smoke's never-stall gate
+        bounds."""
+        with self._lock:
+            self._dead.add(pid)
+
+    def readmit_peer(self, pid: int, peer: ReplayPeer) -> None:
+        """Re-register a revived server (possibly at a new addr:port and
+        ALWAYS at a fresh epoch — the fence the old incarnation's clients
+        trip).  The wire twin of ``readmit_shard``."""
+        with self._lock:
+            old = self.peers.get(pid)
+            self.peers[pid] = peer
+            self._dead.discard(pid)
+            self._probe_unknown_at = 0.0  # learn its piggyback on next pick
+        if old is not None and old is not peer:
+            old.close()
+
+    def dead_peers(self) -> Tuple[int, ...]:
+        with self._lock:
+            return tuple(sorted(self._dead))
+
+    # ------------------------------------------------------------- sampling
+    def _pick_peer(self) -> Optional[ReplayPeer]:
+        """Weighted draw ∝ advertised priority mass over the alive,
+        sampleable peers — server-granular proportional sampling."""
+        now = time.monotonic()
+        with self._lock:
+            probe_due = now >= self._probe_unknown_at
+            if probe_due:
+                self._probe_unknown_at = now + 1.0
+        if probe_due:
+            # peers whose piggyback is unknown (fresh readmit, still
+            # warming) would otherwise NEVER be drawn while a sampleable
+            # survivor exists — refresh them on a rate-limited bounded
+            # probe so a revived server rejoins the draw
+            for p in self._alive_peers():
+                if not p.sampleable and p.alive():
+                    p.probe()
+        peers = [p for p in self._alive_peers()
+                 if p.sampleable and p.connected()]
+        if not peers:
+            # nobody sampleable yet: probe one alive peer to refresh its
+            # piggyback (bounded), covering warmup and post-readmit
+            for p in self._alive_peers():
+                if p.alive():
+                    p.probe()
+            return None
+        masses = np.asarray([max(p.mass, 0.0) for p in peers], np.float64)
+        if masses.sum() <= 0:
+            return peers[int(self.rng.integers(len(peers)))]
+        return peers[int(self.rng.choice(len(peers),
+                                         p=masses / masses.sum()))]
+
+    def _run(self) -> None:
+        inflight: List[Tuple[ReplayPeer, _Pending]] = []
+        while not self._stop.is_set():
+            # top up the pipeline to depth (each slot gated by _space so
+            # decoded-but-unconsumed batches bound the in-flight window)
+            while len(inflight) < self.depth and self._space.acquire(
+                    blocking=False):
+                peer = self._pick_peer()
+                if peer is None:
+                    self._space.release()
+                    time.sleep(0.05)
+                    break
+                try:
+                    p = peer.start_request(
+                        {"op": "sample", "batch": self.batch_size,
+                         "beta": float(self.beta_fn())})
+                except PeerDead:
+                    self._space.release()
+                    continue
+                inflight.append((peer, p))
+            if not inflight:
+                time.sleep(0.01)
+                continue
+            peer, p = inflight.pop(0)
+            try:
+                header, blob = peer.wait(p)
+            except (protocol.ReplayNetError, ValueError, TimeoutError):
+                # dead peer / empty server / wedge: release the slot and
+                # re-route the next request to the survivors
+                self.rerouted += 1
+                self._space.release()
+                continue
+            try:
+                batch = self._decode_batch(header, blob)
+            except framing.FrameError:
+                self._space.release()
+                continue
+            with self._lock:
+                self._ready.append(batch)
+            self._ready_sem.release()
+        # drain: settle nothing further, slots die with the thread
+
+    def _decode_batch(self, header: Dict[str, Any],
+                      blob: bytes) -> SampledBatch:
+        with hostsync.sanctioned():  # wire gather: the frontier's contract
+            arrays = protocol.decode_arrays(header.get("arrays", ()), blob)
+            # copy out of the frame blob view: downstream (device staging,
+            # writeback) expects owned, writable host arrays
+            batch = SampledBatch(
+                idx=np.array(arrays["idx"], np.int64),
+                obs=np.array(arrays["obs"]),
+                action=np.array(arrays["action"]),
+                reward=np.array(arrays["reward"]),
+                next_obs=np.array(arrays["next_obs"]),
+                discount=np.array(arrays["discount"]),
+                weight=np.array(arrays["weight"], np.float32),
+                prob=(np.array(arrays["prob"])
+                      if "prob" in arrays else None))
+            self.batches_received += 1
+            self.rows_sampled += int(batch.idx.shape[0])
+        return batch
+
+    def get(self, timeout: float = 60.0) -> SampledBatch:
+        """Next pipelined batch (host arrays, GLOBAL indices).  Raises
+        TimeoutError with a reasoned message when nothing arrives — the
+        learner's stall alarm, same contract as `BatchPrefetcher.get`."""
+        if not self._ready_sem.acquire(timeout=timeout):
+            raise TimeoutError(
+                f"no replay batch arrived for {timeout}s (all shard "
+                "servers dead, empty, or unreachable — see the "
+                "`replaynet:` section of obs_report)")
+        with self._lock:
+            batch = self._ready.popleft()
+        self._space.release()
+        return batch
+
+    def sampleable(self) -> bool:
+        return any(p.sampleable for p in self._alive_peers())
+
+    def size(self) -> int:
+        return sum(p.size for p in self._alive_peers())
+
+    # ------------------------------------------------------------ writeback
+    def update_priorities(self, idx: np.ndarray, td_abs: np.ndarray) -> None:
+        """Batched priority write-back, routed to the peer owning each
+        global slot.  Fire-and-forget with bounded in-flight; rows owned by
+        a dead peer are dropped (exactly the in-process dead-shard drop).
+        Learner-thread only (the `WritebackRing` commit path)."""
+        with hostsync.sanctioned():  # host routing math on the hot path
+            idx = np.asarray(idx, np.int64).ravel()
+            td = np.asarray(td_abs, np.float64).ravel()
+            routed = np.zeros(idx.shape[0], bool)
+            for peer in self._alive_peers():
+                lo, hi = peer.slot_range()
+                if hi <= lo:
+                    continue
+                m = (idx >= lo) & (idx < hi)
+                if not m.any():
+                    continue
+                routed |= m
+                metas, blob = protocol.encode_arrays(
+                    {"idx": idx[m], "td": td[m]})
+                header: Dict[str, Any] = {"op": "update", "arrays": metas}
+                if peer.epoch is not None:
+                    header["epoch"] = peer.epoch
+                while len(self._wb_pending) >= self.wb_inflight:
+                    self._settle_one_wb()
+                try:
+                    self._wb_pending.append(
+                        (peer, peer.start_request(header, blob)))
+                    self.updates_sent += int(m.sum())
+                except PeerDead:
+                    self.updates_dropped += int(m.sum())
+            dropped = int((~routed).sum())
+        if dropped:
+            self.updates_dropped += dropped
+
+    def _settle_one_wb(self) -> None:
+        peer, p = self._wb_pending.pop(0)
+        try:
+            peer.wait(p)
+        except (protocol.ReplayNetError, ValueError, TimeoutError):
+            pass  # priorities are advisory; the drop is already counted
+
+    def flush(self, timeout_s: float = 10.0) -> None:
+        """Settle every outstanding write-back ack (the `WritebackRing`
+        drain boundary — ``on_drain`` lands here so a checkpoint's replay
+        snapshot sees every priority the learner already computed)."""
+        deadline = time.monotonic() + timeout_s
+        while self._wb_pending and time.monotonic() < deadline:
+            self._settle_one_wb()
+
+    # ---------------------------------------------------------------- stats
+    def stats(self) -> Dict[str, Any]:
+        return {"batches_received": self.batches_received,
+                "rows_sampled": self.rows_sampled,
+                "updates_sent": self.updates_sent,
+                "updates_dropped": self.updates_dropped,
+                "rerouted": self.rerouted,
+                "dead_peers": list(self.dead_peers()),
+                "peers": [p.stats() for p in self._alive_peers()]}
+
+    def close(self) -> None:
+        self._stop.set()
+        self._thread.join(timeout=10)
+        self.flush(timeout_s=2.0)
+        with self._lock:
+            peers = list(self.peers.values())
+            self.peers.clear()
+        for p in peers:
+            p.close()

@@ -1,0 +1,325 @@
+"""`RemoteReplayPlane` — the learner-side aggregate of the cross-host
+replay plane: discovery, failure lifecycle, and the drop-in surfaces
+`parallel/apex.py` swaps in when ``replay_net_remote`` is on.
+
+Discovery reuses the elastic substrate wholesale: shard servers register
+``replay_shard`` leases carrying ``addr:port`` + shard range + epoch
+(`ReplayShardServer.attach_lease`), and the plane watches the SAME
+heartbeat directory every other role already heals through — no second
+discovery protocol.  The plane owns its own `HeartbeatMonitor` (edge state
+is per-instance, so it cannot race the apex loop's fault-row monitor).
+
+Failure lifecycle, mapped onto the in-process names:
+
+    lease expires  -> drop_peer      (survivors-only sampling; the learner
+                                      never stalls while ANY peer samples)
+    lease revives  -> readmit_peer   (reconnect at the lease's addr:port;
+                                      epoch-fenced — an OLDER epoch than
+                                      the one last seen is a stale lease
+                                      file, ignored, and the revived
+                                      incarnation's fresh epoch is what
+                                      append/update frames must stamp)
+
+Snapshots run SERVER-side (``request_snapshot`` at the learner's
+checkpoint step — the fence); the learner's own checkpoint carries no
+replay payload when the plane is on.
+
+jax-free: the plane is wiring and numpy routing.  The one device-touching
+hop — staging a decoded host batch onto the accelerator — is an injected
+callable (`make_prefetcher`'s ``to_device``), so apex keeps the jax half.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Callable, Dict, List, Optional
+
+import numpy as np
+
+from rainbow_iqn_apex_tpu.parallel.elastic import (
+    HeartbeatMonitor,
+    heartbeat_dir,
+)
+from rainbow_iqn_apex_tpu.replay.net.client import (
+    AppendClient,
+    ReplayPeer,
+    SampleClient,
+)
+
+_ROLE = "replay_shard"
+
+
+class RemoteReplayPlane:
+    """Aggregate client over every discovered replay shard server."""
+
+    def __init__(self, cfg, lanes_total: int, metrics=None,
+                 obs_registry=None):
+        self.cfg = cfg
+        self.lanes_total = int(lanes_total)
+        self.metrics = metrics
+        self.obs_registry = obs_registry
+        self.total_shards = max(int(cfg.replay_shards), 1)
+        if self.lanes_total % self.total_shards:
+            raise ValueError(
+                f"{self.lanes_total} lanes do not divide into "
+                f"{self.total_shards} global shards (lane->shard pinning "
+                "must be block-even, the ShardedReplay contract)")
+        self.lanes_per_shard = self.lanes_total // self.total_shards
+        timeout_s = float(getattr(cfg, "heartbeat_timeout_s", 0) or 10.0)
+        self.monitor = HeartbeatMonitor(
+            heartbeat_dir(cfg), timeout_s, self_id=cfg.process_id)
+        self.peers: Dict[int, ReplayPeer] = {}
+        self._peer_epoch: Dict[int, int] = {}  # last epoch seen per server
+        self.sampler: Optional[SampleClient] = None
+        self._appenders: Dict[int, AppendClient] = {}
+        self._append_active = False
+        self.shed_lanes = 0  # append rows shed for lack of an alive owner
+        self._last_stats = time.monotonic()
+        self.discover()
+
+    @classmethod
+    def from_config(cls, cfg, lanes_total: int, metrics=None,
+                    obs_registry=None) -> Optional["RemoteReplayPlane"]:
+        """The config seam: ``replay_net_remote`` off (default) returns
+        None — replay stays in-process, bitwise the pre-net path."""
+        if not getattr(cfg, "replay_net_remote", False):
+            return None
+        return cls(cfg, lanes_total, metrics=metrics,
+                   obs_registry=obs_registry)
+
+    # ------------------------------------------------------------- discovery
+    def _log(self, event: str, **fields: Any) -> None:
+        if self.metrics is not None:
+            try:
+                self.metrics.log("replay_net", event=event, **fields)
+            except Exception:
+                pass
+
+    def _new_peer(self, lease) -> ReplayPeer:
+        cfg = self.cfg
+        return ReplayPeer(
+            lease.addr, lease.port, peer_id=lease.host,
+            probe_timeout_s=float(
+                getattr(cfg, "replay_net_probe_timeout_s", 0.5)),
+            max_frame_bytes=int(
+                getattr(cfg, "replay_net_max_frame_mb", 64)) << 20,
+            logger=self.metrics, obs_registry=self.obs_registry)
+
+    def discover(self) -> int:
+        """Scan the lease directory for replay shard servers not yet in the
+        peer set (startup + late registrants).  Returns the peer count."""
+        for pid, lease in self.monitor.leases().items():
+            if (lease.role != _ROLE or not lease.addr or not lease.port
+                    or pid in self.peers):
+                continue
+            peer = self._new_peer(lease)
+            self.peers[pid] = peer
+            self._peer_epoch[pid] = int(lease.epoch)
+            if self.sampler is not None:
+                self.sampler.readmit_peer(pid, peer)
+            if self._append_active:
+                self._appenders[pid] = self._make_appender(peer)
+            self._log("peer_discovered", server=pid,
+                      peer=f"{lease.addr}:{lease.port}", epoch=lease.epoch)
+        return len(self.peers)
+
+    # ---------------------------------------------------------- append path
+    def _make_appender(self, peer: ReplayPeer) -> AppendClient:
+        cfg = self.cfg
+        return AppendClient(
+            peer, spool_ticks=int(getattr(cfg, "replay_net_spool", 4096)),
+            inflight=int(getattr(cfg, "replay_net_inflight", 4)),
+            logger=self.metrics, obs_registry=self.obs_registry,
+            own_peer=False)  # peers are plane-owned (shared with sampling)
+
+    def append_batch(self, frames: np.ndarray, actions: np.ndarray,
+                     rewards: np.ndarray, terminals: np.ndarray,
+                     priorities: Optional[np.ndarray] = None,
+                     truncations: Optional[np.ndarray] = None) -> None:
+        """Lockstep lane append, block-partitioned across the peers by
+        their advertised shard ranges (exactly `ShardedReplay.append_batch`
+        with servers in place of shards).  Lanes owned by a dead or
+        undiscovered server are shed with a counter — their actor host's
+        experience waits for readmission, survivors keep absorbing."""
+        if not self._append_active:
+            self._append_active = True
+            for pid, peer in self.peers.items():
+                if pid not in self._appenders:
+                    self._appenders[pid] = self._make_appender(peer)
+        lps = self.lanes_per_shard
+        covered = 0
+        for pid, ac in self._appenders.items():
+            if self.sampler is not None and pid in self.sampler.dead_peers():
+                continue
+            peer = ac.peer
+            if peer.shards <= 0:
+                # piggyback not learned yet (no reply seen): one bounded
+                # probe teaches the shard range; still unknown -> shed
+                peer.probe()
+                if peer.shards <= 0:
+                    continue
+            sl = slice(peer.shard_base * lps,
+                       (peer.shard_base + peer.shards) * lps)
+            ac.append(frames[sl], actions[sl], rewards[sl], terminals[sl],
+                      None if priorities is None else priorities[sl],
+                      None if truncations is None else truncations[sl])
+            covered += peer.shards * lps
+        if covered < self.lanes_total:
+            self.shed_lanes += self.lanes_total - covered
+
+    # ---------------------------------------------------------- sample path
+    def start_sampling(self, batch_size: int,
+                       beta_fn: Callable[[], float]) -> SampleClient:
+        cfg = self.cfg
+        self.sampler = SampleClient(
+            self.peers, batch_size, beta_fn,
+            depth=max(int(getattr(cfg, "sample_ahead_depth", 2)), 1),
+            wb_inflight=max(int(getattr(cfg, "writeback_depth", 2)), 1),
+            seed=int(getattr(cfg, "seed", 0)),
+            logger=self.metrics, obs_registry=self.obs_registry)
+        return self.sampler
+
+    def make_prefetcher(self, batch_size: int, beta_fn: Callable[[], float],
+                        to_device: Callable[[Any], Any], registry=None):
+        """The apex learn-loop seam: a `BatchPrefetcher` whose sampler is
+        the wire pipeline — ``get()`` yields ``(global_idx, device_batch)``
+        exactly like the in-process `make_replay_prefetcher`.  ``to_device``
+        is injected (agents.agent.to_device_batch) so this module stays
+        jax-free; the import below is function-local for the same reason."""
+        from rainbow_iqn_apex_tpu.utils.prefetch import BatchPrefetcher
+
+        client = self.start_sampling(batch_size, beta_fn)
+
+        def _sample():
+            s = client.get()
+            return s.idx, to_device(s)
+
+        # depth=1: the wire client already pipelines sample_ahead_depth
+        # requests; this stage only hides the host->device copy
+        return BatchPrefetcher(_sample, depth=1, device_put=False,
+                               registry=registry)
+
+    def size(self) -> int:
+        if self.sampler is not None:
+            return self.sampler.size()
+        return sum(p.size for p in self.peers.values())
+
+    def sampleable(self) -> bool:
+        if self.sampler is not None:
+            return self.sampler.sampleable()
+        return any(p.sampleable for p in self.peers.values())
+
+    def update_priorities(self, idx: np.ndarray,
+                          td_abs: np.ndarray) -> None:
+        if self.sampler is not None:
+            self.sampler.update_priorities(idx, td_abs)
+
+    def flush_writebacks(self) -> None:
+        """`WritebackRing` drain-boundary hook (``on_drain``)."""
+        if self.sampler is not None:
+            self.sampler.flush()
+
+    # ------------------------------------------------------------ snapshots
+    def request_snapshot(self, step: int) -> int:
+        """Ask every alive peer to snapshot its shard block, fenced by the
+        learner's checkpoint ``step``.  Returns how many acked; failures
+        are logged, not raised (a dead peer snapshots when it readmits)."""
+        ok = 0
+        for pid, peer in list(self.peers.items()):
+            if self.sampler is not None and pid in self.sampler.dead_peers():
+                continue
+            try:
+                peer.request({"op": "snapshot", "step": int(step)},
+                             timeout_s=30.0)
+                ok += 1
+            except Exception as e:
+                self._log("snapshot_failed", server=pid,
+                          why=f"{type(e).__name__}: {e}")
+        return ok
+
+    # ----------------------------------------------------------- lifecycle
+    def poll(self, step: int = 0) -> None:
+        """Drive discovery + the drop/readmit lifecycle + the periodic
+        stats row.  Call on the apex loop's metrics cadence (cheap: lease
+        file reads + at most one bounded probe per peer)."""
+        newly_dead, newly_alive = self.monitor.poll()
+        for lease in newly_dead:
+            if lease.role != _ROLE or lease.host not in self.peers:
+                continue
+            if self.sampler is not None:
+                self.sampler.drop_peer(lease.host)
+            self._log("peer_dead", server=lease.host, epoch=lease.epoch,
+                      step=step)
+        for lease in newly_alive:
+            if lease.role != _ROLE or not lease.addr or not lease.port:
+                continue
+            known = self._peer_epoch.get(lease.host)
+            if known is not None and int(lease.epoch) < known:
+                # a stale lease file from a superseded incarnation: the
+                # fence the in-process readmit_shard enforces, plane level
+                self._log("stale_lease_ignored", server=lease.host,
+                          epoch=lease.epoch, fenced_epoch=known)
+                continue
+            if lease.host in self.peers:
+                peer = self._new_peer(lease)
+                self.peers[lease.host] = peer
+                self._peer_epoch[lease.host] = int(lease.epoch)
+                if self.sampler is not None:
+                    self.sampler.readmit_peer(lease.host, peer)
+                ac = self._appenders.get(lease.host)
+                if ac is not None:
+                    ac.peer.close()
+                    ac.peer = peer  # worker picks the new connection up
+                self._log("peer_readmit", server=lease.host,
+                          epoch=lease.epoch, step=step)
+        self.discover()
+        now = time.monotonic()
+        if now - self._last_stats >= 10.0:
+            self._last_stats = now
+            self._stats_row(step)
+
+    def _stats_row(self, step: int) -> None:
+        dead = set(self.sampler.dead_peers()) if self.sampler else set()
+        rtts = []
+        for pid, peer in self.peers.items():
+            if pid not in dead and peer.connected():
+                rtt = peer.probe()
+                if rtt is not None:
+                    rtts.append(rtt)
+        row: Dict[str, Any] = {
+            "event": "stats", "step": step,
+            "peers": len(self.peers), "dead_peers": len(dead),
+            "size": self.size(),
+            "rtt_ms": round(float(np.mean(rtts)), 3) if rtts else None,
+            "shed_lanes": self.shed_lanes,
+        }
+        if self.sampler is not None:
+            row.update(batches=self.sampler.batches_received,
+                       rows_sampled=self.sampler.rows_sampled,
+                       updates_sent=self.sampler.updates_sent,
+                       updates_dropped=self.sampler.updates_dropped,
+                       rerouted=self.sampler.rerouted)
+        if self._appenders:
+            row.update(
+                spool_depth=sum(a.spool_depth()
+                                for a in self._appenders.values()),
+                acked_rows=sum(a.acked_rows
+                               for a in self._appenders.values()),
+                shed_ticks=sum(a.shed_ticks
+                               for a in self._appenders.values()),
+                fenced_rows=sum(a.fenced_rows
+                                for a in self._appenders.values()))
+        self._log(**row)
+
+    def close(self) -> None:
+        for ac in self._appenders.values():
+            ac.flush(timeout_s=2.0)
+            ac.close()
+        self._appenders.clear()
+        if self.sampler is not None:
+            self.sampler.close()  # closes the shared peers
+            self.sampler = None
+        else:
+            for peer in self.peers.values():
+                peer.close()
+        self.peers.clear()

@@ -1,0 +1,109 @@
+"""Wire vocabulary of the cross-host replay plane (replay/net/).
+
+One replay shard server owns a contiguous block of global replay shards and
+speaks the netcore frame protocol (netcore/framing.py — the same codec the
+serving plane rides).  The ops:
+
+    ping      -> pong          bounded liveness probe; teaches the client the
+                               server's piggyback state (below)
+    append    -> ack           a batched block of actor transitions: T lockstep
+                               ticks x L lanes, epoch-stamped (see fencing)
+    sample    -> batch         one assembled PER batch: uint8 obs/next_obs,
+                               fp32 IS weights, GLOBAL slot indices
+    update    -> ack           batched priority write-back at global indices,
+                               epoch-stamped
+    snapshot  -> ack           server-side replay snapshot, fenced by the
+                               learner's checkpoint step (monotone)
+    stats     -> stats_reply   lifetime counters for gates and obs rows
+    rerr                       reasoned typed failure for any of the above
+
+Fencing: every server incarnation carries the lease epoch it claimed at
+startup (parallel/elastic.py ``next_lease_epoch``), and clients stamp the
+epoch they last learned into ``append``/``update`` headers.  A respawned
+server acks a stale-epoch write with ``fenced: true`` and DROPS the rows —
+a dead incarnation's spool cannot resurrect priorities on the revived shard
+block (the plane-level twin of ``ShardedReplay``'s per-shard write fence).
+
+Piggyback contract (the serving plane's, replayed): every reply header
+carries ``size``/``sampleable``/``mass``/``epoch``/``shard_base``/
+``shards``/``capacity``, so the learner ranks and routes across N servers
+with zero dedicated RPCs.
+
+Indices on the wire are GLOBAL slot ids (``shard_base * shard_capacity +
+local slot``): the server owns the translation, so a `SampleClient` mixing
+batches from many servers hands `WritebackRing` exactly the id space the
+in-process `ShardedReplay` would have.
+
+jax-free (numpy + netcore only): actor spoolers and shard servers import
+this without the device runtime.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Tuple
+
+import numpy as np
+
+from rainbow_iqn_apex_tpu.netcore import framing
+
+
+class ReplayNetError(RuntimeError):
+    """Base class for replay-plane transport failures."""
+
+
+class PeerDead(ReplayNetError):
+    """The connection to a replay shard server is gone (every in-flight
+    request settles with this the moment the socket dies — the caller's
+    survivors-only re-route path treats it like a shard drop)."""
+
+
+# etype strings on the wire -> the exception the caller raises (mirrors the
+# serving plane's _ETYPES so error handling stays transport-agnostic)
+_ETYPES = {
+    "empty": ValueError,  # all surviving shards empty: not sampleable yet
+    "stale_fence": ValueError,  # snapshot step older than the fenced one
+    "unsupported": RuntimeError,
+    "dead": PeerDead,
+}
+
+
+def wire_error(etype: str, msg: str) -> BaseException:
+    return _ETYPES.get(str(etype), ReplayNetError)(msg)
+
+
+# Canonical column order of one append block (optional columns simply
+# absent from the array set when the producer has none).
+APPEND_COLS = ("frames", "actions", "rewards", "terminals",
+               "priorities", "truncations")
+
+# Canonical column set of one sampled batch reply (SampledBatch fields).
+BATCH_COLS = ("idx", "obs", "action", "reward", "next_obs",
+              "discount", "weight", "prob")
+
+
+def encode_arrays(arrays: Dict[str, np.ndarray]
+                  ) -> Tuple[List[Dict[str, Any]], bytes]:
+    """(per-array meta list, packed blob) for a named array set.  Meta
+    (name/dtype/shape) rides the frame header under ``arrays``; bytes ride
+    the blob as a u32-length-prefixed chain in the same order."""
+    metas: List[Dict[str, Any]] = []
+    blobs: List[bytes] = []
+    for name, arr in arrays.items():
+        meta, raw = framing.encode_ndarray(np.asarray(arr))
+        meta["name"] = str(name)
+        metas.append(meta)
+        blobs.append(raw)
+    return metas, framing.pack_blobs(blobs)
+
+
+def decode_arrays(metas: List[Dict[str, Any]],
+                  blob: bytes) -> Dict[str, np.ndarray]:
+    """Inverse of `encode_arrays`.  Arrays VIEW the blob (read-only);
+    callers that mutate must copy."""
+    raws = framing.unpack_blobs(blob)
+    if len(raws) != len(metas):
+        raise framing.FrameCorrupt(
+            f"array frame declares {len(metas)} arrays, blob chain holds "
+            f"{len(raws)}")
+    return {str(m["name"]): framing.decode_ndarray(m, raw)
+            for m, raw in zip(metas, raws)}

@@ -1,0 +1,527 @@
+"""Server half of the cross-host replay plane: `ReplayShardServer` owns one
+contiguous block of global replay shards (a `ShardedReplay` built for just
+that block) and speaks the netcore frame protocol to N clients — actor-side
+`AppendClient`s feeding transitions in, one learner-side `SampleClient`
+draining assembled batches and writing priorities back.
+
+Topology (the Ape-X/Redis-shard picture, now actually disaggregated): the
+global replay of ``R`` shards is split into per-server blocks; a server
+constructed with ``shard_base=b`` owning ``S`` local shards serves global
+shards ``[b, b+S)`` and global slot ids ``[b*C, (b+S)*C)`` — it translates
+at the wire boundary, so clients and the learner's `WritebackRing` see the
+SAME global id space the in-process `ShardedReplay` exposes.
+
+Concurrency: the selectors-driven event loop (the serving plane's
+`TransportServer` shape — accepts + reads on one daemon thread, replies
+drained by per-connection writer threads) never touches the replay memory.
+ALL memory ops (append/sample/update/snapshot) funnel through ONE worker
+thread via a bounded work queue — `ShardedReplay` is not thread-safe, and
+serialising writers is exactly the single-redis-instance semantics each
+shard block already models.  Pings and stats answer inline on the loop, so
+liveness probes stay bounded behind a slow sample.
+
+Fencing: the server carries the lease epoch its incarnation claimed
+(``next_lease_epoch``); ``append``/``update`` frames stamped with an OLDER
+epoch are acked ``fenced: true`` and dropped — a respawned server's
+clients cannot resurrect a dead incarnation's spool into the revived shard
+block.  Acks are sent AFTER the memory op lands (worker-thread ordering),
+so an acked append is durably in the ring: the zero-loss gate the smoke
+(scripts/replay_net_smoke.py) asserts counts exactly these.
+
+Snapshots run server-side (``snapshot`` op), fenced by the learner's
+checkpoint step: a replayed or reordered snapshot request older than the
+last fenced step is refused, and a restarting server restores its own shard
+block from its snapshot prefix before accepting traffic.
+
+jax-free (numpy + netcore + replay host structures): a shard server is a
+DRAM process, never a device one.
+"""
+
+from __future__ import annotations
+
+import os
+import queue
+import selectors
+import socket
+import threading
+from typing import Any, Dict, Optional
+
+from rainbow_iqn_apex_tpu.netcore import framing
+from rainbow_iqn_apex_tpu.replay.net import protocol
+
+# bound on one reply write: a peer that stalls reading for this long is
+# dropped (its requests settle as PeerDead client-side) instead of wedging
+# the writing thread
+_SEND_TIMEOUT_S = 5.0
+# bound on queued memory ops: a client pipelining far past the worker's
+# drain rate is backpressured by its own acks, so a full queue means a
+# runaway peer — shed the op with a reasoned rerr instead of growing
+_WORK_QUEUE_DEPTH = 256
+
+
+class _Conn:
+    """One accepted client connection: socket, incremental frame reader,
+    and a bounded outbound queue drained by this connection's OWN writer
+    thread (neither the selector loop nor the memory worker ever blocks on
+    a peer's full send buffer)."""
+
+    __slots__ = ("sock", "reader", "peer", "outq")
+
+    def __init__(self, sock: socket.socket, max_frame_bytes: int):
+        self.sock = sock
+        self.reader = framing.FrameReader(max_frame_bytes)
+        self.outq: "queue.Queue" = queue.Queue(maxsize=4096)
+        try:
+            self.peer = "%s:%s" % sock.getpeername()[:2]
+        except OSError:
+            self.peer = "?"
+
+
+class ReplayShardServer:
+    """Serve one shard block of the global replay over the framed protocol.
+
+    ``memory`` is the `ShardedReplay` this server owns (its local shard 0 is
+    global shard ``shard_base``); ``epoch`` is the lease epoch of this
+    incarnation (stamp from ``next_lease_epoch`` in deployments — the write
+    fence clients are checked against).  ``port=0`` binds an ephemeral port
+    (read ``.port``); ``snapshot_prefix`` enables the server-side
+    ``snapshot`` op and the restore-on-start path.
+    """
+
+    def __init__(self, memory: Any, shard_base: int = 0,
+                 host: str = "127.0.0.1", port: int = 0,
+                 advertise: Optional[str] = None,
+                 max_frame_bytes: int = framing.DEFAULT_MAX_FRAME,
+                 epoch: int = 0, snapshot_prefix: Optional[str] = None,
+                 logger=None):
+        self.memory = memory
+        self.shard_base = int(shard_base)
+        self.slot_base = self.shard_base * memory.shard_capacity
+        self.epoch = int(epoch)
+        self.snapshot_prefix = snapshot_prefix
+        self.max_frame_bytes = int(max_frame_bytes)
+        self.logger = logger
+        self._listener = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._listener.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._listener.bind((host, int(port)))
+        self._listener.listen(64)
+        self._listener.setblocking(False)
+        self.port = self._listener.getsockname()[1]
+        self.advertise = advertise or (
+            "127.0.0.1" if host in ("", "0.0.0.0") else host)
+        self._selector = selectors.DefaultSelector()
+        self._selector.register(self._listener, selectors.EVENT_READ, None)
+        self._conns: Dict[int, _Conn] = {}  # fd -> conn
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._worker: Optional[threading.Thread] = None
+        self._work: "queue.Queue" = queue.Queue(maxsize=_WORK_QUEUE_DEPTH)
+        # lifetime counters (the smoke's gates + the stats op)
+        self.frames_in = 0
+        self.bytes_out = 0
+        self.rows_appended = 0  # acked-and-landed transition rows
+        self.fenced_appends = 0
+        self.fenced_updates = 0
+        self.samples_served = 0
+        self.updates_applied = 0
+        self.snapshot_step = -1
+        # advisory piggyback state: written by the worker after each memory
+        # op, read (under the lock) by every reply — the event loop never
+        # touches the un-thread-safe memory itself
+        self._adv: Dict[str, Any] = {}
+        self._refresh_advisory()
+        if snapshot_prefix is not None:
+            self._maybe_restore()
+
+    @classmethod
+    def from_config(cls, cfg, memory: Any, epoch: int = 0,
+                    snapshot_prefix: Optional[str] = None,
+                    logger=None) -> Optional["ReplayShardServer"]:
+        """The config seam: ``replay_net_host`` unset (default) returns None
+        — replay stays in-process, bitwise the pre-net path."""
+        if not getattr(cfg, "replay_net_host", ""):
+            return None
+        return cls(
+            memory, shard_base=int(cfg.replay_net_shard_base),
+            host=cfg.replay_net_host, port=cfg.replay_net_port,
+            advertise=cfg.replay_net_advertise or None,
+            max_frame_bytes=int(cfg.replay_net_max_frame_mb) << 20,
+            epoch=epoch, snapshot_prefix=snapshot_prefix, logger=logger)
+
+    def attach_lease(self, writer) -> None:
+        """Advertise ``addr:port`` (and the shard block) in this server's
+        lease payload so clients discover the endpoint through the lease
+        files they already watch — no second discovery protocol.  Call
+        BEFORE ``writer.start()`` so the very first beat carries it."""
+        writer.update_payload(addr=self.advertise, port=self.port,
+                              shard_base=self.shard_base,
+                              shards=len(self.memory.shards))
+
+    # -------------------------------------------------------------- lifecycle
+    def start(self) -> "ReplayShardServer":
+        if self._thread is None:
+            self._worker = threading.Thread(
+                target=self._work_loop, name=f"replaynet-mem-{self.port}",
+                daemon=True)
+            self._worker.start()
+            self._thread = threading.Thread(
+                target=self._run, name=f"replaynet-server-{self.port}",
+                daemon=True)
+            self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        """Close the listener and every connection.  Clients see the drop
+        as `PeerDead` and re-route to survivors — the wire analog of
+        ``drop_shard``."""
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=10)
+            self._thread = None
+        if self._worker is not None:
+            try:
+                self._work.put_nowait(None)
+            except queue.Full:
+                pass
+            self._worker.join(timeout=10)
+            self._worker = None
+        with self._lock:
+            conns = list(self._conns.values())
+            self._conns.clear()
+        for conn in conns:
+            self._close_conn(conn, unregister=False)
+        try:
+            self._selector.close()
+        except (OSError, RuntimeError):
+            pass
+        try:
+            self._listener.close()
+        except OSError:
+            pass
+
+    # -------------------------------------------------------------- event loop
+    def _run(self) -> None:
+        while not self._stop.is_set():
+            try:
+                events = self._selector.select(timeout=0.1)
+            except OSError:
+                return
+            for key, _mask in events:
+                if key.fileobj is self._listener:
+                    self._accept()
+                else:
+                    self._read(key.data)
+
+    def _accept(self) -> None:
+        try:
+            sock, _addr = self._listener.accept()
+        except OSError:
+            return
+        # blocking with a bound (see TransportServer._accept): sendall
+        # loops through partial writes; only a peer stalled past the bound
+        # is dropped.  Reads stay selector-driven.
+        sock.settimeout(_SEND_TIMEOUT_S)
+        try:
+            sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        except OSError:
+            pass
+        conn = _Conn(sock, self.max_frame_bytes)
+        with self._lock:
+            self._conns[sock.fileno()] = conn
+        threading.Thread(target=self._write_loop, args=(conn,),
+                         name=f"replaynet-writer-{self.port}",
+                         daemon=True).start()
+        self._selector.register(sock, selectors.EVENT_READ, conn)
+
+    def _close_conn(self, conn: _Conn, unregister: bool = True) -> None:
+        if unregister:
+            try:
+                self._selector.unregister(conn.sock)
+            except (KeyError, OSError, ValueError):
+                pass
+            with self._lock:
+                self._conns.pop(conn.sock.fileno(), None)
+        try:
+            conn.outq.put_nowait(None)  # stop the writer thread
+        except queue.Full:
+            pass  # writer will exit on the closed socket's send error
+        try:
+            conn.sock.close()
+        except OSError:
+            pass
+
+    def _read(self, conn: _Conn) -> None:
+        try:
+            data = conn.sock.recv(1 << 16)
+        except (BlockingIOError, socket.timeout):
+            return  # spurious readiness; nothing to read this round
+        except OSError:
+            self._close_conn(conn)
+            return
+        if not data:
+            self._close_conn(conn)
+            return
+        try:
+            frames = conn.reader.feed(data)
+        except framing.FrameError as e:
+            # torn/corrupt/oversize append frame: the CRC trailer caught it
+            # BEFORE any rows landed — drop the connection with one
+            # reasoned row; the client's spool re-ships after reconnect
+            # (docs/RESILIENCE.md, "torn append frame")
+            self._log("bad_frame", peer=conn.peer,
+                      why=f"{type(e).__name__}: {e}")
+            self._close_conn(conn)
+            return
+        for header, blob in frames:
+            self.frames_in += 1
+            try:
+                self._handle(conn, header, blob)
+            except Exception as e:
+                self._reply(conn, {"op": "rerr",
+                                   "rid": header.get("rid"),
+                                   "etype": "dead",
+                                   "msg": f"{type(e).__name__}: {e}"})
+
+    # ---------------------------------------------------------------- replies
+    def _log(self, event: str, **fields: Any) -> None:
+        if self.logger is not None:
+            try:
+                self.logger.log("replay_net", event=event, **fields)
+            except Exception:
+                pass
+
+    def _refresh_advisory(self) -> None:
+        """Recompute the piggyback state from the memory.  WORKER-thread
+        only (plus construction, before any thread exists) — replies read
+        the cached copy under the lock."""
+        mem = self.memory
+        alive = [s for k, s in enumerate(mem.shards)
+                 if k not in mem._dead]  # advisory read; worker-serialised
+        adv = {
+            "size": sum(len(s) for s in alive),
+            "sampleable": bool(mem.sampleable),
+            "mass": float(sum(s.tree.total for s in alive)),
+            "epoch": self.epoch,
+            "shard_base": self.shard_base,
+            "shards": len(mem.shards),
+            "capacity": int(mem.shard_capacity),
+        }
+        with self._lock:
+            self._adv = adv
+
+    def _state(self) -> Dict[str, Any]:
+        with self._lock:
+            return dict(self._adv)
+
+    def _reply(self, conn: _Conn, header: Dict[str, Any],
+               blob: bytes = b"") -> None:
+        """Enqueue one reply for the connection's writer thread (the event
+        loop and the memory worker never touch the socket).  A full queue
+        means the peer is long stalled — drop it instead of growing."""
+        header = {**header, **self._state()}
+        try:
+            conn.outq.put_nowait((header, blob))
+        except queue.Full:
+            self._close_conn(conn)
+
+    def _write_loop(self, conn: _Conn) -> None:
+        while True:
+            item = conn.outq.get()
+            if item is None:  # close sentinel
+                return
+            header, blob = item
+            try:
+                self.bytes_out += framing.send_frame(conn.sock, header, blob)
+            except (OSError, ValueError):
+                self._close_conn(conn)
+                return
+
+    # ---------------------------------------------------------------- handlers
+    def _handle(self, conn: _Conn, header: Dict[str, Any],
+                blob: bytes) -> None:
+        op = header.get("op")
+        rid = header.get("rid")
+        if op == "ping":
+            self._reply(conn, {"op": "pong", "rid": rid, "alive": True})
+        elif op == "stats":
+            self._reply(conn, {"op": "stats_reply", "rid": rid,
+                               **self.stats()})
+        elif op in ("append", "sample", "update", "snapshot"):
+            # memory ops run on the ONE worker thread; the bounded queue
+            # sheds a runaway pipeliner with a reasoned rerr instead of
+            # buffering without bound
+            try:
+                self._work.put_nowait((conn, op, rid, header, blob))
+            except queue.Full:
+                self._reply(conn, {"op": "rerr", "rid": rid,
+                                   "etype": "unsupported",
+                                   "msg": "server work queue full (client "
+                                          "pipelining past the drain rate)"})
+        else:
+            self._reply(conn, {"op": "rerr", "rid": rid,
+                               "etype": "unsupported",
+                               "msg": f"unknown op {op!r}"})
+
+    def _work_loop(self) -> None:
+        while True:
+            item = self._work.get()
+            if item is None:
+                return
+            conn, op, rid, header, blob = item
+            try:
+                if op == "append":
+                    self._do_append(conn, rid, header, blob)
+                elif op == "sample":
+                    self._do_sample(conn, rid, header)
+                elif op == "update":
+                    self._do_update(conn, rid, header, blob)
+                else:
+                    self._do_snapshot(conn, rid, header)
+                self._refresh_advisory()
+            except Exception as e:
+                self._reply(conn, {"op": "rerr", "rid": rid,
+                                   "etype": "dead",
+                                   "msg": f"{type(e).__name__}: {e}"})
+
+    def _fenced(self, header: Dict[str, Any]) -> bool:
+        """True when the frame's epoch stamp names a STALE incarnation of
+        this shard block (the respawned-server split-brain fence).  A frame
+        with no epoch — a client that has not learned one yet — passes, the
+        same ``epoch=None`` contract `ShardedReplay._fence` keeps."""
+        epoch = header.get("epoch")
+        return epoch is not None and int(epoch) != self.epoch
+
+    def _do_append(self, conn: _Conn, rid: Any, header: Dict[str, Any],
+                   blob: bytes) -> None:
+        if self._fenced(header):
+            self.fenced_appends += 1
+            self._reply(conn, {"op": "ack", "rid": rid, "ok": False,
+                               "fenced": True})
+            return
+        arrays = protocol.decode_arrays(header.get("arrays", ()), blob)
+        frames, actions = arrays["frames"], arrays["actions"]
+        ticks = int(header.get("ticks", 1))
+        if ticks <= 0 or actions.shape[0] != ticks:
+            raise ValueError(
+                f"append block declares {ticks} ticks, arrays carry "
+                f"{actions.shape[0]}")
+        pri = arrays.get("priorities")
+        trunc = arrays.get("truncations")
+        rows = 0
+        for t in range(ticks):
+            # each tick is one lockstep lane append: ring order inside the
+            # block is exactly the order the producer experienced
+            self.memory.append_batch(
+                frames[t], actions[t], arrays["rewards"][t],
+                arrays["terminals"][t],
+                None if pri is None else pri[t],
+                None if trunc is None else trunc[t])
+            rows += int(actions[t].shape[0])
+        self.rows_appended += rows
+        self._reply(conn, {"op": "ack", "rid": rid, "ok": True,
+                           "rows": rows})
+
+    def _do_sample(self, conn: _Conn, rid: Any,
+                   header: Dict[str, Any]) -> None:
+        try:
+            s = self.memory.sample(int(header["batch"]),
+                                   float(header["beta"]))
+        except ValueError as e:  # all surviving shards empty: not yet warm
+            self._reply(conn, {"op": "rerr", "rid": rid, "etype": "empty",
+                               "msg": str(e)})
+            return
+        self.samples_served += 1
+        arrays = {
+            "idx": s.idx + self.slot_base,  # wire ids are GLOBAL
+            "obs": s.obs, "action": s.action, "reward": s.reward,
+            "next_obs": s.next_obs, "discount": s.discount,
+            "weight": s.weight,
+        }
+        if s.prob is not None:
+            arrays["prob"] = s.prob
+        metas, payload = protocol.encode_arrays(arrays)
+        self._reply(conn, {"op": "batch", "rid": rid, "arrays": metas},
+                    payload)
+
+    def _do_update(self, conn: _Conn, rid: Any, header: Dict[str, Any],
+                   blob: bytes) -> None:
+        if self._fenced(header):
+            self.fenced_updates += 1
+            self._reply(conn, {"op": "ack", "rid": rid, "ok": False,
+                               "fenced": True})
+            return
+        arrays = protocol.decode_arrays(header.get("arrays", ()), blob)
+        self.memory.update_priorities(
+            arrays["idx"] - self.slot_base,  # back to this block's ids
+            arrays["td"])
+        self.updates_applied += int(arrays["idx"].shape[0])
+        self._reply(conn, {"op": "ack", "rid": rid, "ok": True})
+
+    def _do_snapshot(self, conn: _Conn, rid: Any,
+                     header: Dict[str, Any]) -> None:
+        step = int(header.get("step", 0))
+        if self.snapshot_prefix is None:
+            self._reply(conn, {"op": "rerr", "rid": rid,
+                               "etype": "unsupported",
+                               "msg": "server has no snapshot prefix"})
+            return
+        if step < self.snapshot_step:
+            # the learner's checkpoint step is the fence: a replayed or
+            # reordered request older than what is already on disk must not
+            # roll the shard block backwards
+            self._reply(conn, {"op": "rerr", "rid": rid,
+                               "etype": "stale_fence",
+                               "msg": f"snapshot step {step} older than "
+                                      f"fenced step {self.snapshot_step}"})
+            return
+        self.memory.snapshot(self.snapshot_prefix)
+        self.snapshot_step = step
+        self._write_snapshot_step(step)
+        self._log("snapshot", step=step, shard_base=self.shard_base)
+        self._reply(conn, {"op": "ack", "rid": rid, "ok": True,
+                           "step": step})
+
+    # -------------------------------------------------------------- snapshots
+    def _step_path(self) -> str:
+        return f"{self.snapshot_prefix}_step"
+
+    def _write_snapshot_step(self, step: int) -> None:
+        tmp = self._step_path() + ".tmp"
+        with open(tmp, "w") as f:
+            f.write(str(int(step)))
+        os.replace(tmp, self._step_path())
+
+    def _maybe_restore(self) -> None:
+        """Restore this server's shard block from its own snapshot (the
+        server-side resume path: the learner checkpoint carries no replay
+        payload when the plane is on).  Missing/torn snapshots read as
+        'cold start' — the epoch fence already guards the semantics."""
+        try:
+            self.memory.restore(self.snapshot_prefix)
+        except FileNotFoundError:
+            return
+        except Exception as e:
+            self._log("restore_failed", why=f"{type(e).__name__}: {e}")
+            return
+        try:
+            with open(self._step_path()) as f:
+                self.snapshot_step = int(f.read().strip() or -1)
+        except (OSError, ValueError):
+            self.snapshot_step = -1
+        self._refresh_advisory()
+        self._log("restored", step=self.snapshot_step,
+                  rows=int(self._adv["size"]))
+
+    # ---------------------------------------------------------------- stats
+    def stats(self) -> Dict[str, Any]:
+        with self._lock:
+            n = len(self._conns)
+        return {"port": self.port, "connections": n,
+                "frames_in": self.frames_in, "bytes_out": self.bytes_out,
+                "rows_appended": self.rows_appended,
+                "fenced_appends": self.fenced_appends,
+                "fenced_updates": self.fenced_updates,
+                "samples_served": self.samples_served,
+                "updates_applied": self.updates_applied,
+                "snapshot_step": self.snapshot_step}

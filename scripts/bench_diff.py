@@ -61,6 +61,10 @@ REPORTED = {
     # trajectory RECORDS what N-games-per-pod costs per learn step without
     # weather-gating it — promote to GATED once a few rounds exist
     "multitask_throughput": "ratio_vs_single",
+    # the wire replay sample path is deliberately report-only (ISSUE 16):
+    # loopback socket throughput is machine weather — promote to GATED
+    # once a few rounds exist
+    "replay_net_path": "ratio_vs_host",
 }
 
 

@@ -20,7 +20,9 @@ The valid kind set is NOT maintained here: it is exactly
 ``require_known_kind=True`` — so a chaos-soak, traced, net-smoke, or
 league run dir lints against the same registry the emitters and the
 golden-schema test use, and a kind can never be valid in one layer and
-unknown in another.  The static config-drift analyzer
+unknown in another (the replay-plane soak's ``replay_net`` rows —
+`make replaynet-smoke` — lint through the same registry).  The static
+config-drift analyzer
 (rainbow_iqn_apex_tpu/analysis/configcheck.py) closes the loop from the
 emission side: every ``logger.log("<kind>", ...)`` literal in the package
 and scripts/ must name a registered kind, so registry and emitters move

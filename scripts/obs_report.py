@@ -236,6 +236,47 @@ def _net_section(by_kind: Dict[str, List[Dict[str, Any]]]) -> Dict[str, Any]:
     }
 
 
+def _replaynet_section(
+    by_kind: Dict[str, List[Dict[str, Any]]]
+) -> Dict[str, Any]:
+    """Fold cross-host replay rows (replay/net/): the newest plane stats
+    row (peer counts, aggregate size, mean rtt, spool depth, acked/shed
+    append totals, sample/write-back totals) plus lifecycle event counts —
+    the RUNBOOK "learner is starving on remote replay" triage reads this
+    section first.  Empty dict for in-process-replay runs."""
+    rows = by_kind.get("replay_net", [])
+    if not rows:
+        return {}
+    events: Dict[str, int] = {}
+    for row in rows:
+        ev = str(row.get("event", "unknown"))
+        events[ev] = events.get(ev, 0) + 1
+    stats = [r for r in rows if r.get("event") == "stats"]
+    last = stats[-1] if stats else {}
+    flaps = sum(events.get(e, 0) for e in (
+        "disconnect", "reconnect", "probe_timeout", "bad_frame",
+        "spool_shed", "peer_dead"))
+    return {
+        "rows": len(rows),
+        "events": events,
+        "flaps": flaps,
+        "peers": last.get("peers"),
+        "dead_peers": last.get("dead_peers"),
+        "size": last.get("size"),
+        "rtt_ms": last.get("rtt_ms"),
+        "spool_depth": last.get("spool_depth"),
+        "acked_rows": last.get("acked_rows"),
+        "shed_ticks": last.get("shed_ticks"),
+        "fenced_rows": last.get("fenced_rows"),
+        "shed_lanes": last.get("shed_lanes"),
+        "batches": last.get("batches"),
+        "rows_sampled": last.get("rows_sampled"),
+        "updates_sent": last.get("updates_sent"),
+        "updates_dropped": last.get("updates_dropped"),
+        "rerouted": last.get("rerouted"),
+    }
+
+
 def _quant_section(by_kind: Dict[str, List[Dict[str, Any]]]) -> Dict[str, Any]:
     """Fold quant/publish/quant_fallback rows: is the quantized path live,
     what did the gate last measure, and how many publish bytes the delta/
@@ -419,6 +460,9 @@ def aggregate(rows: List[Dict[str, Any]]) -> Dict[str, Any]:
         # cross-host serving plane (serving/net/): per-peer transport
         # rtt/reconnects/bytes + router-gossip freshness
         "net": _net_section(by_kind),
+        # cross-host replay plane (replay/net/): newest plane stats +
+        # lifecycle flap counts (the remote-replay starvation triage input)
+        "replaynet": _replaynet_section(by_kind),
         # quantized inference + compressed distribution: gate agreement,
         # fallback count, publish bytes saved vs fp32-full
         "quant": _quant_section(by_kind),
@@ -553,6 +597,18 @@ def render(report: Dict[str, Any]) -> str:
                 f"bytes_recv={snap.get('bytes_recv')}"
                 + ("" if snap.get("connected", True) else " DISCONNECTED")
             )
+    rn = report.get("replaynet") or {}
+    if rn:
+        lines.append(
+            f"replaynet: peers={rn['peers']} (dead={rn['dead_peers']}) "
+            f"size={rn['size']} rtt_ms={rn['rtt_ms']} flaps={rn['flaps']} "
+            f"spool_depth={rn['spool_depth']} acked_rows={rn['acked_rows']} "
+            f"shed_ticks={rn['shed_ticks']} fenced_rows={rn['fenced_rows']} "
+            f"batches={rn['batches']} updates_sent={rn['updates_sent']} "
+            f"(dropped={rn['updates_dropped']}, rerouted={rn['rerouted']})"
+        )
+        if rn.get("events"):
+            lines.append(f"  replaynet events: {rn['events']}")
     q = report["quant"]
     if q["gates"] or q["fallbacks"] or q["publishes"]:
         lines.append(

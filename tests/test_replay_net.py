@@ -1,0 +1,411 @@
+"""Cross-host replay plane (replay/net/; ISSUE 16).
+
+Loopback suite over REAL sockets, no jax:
+
+1. the netcore/ hoist — ``serving.net.framing`` and ``netcore.framing``
+   expose the SAME objects (back-compat re-export, one codec);
+2. append -> sample -> update round trip: AppendClient blocks land acked,
+   SampleClient batches decode with GLOBAL indices, write-backs apply;
+3. over-the-wire sampling parity vs in-process ``ShardedReplay.sample()``
+   — bitwise twin equivalence (same seed, same RNG stream) plus the
+   chi-square draw-distribution band of tests/test_device_sampling.py and
+   fp32 IS-weight agreement with the host formula;
+4. epoch fencing: a stale incarnation's append/update frames ack
+   ``fenced`` and mutate nothing;
+5. drop -> readmit on the SampleClient (the wire twin of
+   ``drop_shard``/``readmit_shard``): survivors-only draws, then the
+   revived peer serves again;
+6. server-side snapshot/restore with the learner step as fence;
+7. the ``replay_net_*`` config family defaults OFF: both ``from_config``
+   constructors return None on an unconfigured Config.
+
+``make replaynet-smoke`` runs the multi-process SIGKILL soak on top
+(scripts/replay_net_smoke.py).
+"""
+
+import os
+import time
+
+import numpy as np
+import pytest
+
+from rainbow_iqn_apex_tpu import netcore
+from rainbow_iqn_apex_tpu.config import Config
+from rainbow_iqn_apex_tpu.netcore import framing as nc_framing
+from rainbow_iqn_apex_tpu.parallel.sharded_replay import ShardedReplay
+from rainbow_iqn_apex_tpu.replay.net import (
+    AppendClient,
+    PeerDead,
+    ReplayPeer,
+    ReplayShardServer,
+    SampleClient,
+    protocol,
+)
+from rainbow_iqn_apex_tpu.replay.net.plane import RemoteReplayPlane
+from rainbow_iqn_apex_tpu.serving.net import framing as sv_framing
+
+pytestmark = pytest.mark.net
+
+FRAME = (12, 12)
+
+
+def _filled_memory(shards=2, cap=512, lanes=4, seed=0, ticks=None):
+    m = ShardedReplay.build(
+        shards, cap, lanes, frame_shape=FRAME, history=2, n_step=3,
+        gamma=0.9, seed=seed,
+    )
+    rng = np.random.default_rng(seed + 1)
+    for _ in range(ticks if ticks is not None else cap // lanes):
+        m.append_batch(
+            rng.integers(0, 255, (lanes, *FRAME), dtype=np.uint8),
+            rng.integers(0, 4, lanes),
+            rng.normal(size=lanes).astype(np.float32),
+            rng.random(lanes) < 0.02,
+            priorities=rng.random(lanes) + 0.05,
+        )
+    return m
+
+
+def _serve(memory, **kwargs):
+    srv = ReplayShardServer(memory, **kwargs)
+    srv.start()
+    return srv
+
+
+def _peer(srv, pid=0, **kwargs):
+    return ReplayPeer("127.0.0.1", srv.port, peer_id=pid, **kwargs)
+
+
+def _exact_probs(m: ShardedReplay) -> np.ndarray:
+    leaves = np.concatenate([
+        s.tree.tree[s.tree.span:s.tree.span + s.capacity] for s in m.shards
+    ])
+    return leaves / leaves.sum()
+
+
+def _chi_square(counts: np.ndarray, expected: np.ndarray) -> float:
+    keep = expected > 0
+    return float(
+        ((counts[keep] - expected[keep]) ** 2 / expected[keep]).sum()
+    )
+
+
+# ----------------------------------------------------------- netcore hoist
+def test_framing_shared_between_netcore_and_serving():
+    """The hoist keeps ONE codec: serving.net.framing re-exports the
+    netcore classes (isinstance compatibility across both import paths),
+    and the lazy package inits expose it without jax."""
+    assert sv_framing.FrameReader is nc_framing.FrameReader
+    assert sv_framing.FrameProtocol is nc_framing.FrameProtocol
+    assert sv_framing.encode_frame is nc_framing.encode_frame
+    assert netcore.FrameReader is nc_framing.FrameReader
+    # the codec itself still round-trips through either path
+    payload = sv_framing.encode_frame({"op": "ping"}, b"abc")
+    reader = nc_framing.FrameReader()
+    frames = reader.feed(payload)
+    assert frames == [({"op": "ping"}, b"abc")]
+
+
+def test_ndarray_codec_roundtrip_via_protocol():
+    arrays = {
+        "idx": np.arange(7, dtype=np.int64),
+        "obs": np.random.default_rng(0).integers(
+            0, 255, (7, *FRAME, 2), dtype=np.uint8),
+        "weight": np.linspace(0.1, 1.0, 7, dtype=np.float32),
+    }
+    metas, blob = protocol.encode_arrays(arrays)
+    out = protocol.decode_arrays(metas, blob)
+    assert set(out) == set(arrays)
+    for k in arrays:
+        np.testing.assert_array_equal(out[k], arrays[k])
+        assert out[k].dtype == arrays[k].dtype
+
+
+# ------------------------------------------------------------ round trip
+def test_append_sample_update_roundtrip():
+    mem = ShardedReplay.build(2, 512, 4, frame_shape=FRAME, history=2,
+                              n_step=3, gamma=0.9, seed=0)
+    srv = _serve(mem, epoch=5)
+    peer = _peer(srv)
+    try:
+        assert peer.probe(timeout_s=5.0) is not None
+        ac = AppendClient(peer, own_peer=False)
+        rng = np.random.default_rng(1)
+        for _ in range(200):
+            ac.append(
+                rng.integers(0, 255, (4, *FRAME), dtype=np.uint8),
+                rng.integers(0, 4, 4),
+                rng.normal(size=4).astype(np.float32),
+                rng.random(4) < 0.02,
+                priorities=rng.random(4) + 0.05,
+            )
+        assert ac.flush(timeout_s=30.0)
+        assert ac.acked_rows == 200 * 4
+        assert srv.rows_appended == 200 * 4
+        assert len(mem) > 0 and mem.sampleable
+
+        sc = SampleClient({0: peer}, 32, lambda: 0.5, depth=2, seed=0)
+        try:
+            b = sc.get(timeout=30.0)
+            assert b.idx.shape == (32,)
+            assert b.obs.dtype == np.uint8
+            assert b.obs.shape == (32, *FRAME, 2)
+            assert b.weight.dtype == np.float32
+            # write-back applies server-side (peer owns slots [0, cap))
+            before = [s.tree.total for s in mem.shards]
+            sc.update_priorities(b.idx, np.full(32, 9.0, np.float32))
+            sc.flush(timeout_s=10.0)
+            assert sc.updates_sent == 32 and sc.updates_dropped == 0
+            deadline = time.monotonic() + 10.0
+            while (srv.updates_applied < 32
+                   and time.monotonic() < deadline):
+                time.sleep(0.01)
+            assert srv.updates_applied == 32
+            assert [s.tree.total for s in mem.shards] != before
+        finally:
+            sc.close()
+        ac.close()
+    finally:
+        peer.close()
+        srv.stop()
+
+
+# ------------------------------------------------- sampling parity (wire)
+def test_wire_sample_bitwise_matches_in_process_twin():
+    """One server owning ALL shards vs an identically built+filled twin:
+    the server literally calls ``ShardedReplay.sample`` on the same RNG
+    stream, so wire batches are BITWISE the twin's host batches (idx,
+    uint8 obs, fp32 IS weights) — the strongest parity statement, the
+    chi-square below is the distributional form."""
+    mem = _filled_memory()
+    twin = _filled_memory()
+    srv = _serve(mem)
+    peer = _peer(srv)
+    try:
+        for _ in range(5):
+            header, blob = peer.request(
+                {"op": "sample", "batch": 50, "beta": 0.5}, timeout_s=10.0)
+            assert header["op"] == "batch"
+            wire = protocol.decode_arrays(header["arrays"], blob)
+            host = twin.sample(50, 0.5)
+            np.testing.assert_array_equal(wire["idx"], host.idx)
+            np.testing.assert_array_equal(wire["obs"], host.obs)
+            np.testing.assert_array_equal(wire["action"], host.action)
+            np.testing.assert_array_equal(wire["weight"], host.weight)
+            assert wire["weight"].dtype == np.float32
+    finally:
+        peer.close()
+        srv.stop()
+
+
+def test_wire_draw_matches_host_distribution_chi_square():
+    """SampleClient draws over many batches land within the chi-square
+    acceptance band of the EXACT proportional probabilities — the
+    tests/test_device_sampling.py band (99.9% critical value, 32 bins)."""
+    mem = _filled_memory()
+    p = _exact_probs(mem)
+    n_slots = p.size
+    bins = 32
+    bin_of = (np.arange(n_slots) * bins) // n_slots
+    draws = 20_000
+    B = 50
+
+    srv = _serve(mem)
+    peer = _peer(srv)
+    sc = SampleClient({0: peer}, B, lambda: 0.5, depth=2, seed=0)
+    try:
+        counts = np.zeros(bins)
+        for _ in range(draws // B):
+            b = sc.get(timeout=30.0)
+            np.add.at(counts, bin_of[b.idx], 1)
+        n = int(counts.sum())
+        exp_bins = np.zeros(bins)
+        np.add.at(exp_bins, bin_of, p)
+        crit = 61.1  # chi2 df=31, alpha=0.001
+        chi = _chi_square(counts, exp_bins * n)
+        assert chi < crit, f"wire draw chi2 {chi:.1f} >= {crit}"
+    finally:
+        sc.close()
+        srv.stop()
+
+
+def test_wire_is_weights_match_host_formula_fp32():
+    mem = _filled_memory()
+    srv = _serve(mem)
+    peer = _peer(srv)
+    try:
+        beta = 0.6
+        header, blob = peer.request(
+            {"op": "sample", "batch": 64, "beta": beta}, timeout_s=10.0)
+        wire = protocol.decode_arrays(header["arrays"], blob)
+        prob = wire["prob"].astype(np.float64)  # f64 host truth
+        w_ref = (len(mem) * np.maximum(prob, 1e-12)) ** (-beta)
+        w_ref = w_ref / w_ref.max()
+        np.testing.assert_allclose(
+            wire["weight"], w_ref.astype(np.float32),
+            rtol=2e-4, atol=1e-6)
+    finally:
+        peer.close()
+        srv.stop()
+
+
+# ------------------------------------------------------------ epoch fence
+def test_stale_epoch_append_and_update_are_fenced():
+    mem = _filled_memory()
+    srv = _serve(mem, epoch=5)
+    peer = _peer(srv)
+    try:
+        size_before = len(mem)
+        totals_before = [s.tree.total for s in mem.shards]
+        rng = np.random.default_rng(2)
+        arrays = {
+            "frames": rng.integers(0, 255, (1, 4, *FRAME), dtype=np.uint8),
+            "actions": rng.integers(0, 4, (1, 4)),
+            "rewards": rng.normal(size=(1, 4)).astype(np.float32),
+            "terminals": np.zeros((1, 4), bool),
+        }
+        metas, blob = protocol.encode_arrays(arrays)
+        header, _ = peer.request(
+            {"op": "append", "ticks": 1, "epoch": 4, "arrays": metas},
+            blob, timeout_s=10.0)
+        assert header["ok"] is False and header["fenced"] is True
+        assert len(mem) == size_before
+        assert srv.fenced_appends == 1
+
+        up = {"idx": np.arange(8, dtype=np.int64),
+              "td": np.full(8, 7.0, np.float32)}
+        metas, blob = protocol.encode_arrays(up)
+        header, _ = peer.request(
+            {"op": "update", "epoch": 4, "arrays": metas}, blob,
+            timeout_s=10.0)
+        assert header["ok"] is False and header["fenced"] is True
+        assert [s.tree.total for s in mem.shards] == totals_before
+        assert srv.fenced_updates == 1
+
+        # a current-epoch frame (or one with no epoch learned yet) passes
+        header, _ = peer.request(
+            {"op": "append", "ticks": 1, "epoch": 5,
+             "arrays": protocol.encode_arrays(arrays)[0]},
+            protocol.encode_arrays(arrays)[1], timeout_s=10.0)
+        assert header["ok"] is True and header["rows"] == 4
+    finally:
+        peer.close()
+        srv.stop()
+
+
+# --------------------------------------------------------- drop / readmit
+def test_sample_client_drop_then_readmit_peer():
+    """Two shard blocks on two servers: dropping one peer keeps full
+    batches flowing from the survivor's slot range only; readmitting a
+    REVIVED incarnation restores draws from its range."""
+    cap = 512
+    m0 = _filled_memory(shards=1, cap=cap, seed=0)
+    m1 = _filled_memory(shards=1, cap=cap, seed=9)
+    s0 = _serve(m0, shard_base=0)
+    s1 = _serve(m1, shard_base=1, epoch=1)
+    p0, p1 = _peer(s0, 0), _peer(s1, 1)
+    sc = SampleClient({0: p0, 1: p1}, 32, lambda: 0.4, depth=2, seed=3)
+    try:
+        # both ranges eventually drawn
+        seen = set()
+        for _ in range(30):
+            b = sc.get(timeout=30.0)
+            seen.update(np.unique(b.idx // cap).tolist())
+            if seen == {0, 1}:
+                break
+        assert seen == {0, 1}
+
+        sc.drop_peer(1)
+        # drain the pipeline of pre-drop batches, then survivors only
+        for _ in range(4):
+            sc.get(timeout=30.0)
+        for _ in range(10):
+            b = sc.get(timeout=30.0)
+            assert set(np.unique(b.idx // cap).tolist()) == {0}
+        assert sc.dead_peers() == (1,)
+
+        # revive at a fresh epoch (possibly a new port in real runs)
+        p1b = _peer(s1, 1)
+        sc.readmit_peer(1, p1b)
+        assert sc.dead_peers() == ()
+        revived = False
+        for _ in range(60):
+            b = sc.get(timeout=30.0)
+            if 1 in np.unique(b.idx // cap).tolist():
+                revived = True
+                break
+        assert revived, "readmitted peer never drawn again"
+    finally:
+        sc.close()
+        s0.stop()
+        s1.stop()
+
+
+# ------------------------------------------------------ snapshot / restore
+def test_server_side_snapshot_restore_with_step_fence(tmp_path):
+    prefix = os.path.join(str(tmp_path), "shard0")
+    mem = _filled_memory(shards=1)
+    srv = _serve(mem, snapshot_prefix=prefix)
+    peer = _peer(srv)
+    try:
+        header, _ = peer.request({"op": "snapshot", "step": 100},
+                                 timeout_s=30.0)
+        assert header["ok"] is True and header["step"] == 100
+        # an older (replayed/reordered) request must not roll back
+        with pytest.raises(ValueError, match="older than fenced"):
+            peer.request({"op": "snapshot", "step": 50}, timeout_s=30.0)
+    finally:
+        peer.close()
+        srv.stop()
+
+    # a respawned server restores its own shard block from the prefix
+    fresh = ShardedReplay.build(1, 512, 4, frame_shape=FRAME, history=2,
+                                n_step=3, gamma=0.9, seed=0)
+    assert len(fresh) == 0
+    srv2 = ReplayShardServer(fresh, snapshot_prefix=prefix)
+    assert len(fresh) == len(mem)
+    assert srv2.snapshot_step == 100  # the fence survives the respawn
+
+
+# ------------------------------------------------------------- default off
+def test_replay_net_config_defaults_off():
+    cfg = Config()
+    assert cfg.replay_net_host == ""
+    assert cfg.replay_net_port == 0
+    assert cfg.replay_net_advertise == ""
+    assert cfg.replay_net_remote is False
+    mem = ShardedReplay.build(1, 64, 4, frame_shape=FRAME, history=2,
+                              n_step=3, gamma=0.9, seed=0)
+    assert ReplayShardServer.from_config(cfg, mem) is None
+    assert RemoteReplayPlane.from_config(cfg, 4) is None
+
+
+def test_append_client_sheds_on_full_spool_with_dead_server():
+    """An unreachable server must never stall the actor: the spool fills,
+    append() returns False, the shed counter climbs — and close() returns
+    promptly (bounded reconnect backoff, no join hang)."""
+    dead = ReplayPeer("127.0.0.1", 1, peer_id=0, connect=False)
+    ac = AppendClient(dead, spool_ticks=4, coalesce=1)
+    try:
+        rng = np.random.default_rng(4)
+        results = []
+        for _ in range(12):
+            results.append(ac.append(
+                rng.integers(0, 255, (2, *FRAME), dtype=np.uint8),
+                rng.integers(0, 4, 2),
+                rng.normal(size=2).astype(np.float32),
+                np.zeros(2, bool)))
+        assert not all(results)
+        assert ac.shed_ticks >= 1
+        assert ac.spool_depth() <= 4
+    finally:
+        ac.close()
+
+
+def test_peer_request_raises_peer_dead_when_unreachable():
+    dead = ReplayPeer("127.0.0.1", 1, peer_id=0, connect=False)
+    try:
+        with pytest.raises(PeerDead):
+            dead.request({"op": "ping"}, timeout_s=1.0)
+    finally:
+        dead.close()
