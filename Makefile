@@ -2,7 +2,7 @@
 
 PY ?= python
 
-.PHONY: test test-fast serve-smoke serve-bench chaos-smoke obs-smoke soak-smoke perf-smoke fleet-smoke quant-smoke trace-smoke multitask-smoke net-smoke replaynet-smoke league-smoke static-smoke
+.PHONY: test test-fast serve-smoke serve-bench chaos-smoke obs-smoke soak-smoke failover-smoke perf-smoke fleet-smoke quant-smoke trace-smoke multitask-smoke net-smoke replaynet-smoke league-smoke static-smoke
 
 # tier-1: fast unit + integration tests on the virtual 8-device CPU mesh
 test-fast:
@@ -100,6 +100,21 @@ soak-smoke:
 	JAX_PLATFORMS=cpu $(PY) scripts/chaos_soak.py --frames 2000 \
 	  --kill-schedule seeded --out /tmp/ria_soak_smoke
 	$(PY) scripts/lint_jsonl.py /tmp/ria_soak_smoke/results
+
+# learner-failover smoke (docs/RESILIENCE.md "learner failover"): the
+# failover unit/race tests, then the real-process kill: SIGKILL the toy
+# learner mid-run with a live standby — the harness gates that the standby
+# claims within the lease timeout, mailbox versions stay strictly monotone
+# across the takeover, every adoption is digest-exact (zero stale adopts),
+# the successor's post-takeover state is bitwise a plain kill->resume from
+# the same checkpoint, and the run dir lints.  Emits one report-only
+# failover_mttr bench row.
+failover-smoke:
+	JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_failover.py -q -m chaos
+	rm -rf /tmp/ria_failover_smoke
+	JAX_PLATFORMS=cpu $(PY) scripts/chaos_soak.py --kill-learner \
+	  --out /tmp/ria_failover_smoke
+	$(PY) scripts/lint_jsonl.py /tmp/ria_failover_smoke/results
 
 # perf smoke: the pipelined learner hot path (utils/writeback.py ring,
 # docs/PERFORMANCE.md) must beat the per-step-sync loop on the CPU synthetic

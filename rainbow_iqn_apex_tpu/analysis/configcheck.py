@@ -79,6 +79,8 @@ DEFAULT_OFF: Dict[str, object] = {
     "coordinator_address": "",
     "snapshot_replay": False,
     "resume": "",
+    "failover_standby": False,
+    "failover_warm": False,
 }
 
 _DOC_CFG_RE = re.compile(r"`cfg\.([A-Za-z_][A-Za-z0-9_]*)`")

@@ -60,6 +60,7 @@ JAX_FREE_MODULES: Tuple[str, ...] = (
     "rainbow_iqn_apex_tpu/netcore/",
     "rainbow_iqn_apex_tpu/obs/schema.py",
     "rainbow_iqn_apex_tpu/parallel/elastic.py",
+    "rainbow_iqn_apex_tpu/parallel/failover.py",
     "rainbow_iqn_apex_tpu/parallel/sharded_replay.py",
     "rainbow_iqn_apex_tpu/replay/net/",
     "rainbow_iqn_apex_tpu/serving/batcher.py",

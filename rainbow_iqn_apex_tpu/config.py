@@ -78,6 +78,20 @@ class Config:
     respawn_base_s: float = 0.2  # respawn backoff base (doubles per attempt,
     # deterministic jitter — the shared RetryPolicy schedule)
     respawn_max_s: float = 5.0  # respawn backoff ceiling
+    # ---- learner failover (parallel/failover.py; docs/RESILIENCE.md) --------------
+    failover_standby: bool = False  # run a hot-standby learner: tail the
+    # active learner's lease and, on expiry, claim the learner role at
+    # learner_epoch+1 via the O_EXCL per-epoch claim file, restore the newest
+    # VALID checkpoint (+ CRC'd replay snapshot) and resume training at
+    # weight versions strictly above the deceased learner's.  Off (default)
+    # = no standby machinery runs; the training loop is bitwise the
+    # pre-failover path (tier-1 asserted).
+    failover_warm: bool = False  # warm standby: additionally tail the
+    # WeightMailbox so takeover starts from the freshest published params
+    # (restore only replays the delta since the last checkpoint).  Requires
+    # failover_standby.
+    failover_poll_s: float = 0.5  # standby lease-poll cadence in seconds
+    # (bounds claim latency at ~poll + heartbeat_timeout_s)
 
     # ---- environment (SURVEY §2 row 2) -------------------------------------------
     env_id: str = "toy:catch"  # "toy:catch", "toy:chain", or "atari:<Game>"

@@ -66,6 +66,13 @@ class RunHealth:
         # NAMES the offender; a clean lag row clears the set
         self.lag_consumers: set = set()
         self.readmits = 0
+        # learner failover (parallel/failover.py; docs/RESILIENCE.md
+        # "learner failover"): a takeover latches the run degraded until the
+        # SUCCESSOR completes its first clean learn step (note_finite_step
+        # is the heal edge) — "a standby claimed the role" is only half the
+        # story until the claimed learner actually trains.
+        self.takeover_pending = False
+        self.takeovers = 0
         self.total_shed = 0
         self._last_strikes = 0
         self._aborted = False
@@ -228,6 +235,40 @@ class RunHealth:
                     with self._lock:
                         self.fault_counts["league_collapsed"] += 1
                         self._win_faults["league_collapsed"] += 1
+        elif kind == "failover":
+            # learner failover lifecycle (parallel/failover.py).  A takeover
+            # is the single point of failure actually failing — degrade the
+            # window AND latch degraded until the successor's first clean
+            # learn step (note_finite_step clears the latch).  A fenced
+            # stale publish/write-back means a ZOMBIE predecessor is still
+            # running — the fence worked, but a human should know it is
+            # firing.  Lost claim races are normal standby operation:
+            # counted, never degrading.
+            event = row.get("event")
+            if event == "takeover":
+                with self._lock:
+                    self.takeover_pending = True
+                    self.takeovers += 1
+                    self.fault_counts["failover_takeover"] += 1
+                    self._win_faults["failover_takeover"] += 1
+                self.registry.counter(
+                    "failover_takeovers_total", "health").inc()
+                mttr = row.get("mttr_s")
+                if mttr is not None:
+                    self.registry.gauge("failover_mttr_s", "health").set(
+                        float(mttr))
+            elif event == "fenced_stale":
+                with self._lock:
+                    self.fault_counts["failover_fenced"] += 1
+                    self._win_faults["failover_fenced"] += 1
+                self.registry.counter(
+                    "failover_fenced_total", "health").inc()
+            elif event == "claim":
+                self.registry.counter(
+                    "failover_claims_total", "health").inc()
+            elif event == "restore":
+                self.registry.counter(
+                    "failover_restores_total", "health").inc()
         elif kind == "lag":
             # propagation-lag budget check (obs/pipeline_trace.py): the
             # budget is max_weight_lag publishes' worth of publish cadence —
@@ -295,6 +336,7 @@ class RunHealth:
         with self._lock:
             self._last_strikes = 0
             self._stall_active = False
+            self.takeover_pending = False  # successor trained: heal edge
 
     def note_abort(self) -> None:
         self.note_fault("train_aborted")
@@ -315,6 +357,7 @@ class RunHealth:
             or self._win_shed > 0
             or self.dead_hosts
             or self.fenced_hosts
+            or self.takeover_pending
         ):
             return "degraded"
         return "ok"
@@ -349,6 +392,8 @@ class RunHealth:
                 "hosts_fenced": sorted(self.fenced_hosts),
                 "lag_consumers": sorted(self.lag_consumers),
                 "readmits": int(self.readmits),
+                "takeovers": int(self.takeovers),
+                "takeover_pending": bool(self.takeover_pending),
             }
             self._win_faults.clear()
             self._win_shed = 0

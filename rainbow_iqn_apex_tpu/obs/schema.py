@@ -121,6 +121,17 @@ REQUIRED_KEYS: Dict[str, frozenset] = {
     # (member/source/generation/digest/genome); "adopt" is the loser-side
     # confirmation (digest-asserted); "exploit_skipped"/"adopt_refused"
     # carry a reasoned `reason`; "evicted" is a member's permanent death
+    # learner-failover rows (parallel/failover.py; docs/RESILIENCE.md
+    # "learner failover"):
+    "failover": frozenset({"event"}),  # standby/takeover lifecycle (event:
+    # claim/takeover/restore/fenced_stale.  "claim" is one O_EXCL role-epoch
+    # race outcome — carries epoch + won, losers add a reasoned `reason` and
+    # re-arm; "restore" carries restore_s (+ step/warm) for the recovery-
+    # latency split; "takeover" carries epoch/mttr_s/warm — RunHealth folds
+    # it window-degraded until the first clean post-takeover learn row;
+    # "fenced_stale" carries `surface` (publish/mailbox/writeback/
+    # replay_net/league) + the refused epoch — the zombie-learner refusal
+    # witness obs_report's `failover:` section counts)
     "lag": frozenset({"step"}),  # periodic lag-attribution row: per-metric
     # window percentiles of the always-on lag_* histograms (sample age at
     # learn time, ring retirement, router dispatch, batcher slot wait) plus

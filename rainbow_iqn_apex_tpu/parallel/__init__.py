@@ -23,6 +23,8 @@ _EXPORTS = {
     "replicated": "rainbow_iqn_apex_tpu.parallel.mesh",
     "split_devices": "rainbow_iqn_apex_tpu.parallel.mesh",
     "ShardedReplay": "rainbow_iqn_apex_tpu.parallel.sharded_replay",
+    "StandbyLearner": "rainbow_iqn_apex_tpu.parallel.failover",
+    "run_standby": "rainbow_iqn_apex_tpu.parallel.failover",
     "HeartbeatMonitor": "rainbow_iqn_apex_tpu.parallel.elastic",
     "HeartbeatWriter": "rainbow_iqn_apex_tpu.parallel.elastic",
     "Lease": "rainbow_iqn_apex_tpu.parallel.elastic",
@@ -72,6 +74,10 @@ if TYPE_CHECKING:  # static analyzers see the eager imports
         parse_mesh_shape,
         replicated,
         split_devices,
+    )
+    from rainbow_iqn_apex_tpu.parallel.failover import (  # noqa: F401
+        StandbyLearner,
+        run_standby,
     )
     from rainbow_iqn_apex_tpu.parallel.sharded_replay import (  # noqa: F401
         ShardedReplay,

@@ -764,6 +764,36 @@ def train_apex(cfg: Config, max_frames: Optional[int] = None) -> Dict[str, Any]:
             monitor = HeartbeatMonitor(
                 heartbeat_dir(cfg), cfg.heartbeat_timeout_s, self_id=cfg.process_id
             )
+    # learner failover (parallel/failover.py; docs/RESILIENCE.md "learner
+    # failover"): claim this incarnation's learner-role epoch through the
+    # same O_EXCL markers standbys race, stamp it into the lease payload
+    # (the standby's takeover contract) and arm the zombie publish fence.
+    # Default-off takes none of this; multihost declines with a reasoned
+    # notice (N pod hosts racing one role claim would fence each other —
+    # pod-level failover is a ROADMAP follow-up).
+    lfence = None
+    learner_epoch = 0
+    if cfg.failover_standby:
+        if multihost:
+            metrics.log("notice", event="failover_fallback",
+                        reason="multihost: external respawn loop retained")
+        else:
+            from rainbow_iqn_apex_tpu.parallel.elastic import EpochFence
+            from rainbow_iqn_apex_tpu.parallel.failover import (
+                LEARNER_ROLE,
+                learner_epoch_at_start,
+                refresh_fence,
+            )
+
+            learner_epoch = learner_epoch_at_start(cfg)
+            lfence = EpochFence(learner_epoch)
+            driver.attach_epoch_fence(lfence, learner_epoch)
+            if heartbeat is not None:
+                heartbeat.update_payload(
+                    role=LEARNER_ROLE, learner_epoch=learner_epoch)
+                heartbeat.beat()  # visible before the first renewal interval
+            metrics.log("failover", event="claim", won=True,
+                        epoch=learner_epoch, source="learner_start")
     # staleness fence (parallel/elastic.py): the fused loop adopts the
     # published version atomically with the params, so lag is structurally 0
     # here and the fence can never fire — observe() keeps the
@@ -861,6 +891,11 @@ def train_apex(cfg: Config, max_frames: Optional[int] = None) -> Dict[str, Any]:
                 cfg, lanes, metrics=metrics,
                 obs_registry=obs_run.registry,
             )
+            if lfence is not None:
+                # update/snapshot frames carry the learner epoch; the shard
+                # servers latch the highest seen and refuse older stamps
+                # (the PR-16 step fence grown an epoch dimension)
+                rplane.set_learner_epoch(learner_epoch)
 
     frames = 0
     last_pub = 0
@@ -875,6 +910,24 @@ def train_apex(cfg: Config, max_frames: Optional[int] = None) -> Dict[str, Any]:
         # (remote plane: shard servers restore their own snapshots at
         # spawn, fenced by the learner's checkpoint step — nothing local)
         metrics.log("resume", step=driver.step, frames=frames)
+        if lfence is not None:
+            # successor version floor: the deceased learner may have
+            # PUBLISHED versions above its last checkpointed
+            # weights_version — start strictly above the highest version
+            # any lease ever advertised, so no consumer watches the
+            # successor re-issue a version number it already adopted
+            peak = max(
+                (lease.weight_version for lease in HeartbeatMonitor(
+                    heartbeat_dir(cfg), cfg.heartbeat_timeout_s,
+                ).leases().values()),
+                default=-1,
+            )
+            if peak > driver.weights_version:
+                driver.weights_version = peak
+                driver.actor_weights_version = peak
+            metrics.log("failover", event="restore", epoch=learner_epoch,
+                        step=driver.step, version_floor=max(
+                            peak, driver.weights_version))
 
     estimator = (
         ActorPriorityEstimator(lanes, cfg.multi_step, cfg.gamma)
@@ -918,6 +971,23 @@ def train_apex(cfg: Config, max_frames: Optional[int] = None) -> Dict[str, Any]:
         _update_target = frontier.update
     else:
         _update_target = memory.update_priorities
+    if lfence is not None:
+        _unfenced_update = _update_target
+        _wb_refused = [0]
+
+        def _update_target(idx, td_abs):
+            # zombie write-back fence: a superseded learner's retired |TD|
+            # rows must not perturb the successor's sampling distribution.
+            # One row on the first refusal (a storm is a triage signal, not
+            # a log flood — docs/RUNBOOK.md), the fence counts the rest.
+            if lfence.stale(learner_epoch):
+                _wb_refused[0] += 1
+                if _wb_refused[0] == 1:
+                    metrics.log("failover", event="fenced_stale",
+                                surface="writeback", epoch=learner_epoch,
+                                fence_epoch=lfence.epoch)
+                return None
+            return _unfenced_update(idx, td_abs)
     committer = RingCommitter(
         ring,
         _update_target,
@@ -1208,13 +1278,24 @@ def train_apex(cfg: Config, max_frames: Optional[int] = None) -> Dict[str, Any]:
                         if heartbeat is not None:
                             heartbeat.set_weight_version(version)
                         if member is not None:
-                            # league outbox publish (the int8-delta chain
-                            # other members adopt from) rides the same
-                            # drained boundary as the actor broadcast
-                            with hostsync.sanctioned():
-                                member.publish(
-                                    host_state(driver.state).params,
-                                    step=step)
+                            if (lfence is not None
+                                    and lfence.stale(learner_epoch)):
+                                # zombie league fence: a superseded member
+                                # incarnation must not clobber the
+                                # successor's outbox delta chain
+                                metrics.log(
+                                    "failover", event="fenced_stale",
+                                    surface="league", epoch=learner_epoch,
+                                    fence_epoch=lfence.epoch)
+                            else:
+                                # league outbox publish (the int8-delta
+                                # chain other members adopt from) rides the
+                                # same drained boundary as the actor
+                                # broadcast
+                                with hostsync.sanctioned():
+                                    member.publish(
+                                        host_state(driver.state).params,
+                                        step=step)
                     if (member is not None
                             and cadence_hit(step, cfg.metrics_interval,
                                             reuse_k)
@@ -1324,6 +1405,13 @@ def train_apex(cfg: Config, max_frames: Optional[int] = None) -> Dict[str, Any]:
                             **({} if reuse_k == 1
                                else {"replay_ratio": reuse_k}),
                         )
+                        if lfence is not None:
+                            # the zombie's wake-up path: claim markers are
+                            # plain files, visible to a process that was
+                            # paused through the whole takeover the moment
+                            # it resumes — latch any successor epoch so the
+                            # next publish/write-back/snapshot refuses
+                            refresh_fence(lfence, heartbeat_dir(cfg))
                         if monitor is not None:
                             # a preempted host stops heartbeating; the
                             # host_dead row is the external supervisor's
@@ -1379,7 +1467,15 @@ def train_apex(cfg: Config, max_frames: Optional[int] = None) -> Dict[str, Any]:
                         # hosts retry in lockstep too.
                         sup.save_checkpoint(
                             ckpt, step, host_state(driver.state),
+                            # epoch in the extras: a successor's epoch-k+1
+                            # checkpoint outranks the deceased epoch-k
+                            # learner's in-flight save even when the
+                            # zombie's step counter ran ahead
+                            # (Checkpointer._steps_by_epoch); 0 is never
+                            # stamped so the off path stays byte-identical
                             {"frames": frames, "weights_version": driver.weights_version,
+                             **({"learner_epoch": learner_epoch}
+                                if learner_epoch > 0 else {}),
                              **rng_extra(driver.key)},
                         )
                         if rplane is None:
@@ -1413,7 +1509,8 @@ def train_apex(cfg: Config, max_frames: Optional[int] = None) -> Dict[str, Any]:
     sup.save_checkpoint(
         ckpt, driver.step, host_state(driver.state),
         {"frames": frames, "weights_version": driver.weights_version,
-                             **rng_extra(driver.key)}, critical=True,
+         **({"learner_epoch": learner_epoch} if learner_epoch > 0 else {}),
+         **rng_extra(driver.key)}, critical=True,
     )
     if frontier is not None:
         # the final drain may have been skipped by a rollback: catch the

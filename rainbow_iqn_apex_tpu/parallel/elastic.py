@@ -78,6 +78,88 @@ def next_lease_epoch(directory: str, process_id: int) -> int:
             epoch += 1
 
 
+# ----------------------------------------------------------- role epoch claims
+def claim_role_epoch(directory: str, role: str, epoch: int) -> bool:
+    """Claim ``role`` at ``epoch``; exactly ONE of N racing claimants wins.
+
+    The primitive behind learner failover (parallel/failover.py): two hot
+    standbys that both watched the learner's lease expire race to create the
+    SAME ``<role>.e<epoch>`` marker with O_CREAT|O_EXCL — the filesystem
+    picks one winner atomically, the loser re-arms.  Unlike
+    ``next_lease_epoch`` the marker is keyed by ROLE, not host id, because
+    the racers are different processes with different pids claiming one
+    logical role.  Returns True when THIS caller created the marker."""
+    os.makedirs(directory, exist_ok=True)
+    try:
+        fd = os.open(
+            os.path.join(directory, f"{role}.e{int(epoch)}"),
+            os.O_CREAT | os.O_EXCL | os.O_WRONLY,
+        )
+        os.close(fd)
+        return True
+    except FileExistsError:
+        return False
+
+
+def latest_role_epoch(directory: str, role: str) -> int:
+    """Highest epoch ever claimed for ``role`` (-1 when none): the floor a
+    standby must claim ABOVE — claiming ``latest + 1`` can only lose to a
+    sibling standby (re-arm and re-read), never to a dead incarnation."""
+    prefix = f"{role}.e"
+    best = -1
+    try:
+        names = os.listdir(directory)
+    except FileNotFoundError:
+        return best
+    for name in names:
+        if name.startswith(prefix):
+            try:
+                best = max(best, int(name[len(prefix):]))
+            except ValueError:
+                continue
+    return best
+
+
+class StaleEpochError(ValueError):
+    """A publish/write-back stamped with a superseded learner epoch was
+    refused — the zombie fence (docs/RESILIENCE.md "zombie learner")."""
+
+
+class EpochFence:
+    """Monotone learner-epoch latch: the one rule every fenced surface
+    (weight publish, priority write-back, replay-net snapshot, league
+    outbox) shares.  ``observe`` latches the highest epoch ever seen (from
+    leases, mailbox rows, claim markers); ``stale(epoch)`` answers whether a
+    write stamped ``epoch`` names a superseded incarnation and counts the
+    refusal.  With failover off no epoch above 0 ever exists, so ``stale``
+    is identically False and the fenced paths are bitwise the pre-failover
+    behaviour."""
+
+    def __init__(self, epoch: int = 0):
+        self._epoch = int(epoch)
+        self._lock = threading.Lock()
+        self.refusals = 0
+
+    @property
+    def epoch(self) -> int:
+        with self._lock:
+            return self._epoch
+
+    def observe(self, epoch: int) -> int:
+        """Latch ``max(current, epoch)``; returns the latched epoch."""
+        with self._lock:
+            self._epoch = max(self._epoch, int(epoch))
+            return self._epoch
+
+    def stale(self, epoch: int) -> bool:
+        """True — and counted — when ``epoch`` is superseded."""
+        with self._lock:
+            if int(epoch) < self._epoch:
+                self.refusals += 1
+                return True
+            return False
+
+
 # ------------------------------------------------------------- lease writing
 class HeartbeatWriter:
     """Daemon thread re-writing this host's lease file every ``interval_s``.
@@ -223,6 +305,12 @@ class Lease:
     # discovery channel (same rationale as `game`)
     member: Optional[int] = None
     generation: int = -1
+    # learner-failover payload (parallel/failover.py): the learner-role
+    # epoch this incarnation trains under.  Distinct from ``epoch`` (the
+    # HOST incarnation counter): a learner host may respawn many times
+    # (epoch climbs) while the learner ROLE stays at one learner_epoch until
+    # a standby takes over.  Standbys fence takeover claims on it.
+    learner_epoch: int = 0
 
 
 # ---------------------------------------------------------- lease monitoring
@@ -295,6 +383,7 @@ class HeartbeatMonitor:
                 member=(None if payload.get("member") is None
                         else int(payload["member"])),
                 generation=int(payload.get("generation", -1)),
+                learner_epoch=int(payload.get("learner_epoch", 0) or 0),
                 addr=str(payload.get("addr", "") or ""),
                 port=int(payload.get("port", 0) or 0),
             )
@@ -383,8 +472,21 @@ class WeightMailbox:
         self._encoder = None  # created on first publish_params
         self._files: Dict[int, str] = {}  # version -> payload file
 
-    def publish(self, version: int, step: int = 0, **extra: Any) -> None:
+    def publish(self, version: int, step: int = 0,
+                learner_epoch: Optional[int] = None, **extra: Any) -> None:
         os.makedirs(os.path.dirname(self.path) or ".", exist_ok=True)
+        if learner_epoch is not None:
+            # the authoritative cross-process zombie fence: the row ON DISK
+            # carries the epoch that wrote it, and a publish stamped with an
+            # OLDER one (a paused-not-dead learner waking after takeover)
+            # is refused before anything is written.  None (the default)
+            # keeps the pre-failover path byte-for-byte.
+            held = int((self.read() or {}).get("learner_epoch", 0) or 0)
+            if held > int(learner_epoch):
+                raise StaleEpochError(
+                    f"mailbox publish from learner epoch {learner_epoch} "
+                    f"refused: epoch {held} already published")
+            extra = {"learner_epoch": int(learner_epoch), **extra}
         row = {"version": int(version), "step": int(step),
                "ts": round(time.time(), 3), "pub_host": self.host, **extra}
         tmp = self.path + ".tmp"
@@ -404,13 +506,25 @@ class WeightMailbox:
         return os.path.splitext(self.path)[0] + "_payload"
 
     def publish_params(self, params: Any, version: int, step: int = 0,
+                       learner_epoch: Optional[int] = None,
                        **extra: Any) -> Dict[str, Any]:
         """Publish the actual weights as a delta-compressed payload plus the
         version row.  Monotone: a backward/duplicate version raises (the
-        mailbox mirror of FleetRollout's refused_backward).  Returns the
-        row written, with ``bytes`` = the packet's logical wire size."""
+        mailbox mirror of FleetRollout's refused_backward), and a
+        ``learner_epoch`` older than the one already on disk raises
+        `StaleEpochError` BEFORE any payload file is written (the zombie
+        fence — a superseded learner must not clobber the successor's delta
+        chain).  Returns the row written, with ``bytes`` = the packet's
+        logical wire size."""
         from rainbow_iqn_apex_tpu.utils import quantize as quantize_mod
 
+        if learner_epoch is not None:
+            held = int((self.read() or {}).get("learner_epoch", 0) or 0)
+            if held > int(learner_epoch):
+                raise StaleEpochError(
+                    f"mailbox params publish from learner epoch "
+                    f"{learner_epoch} refused: epoch {held} already "
+                    "published")
         if self._encoder is None:
             if self.compression == "int8_delta":
                 self._encoder = quantize_mod.DeltaEncoder(self.base_interval)
@@ -436,7 +550,7 @@ class WeightMailbox:
             except OSError:
                 self._files.pop(v, None)
         self.publish(
-            version, step=step,
+            version, step=step, learner_epoch=learner_epoch,
             payload_kind=packet.kind,
             payload_file=fname,
             base_version=packet.base_version,

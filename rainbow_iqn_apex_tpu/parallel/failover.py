@@ -1,0 +1,350 @@
+"""Epoch-fenced hot-standby learner failover (docs/RESILIENCE.md).
+
+Ape-X centralizes all gradient work in ONE learner (arXiv:1803.00933).
+After the elastic layer every actor self-heals, the replay fabric drops and
+readmits shards, and the replay-net servers readmit — but a dead learner
+host still killed the run: the only recovery was launch_apex.sh's external
+restart loop, which loses the warm replay plane and every downstream
+consumer mid-flight.  This module closes that last single point of failure
+with a standby learner and a learner-role epoch fence:
+
+- `StandbyLearner` tails the active learner's elastic lease (a
+  `HeartbeatMonitor` over the same heartbeat dir).  On lease expiry it
+  claims the learner role at ``learner_epoch + 1`` via the O_EXCL per-epoch
+  claim files (`claim_role_epoch`): two racing standbys resolve to exactly
+  one winner at the filesystem.  The winner runs the injected ``takeover``
+  callback — the jax-heavy half (Checkpointer.restore_latest_valid, the
+  CRC-verified replay snapshot, the resumed train loop) lives in the
+  CALLER, keeping this module jax-free — and the successor publishes
+  weights at versions strictly above the deceased learner's, so
+  `StalenessFence`/`WeightMailbox`/`FleetRollout` consumers converge onto
+  it without adopting anything stale.  The loser emits a reasoned
+  ``failover`` row and re-arms as the NEW learner's standby.
+- **Zombie fencing**: a paused-not-dead learner (GC stall, network
+  partition) that wakes after takeover carries a superseded
+  ``learner_epoch``.  Every publish surface it touches — the driver
+  publish (`QuantPublishMixin.attach_epoch_fence`), mailbox rows
+  (`WeightMailbox.publish(learner_epoch=...)`, authoritative on disk),
+  priority write-backs and replay-net snapshots (replay/net), the league
+  outbox — checks an `EpochFence` (refreshed from the claim markers via
+  `refresh_fence`) and REFUSES with ``failover`` event=fenced_stale
+  instead of clobbering the successor.
+- Standby modes: **cold** (claim -> restore, MTTR measured from the
+  observed death to the takeover callback returning) and **warm**
+  (``failover_warm``: a `MailboxSubscriber` keeps a bit-exact
+  reconstruction of the freshest published params current, handed to the
+  takeover callback so restore only replays the delta since the last
+  checkpoint).
+
+jax-free by construction (analysis/imports.py declares it): the idle
+standby pays no device-runtime import tax.  All behavior is behind the
+default-off ``failover_*`` config; with it off no learner epoch above 0
+ever exists, so every fence check is identically False and the training
+path is bitwise the pre-failover behaviour (tier-1 asserted).
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional
+
+from rainbow_iqn_apex_tpu.parallel.elastic import (
+    EpochFence,
+    HeartbeatMonitor,
+    Lease,
+    MailboxSubscriber,
+    WeightMailbox,
+    claim_role_epoch,
+    heartbeat_dir,
+    latest_role_epoch,
+)
+from rainbow_iqn_apex_tpu.utils import faults
+
+# The one logical role the claim markers are keyed by (``learner.e<k>`` in
+# the heartbeat dir) — role-keyed, not host-keyed, because the racers are
+# different processes with different pids claiming one role.
+LEARNER_ROLE = "learner"
+
+
+def learner_epoch_at_start(cfg) -> int:
+    """The learner-role epoch a STARTING learner claims and trains under.
+
+    With failover off this is identically 0 and nothing is written — the
+    off path stays bitwise.  With failover on the learner claims
+    ``latest + 1`` (first launch: 0) through the same O_EXCL markers the
+    standbys race, so a scheduler double-launch of the learner resolves to
+    two different epochs — the younger one fences the elder's publishes."""
+    if not getattr(cfg, "failover_standby", False):
+        return 0
+    directory = heartbeat_dir(cfg)
+    while True:
+        epoch = latest_role_epoch(directory, LEARNER_ROLE) + 1
+        if claim_role_epoch(directory, LEARNER_ROLE, epoch):
+            return epoch
+
+
+def refresh_fence(fence: EpochFence, directory: str,
+                  role: str = LEARNER_ROLE) -> int:
+    """Latch the highest role epoch ever CLAIMED into ``fence``.
+
+    This is how a zombie learns it was superseded: the claim markers are
+    plain files, visible to a process that was paused through the whole
+    takeover the moment it wakes — no message delivery required.  Returns
+    the latched epoch."""
+    return fence.observe(latest_role_epoch(directory, role))
+
+
+class StandbyLearner:
+    """Tail the learner's lease; claim the role at epoch+1 when it expires.
+
+    Single responsibility split: this class owns detection, the claim race,
+    warm-params tailing and the ``failover`` row surface; the jax-heavy
+    recovery is the injected ``takeover(learner_epoch, warm_params)``
+    callable, which should restore the newest VALID checkpoint
+    (`Checkpointer.restore_latest_valid` — it scans past a torn newest
+    step), restore the replay snapshot, and resume training publishing at
+    versions strictly above the predecessor's.  Its return value is
+    surfaced as ``result["outcome"]``.
+
+    Drive it either inline (``run()`` blocks until takeover) or in the
+    background (``start()``/``stop()``); the mutable standby state is
+    written under ``_lock`` because the background thread and the public
+    surface share it (analysis/locks.py enforces this structurally)."""
+
+    def __init__(self, cfg, takeover: Callable[[int, Optional[Any]], Any],
+                 metrics=None, registry=None,
+                 monitor: Optional[HeartbeatMonitor] = None,
+                 mailbox: Optional[WeightMailbox] = None,
+                 injector: Optional[faults.FaultInjector] = None,
+                 clock: Callable[[], float] = time.monotonic):
+        self.cfg = cfg
+        self.takeover = takeover
+        self.metrics = metrics
+        self.registry = registry
+        self.directory = heartbeat_dir(cfg)
+        self.monitor = monitor if monitor is not None else HeartbeatMonitor(
+            self.directory, cfg.heartbeat_timeout_s,
+            self_id=getattr(cfg, "process_id", None),
+        )
+        self.poll_s = float(getattr(cfg, "failover_poll_s", 0.5))
+        self.warm = bool(getattr(cfg, "failover_warm", False))
+        self._subscriber = (
+            MailboxSubscriber(mailbox, consumer="standby")
+            if self.warm and mailbox is not None else None
+        )
+        self.injector = injector if injector is not None else faults.get()
+        self.clock = clock
+        # the standby's own view of the highest learner epoch in play —
+        # sourced from claim markers AND lease payloads, so it never claims
+        # at or below an epoch it has already seen live
+        self.fence = EpochFence()
+        self.claims_lost = 0
+        self.result: Optional[Dict[str, Any]] = None
+        self._warm_params: Optional[Any] = None
+        self._warm_version = -1
+        self._death_t: Optional[float] = None
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    # ------------------------------------------------------------- emission
+    def _row(self, event: str, **fields: Any) -> None:
+        if self.metrics is not None:
+            self.metrics.log("failover", event=event, **fields)
+
+    # ------------------------------------------------------------ detection
+    def _learner_leases(self) -> List[Lease]:
+        self_id = getattr(self.cfg, "process_id", None)
+        return [
+            lease for lease in self.monitor.leases().values()
+            if lease.role == LEARNER_ROLE and lease.host != self_id
+        ]
+
+    def _poll_warm(self) -> None:
+        if self._subscriber is None:
+            return
+        params = self._subscriber.poll()
+        if params is not None:
+            with self._lock:
+                self._warm_params = params
+                self._warm_version = self._subscriber.version
+
+    # ----------------------------------------------------------- claim race
+    def _attempt_claim(self, now: float) -> Optional[Dict[str, Any]]:
+        floor = max(
+            latest_role_epoch(self.directory, LEARNER_ROLE),
+            self.fence.epoch,
+        )
+        epoch = floor + 1
+        with self._lock:
+            death_t = self._death_t
+        claim_s = None if death_t is None else round(now - death_t, 3)
+        if self.injector.enabled and self.injector.fire("standby_claim"):
+            # manufactured claim failure (a filesystem hiccup mid-O_EXCL):
+            # reasoned row, re-arm — the next poll retries the race
+            self._row("claim", won=False, epoch=epoch, claim_s=claim_s,
+                      reason="injected_fault")
+            return None
+        won = claim_role_epoch(self.directory, LEARNER_ROLE, epoch)
+        self.fence.observe(epoch)
+        if not won:
+            # a sibling standby won the filesystem race: it IS the learner
+            # now — emit the reasoned loser row and go back to standby duty
+            # watching the new incarnation's lease
+            with self._lock:
+                self.claims_lost += 1
+                self._death_t = None
+            self._row("claim", won=False, epoch=epoch, claim_s=claim_s,
+                      reason="lost_race")
+            return None
+        self._row("claim", won=True, epoch=epoch, claim_s=claim_s)
+        # the takeover row lands when the role is WON, before the (possibly
+        # process-lifetime — run_standby's callback IS the resumed train
+        # loop) recovery work: RunHealth degrades the window at the right
+        # moment and the restore row closes the latency split afterwards
+        mttr_s = (None if death_t is None
+                  else round(self.clock() - death_t, 3))
+        self._row("takeover", epoch=epoch, mttr_s=mttr_s, warm=self.warm,
+                  claim_s=claim_s)
+        if self.registry is not None:
+            self.registry.counter("failover_takeovers", "standby").inc()
+            if mttr_s is not None:
+                self.registry.gauge("failover_mttr_s", "standby").set(mttr_s)
+        with self._lock:
+            warm_params = self._warm_params
+            warm_version = self._warm_version
+        t_restore0 = self.clock()
+        outcome = self.takeover(
+            epoch, warm_params if self.warm else None)
+        restore_s = round(self.clock() - t_restore0, 3)
+        self._row("restore", epoch=epoch, restore_s=restore_s,
+                  warm=self.warm, warm_version=warm_version)
+        result = {"epoch": epoch, "mttr_s": mttr_s, "claim_s": claim_s,
+                  "restore_s": restore_s, "warm": self.warm,
+                  "outcome": outcome}
+        with self._lock:
+            self.result = result
+        return result
+
+    # ------------------------------------------------------------ main loop
+    def poll(self) -> Optional[Dict[str, Any]]:
+        """One standby sweep.  Returns the takeover result dict once this
+        standby has taken the role over, None while on standby duty."""
+        with self._lock:
+            if self.result is not None:
+                return self.result
+        self._poll_warm()
+        leases = self._learner_leases()
+        for lease in leases:
+            self.fence.observe(lease.learner_epoch)
+        now = self.clock()
+        if any(lease.fresh for lease in leases):
+            with self._lock:
+                self._death_t = None  # a live learner: nothing to do
+            return None
+        if not leases:
+            return None  # no learner has EVER beaten; absence is not death
+        with self._lock:
+            if self._death_t is None:
+                self._death_t = now
+        return self._attempt_claim(now)
+
+    def run(self, max_wait_s: Optional[float] = None
+            ) -> Optional[Dict[str, Any]]:
+        """Block on standby duty until takeover (returns its result),
+        ``stop()``, or ``max_wait_s`` elapses (returns None)."""
+        t0 = self.clock()
+        while not self._stop.is_set():
+            out = self.poll()
+            if out is not None:
+                return out
+            if max_wait_s is not None and self.clock() - t0 >= max_wait_s:
+                return None
+            self._stop.wait(self.poll_s)
+        return None
+
+    def _run(self) -> None:
+        self.run()
+
+    def start(self) -> "StandbyLearner":
+        if self._thread is None:
+            self._thread = threading.Thread(
+                target=self._run, name="standby-learner", daemon=True)
+            self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=10)
+            self._thread = None
+
+
+def mailbox_path(cfg) -> str:
+    """The run's conventional WeightMailbox location — one path both the
+    publisher (scripts/chaos_soak.py learners) and the warm standby's
+    subscriber derive from cfg, so neither needs a side channel."""
+    return os.path.join(cfg.results_dir, cfg.run_id, "mailbox.json")
+
+
+def run_standby(cfg, max_wait_s: Optional[float] = None) -> Dict[str, Any]:
+    """Process entry for a hot-standby learner (launch_apex.sh --standby,
+    train_agent_apex.py --role standby).
+
+    Tails the learner's lease in this run's heartbeat dir, writes its own
+    ``standby`` lease when heartbeats are on (requires a process_id
+    DISTINCT from the learner's — the lease file is keyed by it), and on
+    takeover re-enters the standard apex entry with ``resume="auto"`` as
+    process 0: `train_apex` claims the NEXT learner-role epoch itself
+    (strictly above both the deceased learner's and this standby's claim
+    marker), restores the newest VALID checkpoint — scanning past a torn
+    newest step — plus the CRC-verified replay snapshot, and resumes
+    publishing strictly above the predecessor.  Warm mode additionally
+    tails the run's mailbox so harnesses that inject their own takeover
+    callback (scripts/chaos_soak.py) start from the freshest publish; the
+    train_apex path restores from the checkpoint either way.
+
+    Returns {"takeover": bool, ...} with the StandbyLearner result fields
+    (epoch/mttr_s/claim_s/restore_s/outcome) when a takeover happened."""
+    from rainbow_iqn_apex_tpu.parallel.elastic import HeartbeatWriter
+    from rainbow_iqn_apex_tpu.utils.logging import MetricsLogger
+
+    run_dir = os.path.join(cfg.results_dir, cfg.run_id)
+    metrics = MetricsLogger(
+        os.path.join(run_dir, "standby.jsonl"), cfg.run_id,
+        echo=False, host=getattr(cfg, "process_id", 0),
+    )
+    faults.install_from(cfg)
+
+    def takeover(epoch: int, warm_params: Optional[Any]) -> Any:
+        # the jax-heavy half, imported only when the role is actually
+        # claimed — the idle standby never pays the device-runtime tax
+        import dataclasses
+
+        from rainbow_iqn_apex_tpu.parallel.apex import train_apex
+
+        return train_apex(
+            dataclasses.replace(cfg, resume="auto", process_id=0))
+
+    mailbox = (WeightMailbox(mailbox_path(cfg))
+               if getattr(cfg, "failover_warm", False) else None)
+    standby = StandbyLearner(cfg, takeover, metrics=metrics, mailbox=mailbox)
+    heartbeat = None
+    if cfg.heartbeat_interval_s > 0 and getattr(cfg, "process_id", 0) != 0:
+        heartbeat = HeartbeatWriter(
+            heartbeat_dir(cfg), cfg.process_id, cfg.heartbeat_interval_s,
+            role="standby",
+        ).start()
+    try:
+        result = standby.run(max_wait_s=max_wait_s)
+    finally:
+        if heartbeat is not None:
+            heartbeat.stop()
+        metrics.close()
+    if result is None:
+        return {"takeover": False, "claims_lost": standby.claims_lost}
+    out = dict(result)
+    out["takeover"] = True
+    return out

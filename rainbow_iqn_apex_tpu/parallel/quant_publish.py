@@ -57,6 +57,12 @@ class QuantPublishMixin:
         self._obs_metrics = None
         self._obs_registry = None
         self._obs_tracer = None
+        # learner-failover fence state (parallel/failover.py): with no fence
+        # attached (every pre-failover run) publish_weights is bitwise the
+        # pre-failover path — the fence check short-circuits on None.
+        self._epoch_fence = None
+        self.learner_epoch = 0
+        self.fenced_publishes = 0
         mode = check_mode(cfg.serve_quantize)
         if mode != "off" and multihost:
             self.quant_disabled_reason = "multihost"
@@ -78,6 +84,16 @@ class QuantPublishMixin:
         self._obs_metrics = metrics
         self._obs_registry = registry
         self._obs_tracer = tracer
+
+    def attach_epoch_fence(self, fence, learner_epoch: int) -> None:
+        """Arm the zombie-learner publish fence (parallel/failover.py): this
+        driver publishes AS ``learner_epoch``; when the shared `EpochFence`
+        has latched a higher epoch (a standby took the role over while this
+        learner was paused, not dead), `publish_weights` refuses instead of
+        broadcasting — the driver-side half of the two-layer fence whose
+        authoritative cross-process half is the `WeightMailbox` disk row."""
+        self._epoch_fence = fence
+        self.learner_epoch = int(learner_epoch)
 
     def wants_calibration(self) -> bool:
         return self.quant_mode != "off" and self._calib_obs is None
@@ -125,6 +141,22 @@ class QuantPublishMixin:
         ``quant_fallback`` row.  ``serve_quantize="off"`` takes exactly the
         pre-quant path."""
         import time as _time
+
+        if (self._epoch_fence is not None
+                and self._epoch_fence.stale(self.learner_epoch)):
+            # zombie fence: a successor claimed the learner role at a higher
+            # epoch while this learner was paused — refusing here keeps the
+            # stale tree off the actor mesh entirely (the mailbox's disk-row
+            # fence would also refuse, but only for out-of-process readers).
+            self.fenced_publishes += 1
+            if self._obs_metrics is not None:
+                self._obs_metrics.log(
+                    "failover", event="fenced_stale", surface="publish",
+                    epoch=self.learner_epoch,
+                    fence_epoch=self._epoch_fence.epoch,
+                    version=self.weights_version,
+                )
+            return self.weights_version
 
         t_pub0 = _time.time()
         p = self.state.params

@@ -591,6 +591,10 @@ class SampleClient:
         self.logger = logger
         self.obs_registry = obs_registry
         self.rng = np.random.default_rng(seed)
+        # failover: when set, update frames carry the learner's role epoch
+        # so shard servers can refuse a superseded (zombie) learner's
+        # write-backs.  None (default) leaves the wire format untouched.
+        self.learner_epoch: Optional[int] = None
         self._dead: set = set()
         self._lock = threading.Lock()
         self._stop = threading.Event()
@@ -778,6 +782,8 @@ class SampleClient:
                 header: Dict[str, Any] = {"op": "update", "arrays": metas}
                 if peer.epoch is not None:
                     header["epoch"] = peer.epoch
+                if self.learner_epoch is not None:
+                    header["learner_epoch"] = self.learner_epoch
                 while len(self._wb_pending) >= self.wb_inflight:
                     self._settle_one_wb()
                 try:

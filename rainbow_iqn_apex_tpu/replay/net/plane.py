@@ -73,6 +73,11 @@ class RemoteReplayPlane:
         self.sampler: Optional[SampleClient] = None
         self._appenders: Dict[int, AppendClient] = {}
         self._append_active = False
+        # failover: the learner's role epoch, stamped into update + snapshot
+        # frames so shard servers latch the highest seen and refuse a
+        # superseded (zombie) learner.  None = unstamped, the pre-failover
+        # wire format byte for byte.
+        self.learner_epoch: Optional[int] = None
         self.shed_lanes = 0  # append rows shed for lack of an alive owner
         self._last_stats = time.monotonic()
         self.discover()
@@ -177,7 +182,19 @@ class RemoteReplayPlane:
             wb_inflight=max(int(getattr(cfg, "writeback_depth", 2)), 1),
             seed=int(getattr(cfg, "seed", 0)),
             logger=self.metrics, obs_registry=self.obs_registry)
+        if self.learner_epoch is not None:
+            self.sampler.learner_epoch = self.learner_epoch
         return self.sampler
+
+    def set_learner_epoch(self, epoch: int) -> None:
+        """Arm the failover epoch stamp: every subsequent priority
+        write-back and snapshot request carries ``learner_epoch`` so the
+        shard servers' latch can refuse frames from a learner this one
+        superseded (and, symmetrically, refuse THIS learner once a
+        successor claims a higher epoch)."""
+        self.learner_epoch = int(epoch)
+        if self.sampler is not None:
+            self.sampler.learner_epoch = self.learner_epoch
 
     def make_prefetcher(self, batch_size: int, beta_fn: Callable[[], float],
                         to_device: Callable[[Any], Any], registry=None):
@@ -225,12 +242,14 @@ class RemoteReplayPlane:
         learner's checkpoint ``step``.  Returns how many acked; failures
         are logged, not raised (a dead peer snapshots when it readmits)."""
         ok = 0
+        header: Dict[str, Any] = {"op": "snapshot", "step": int(step)}
+        if self.learner_epoch is not None:
+            header["learner_epoch"] = self.learner_epoch
         for pid, peer in list(self.peers.items()):
             if self.sampler is not None and pid in self.sampler.dead_peers():
                 continue
             try:
-                peer.request({"op": "snapshot", "step": int(step)},
-                             timeout_s=30.0)
+                peer.request(dict(header), timeout_s=30.0)
                 ok += 1
             except Exception as e:
                 self._log("snapshot_failed", server=pid,

@@ -126,6 +126,15 @@ class ReplayShardServer:
         self.samples_served = 0
         self.updates_applied = 0
         self.snapshot_step = -1
+        # learner-role epoch latch (parallel/failover.py): priority
+        # write-backs and snapshot requests stamped by a SUPERSEDED learner
+        # incarnation are refused — the step fence below grown an epoch
+        # dimension.  -1 = no failover-armed learner ever wrote; unstamped
+        # frames (every pre-failover client) always pass, so the off path
+        # is bitwise intact.  Persisted beside the snapshot step so a
+        # restarted server cannot be rolled back by a patient zombie.
+        self.learner_epoch = -1
+        self.fenced_learner_writes = 0
         # advisory piggyback state: written by the worker after each memory
         # op, read (under the lock) by every reply — the event loop never
         # touches the un-thread-safe memory itself
@@ -392,6 +401,27 @@ class ReplayShardServer:
         epoch = header.get("epoch")
         return epoch is not None and int(epoch) != self.epoch
 
+    def _stale_learner(self, header: Dict[str, Any]) -> bool:
+        """True when the frame's ``learner_epoch`` stamp names a SUPERSEDED
+        learner incarnation (the zombie fence — docs/RESILIENCE.md "zombie
+        learner").  Unstamped frames pass; a NEWER stamp latches (and
+        persists) the new floor, so once the successor's first write lands
+        the predecessor is refused forever, restarts included."""
+        le = header.get("learner_epoch")
+        if le is None:
+            return False
+        le = int(le)
+        if le < self.learner_epoch:
+            self.fenced_learner_writes += 1
+            self._log("stale_learner", learner_epoch=le,
+                      latched=self.learner_epoch)
+            return True
+        if le > self.learner_epoch:
+            self.learner_epoch = le
+            if self.snapshot_prefix is not None:
+                self._write_learner_epoch(le)
+        return False
+
     def _do_append(self, conn: _Conn, rid: Any, header: Dict[str, Any],
                    blob: bytes) -> None:
         if self._fenced(header):
@@ -451,6 +481,11 @@ class ReplayShardServer:
             self._reply(conn, {"op": "ack", "rid": rid, "ok": False,
                                "fenced": True})
             return
+        if self._stale_learner(header):
+            self.fenced_updates += 1
+            self._reply(conn, {"op": "ack", "rid": rid, "ok": False,
+                               "fenced": True, "stale_learner": True})
+            return
         arrays = protocol.decode_arrays(header.get("arrays", ()), blob)
         self.memory.update_priorities(
             arrays["idx"] - self.slot_base,  # back to this block's ids
@@ -465,6 +500,17 @@ class ReplayShardServer:
             self._reply(conn, {"op": "rerr", "rid": rid,
                                "etype": "unsupported",
                                "msg": "server has no snapshot prefix"})
+            return
+        if self._stale_learner(header):
+            # a zombie's snapshot request must not overwrite the shard
+            # block's on-disk state with its stale view — refused even when
+            # its step counter ran AHEAD of the successor's (the step fence
+            # below cannot catch that case; the epoch dimension can)
+            self._reply(conn, {"op": "rerr", "rid": rid,
+                               "etype": "stale_fence",
+                               "msg": f"snapshot from superseded learner "
+                                      f"epoch {header.get('learner_epoch')} "
+                                      f"(latched {self.learner_epoch})"})
             return
         if step < self.snapshot_step:
             # the learner's checkpoint step is the fence: a replayed or
@@ -492,11 +538,28 @@ class ReplayShardServer:
             f.write(str(int(step)))
         os.replace(tmp, self._step_path())
 
+    def _learner_epoch_path(self) -> str:
+        return f"{self.snapshot_prefix}_learner_epoch"
+
+    def _write_learner_epoch(self, epoch: int) -> None:
+        tmp = self._learner_epoch_path() + ".tmp"
+        with open(tmp, "w") as f:
+            f.write(str(int(epoch)))
+        os.replace(tmp, self._learner_epoch_path())
+
     def _maybe_restore(self) -> None:
         """Restore this server's shard block from its own snapshot (the
         server-side resume path: the learner checkpoint carries no replay
         payload when the plane is on).  Missing/torn snapshots read as
         'cold start' — the epoch fence already guards the semantics."""
+        try:
+            # the learner-epoch latch restores INDEPENDENTLY of the replay
+            # payload: a cold-started shard block must still refuse a
+            # patient zombie's write-backs
+            with open(self._learner_epoch_path()) as f:
+                self.learner_epoch = int(f.read().strip() or -1)
+        except (OSError, ValueError):
+            pass
         try:
             self.memory.restore(self.snapshot_prefix)
         except FileNotFoundError:
@@ -524,4 +587,6 @@ class ReplayShardServer:
                 "fenced_updates": self.fenced_updates,
                 "samples_served": self.samples_served,
                 "updates_applied": self.updates_applied,
-                "snapshot_step": self.snapshot_step}
+                "snapshot_step": self.snapshot_step,
+                "learner_epoch": self.learner_epoch,
+                "fenced_learner_writes": self.fenced_learner_writes}

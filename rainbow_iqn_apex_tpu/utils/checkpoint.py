@@ -59,6 +59,14 @@ class Checkpointer:
                 state=ocp.args.StandardSave(state),
                 extra=ocp.args.JsonSave(extra or {}),
             ),
+            # force: Orbax's should_save silently SKIPS any step at or below
+            # latest_step.  A failover successor restores an epoch-ranked
+            # OLDER step and re-saves below the zombie predecessor's
+            # in-flight high-water mark — those saves must land
+            # (``_steps_by_epoch`` orders restores by epoch, not step).
+            # Same-step overwrites are already returned above, so force
+            # never clobbers an existing committed cut.
+            force=True,
         )
 
     def wait(self) -> None:
@@ -95,7 +103,7 @@ class Checkpointer:
         return None if out is None or out[0] is None else out
 
     def _restore_newest_valid(self, abstract_state: Optional[TrainState]):
-        for step in sorted(self._mngr.all_steps(), reverse=True):
+        for step in self._steps_by_epoch():
             try:
                 if abstract_state is None:
                     extra = self.restore_extra(step)
@@ -105,6 +113,28 @@ class Checkpointer:
             except Exception:  # corrupt/torn step: fall back to the previous
                 continue
         return None
+
+    def _steps_by_epoch(self) -> Tuple[int, ...]:
+        """Candidate steps ordered newest-first by (learner_epoch, step).
+
+        Learner failover (parallel/failover.py) stamps ``learner_epoch``
+        into the extras; ordering on it FIRST means a successor's epoch-k+1
+        checkpoint outranks the deceased epoch-k learner's in-flight save
+        even when the zombie's step counter ran ahead — the successor can
+        never be outranked by its predecessor.  Checkpoints without the
+        stamp (every pre-failover run) read as epoch 0, so the order
+        degenerates to plain step-descending — the seed behaviour."""
+        def epoch_of(step: int) -> int:
+            try:
+                return int(self.restore_extra(step).get("learner_epoch", 0))
+            except Exception:  # torn side-car: rank lowest, still a candidate
+                return -1
+
+        steps = sorted(self._mngr.all_steps(), reverse=True)
+        if len(steps) < 2:
+            return tuple(steps)
+        return tuple(sorted(steps, key=lambda s: (epoch_of(s), s),
+                            reverse=True))
 
     def refresh(self) -> Optional[int]:
         """Re-read the step list from disk and return the latest step.

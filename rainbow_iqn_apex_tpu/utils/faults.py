@@ -45,6 +45,10 @@ POINTS = (
     "lease_lost",  # a LIVE process stops renewing its lease (zombie / split
     # brain: the incarnation epoch fencing exists for)
     "shard_rejoin",  # shard readmission fails once (re-registration raced)
+    "learner_exit",  # the LEARNER process exits mid-run (the last single
+    # point of failure; a live standby claims the role — failover)
+    "standby_claim",  # a standby's takeover claim attempt fails once
+    # (filesystem hiccup mid-O_EXCL; the standby re-arms and re-claims)
 )
 
 ENV_VAR = "RIA_FAULTS"

@@ -65,6 +65,10 @@ REPORTED = {
     # loopback socket throughput is machine weather — promote to GATED
     # once a few rounds exist
     "replay_net_path": "ratio_vs_host",
+    # learner-failover MTTR is deliberately report-only (ISSUE 17): kill->
+    # first-successor-publish latency is process-start machine weather; the
+    # trajectory records it so a regression SHOWS without gating on it
+    "failover_mttr": "value",
 }
 
 

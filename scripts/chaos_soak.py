@@ -51,6 +51,22 @@ The harness asserts, from its own JSONL (exit 0 only if ALL hold):
 
 `make soak-smoke` runs this at --frames 2000; the `chaos`-marked tier-1 test
 (tests/test_elastic.py) runs a smaller budget.
+
+Learner failover (`--kill-learner`, `make failover-smoke`): a second
+topology exercising parallel/failover.py with real processes — a jax-free
+toy learner child (deterministic per-step state evolution, CRC'd toy
+checkpoints, real `WeightMailbox.publish_params` stamped with its claimed
+learner epoch, a `learner`-role lease) is SIGKILLed mid-run while a live
+standby child (`StandbyLearner` with an injected toy-restore takeover)
+tails its lease.  The parent deliberately tears the newest toy checkpoint
+(the write the learner died mid-way through) so the takeover must restore
+PAST it.  Gates: the standby claims within the lease timeout (plus
+detection cadence), mailbox weight versions are strictly monotone across
+the takeover, zero stale adoptions (every adoption digest-checked against
+the publisher's own reconstruction), the successor's post-takeover state
+is bitwise equal to a plain kill->resume replay from the same checkpoint,
+and the whole run dir lints.  Emits one report-only ``failover_mttr``
+bench row (scripts/bench_diff.py REPORTED).
 """
 
 from __future__ import annotations
@@ -654,6 +670,489 @@ def soak_main(args) -> int:
     return 0 if summary["ok"] else 1
 
 
+# ------------------------------------------------------- learner failover
+# A toy learner whose whole state is a pure function of (checkpoint, step):
+# each step perturbs the params with a PER-STEP seeded rng, so replaying
+# from any checkpoint reproduces the exact bytes — the yardstick for the
+# "post-takeover step bitwise equal to plain kill->resume" gate.
+
+
+def toy_params(seed: int) -> dict:
+    rng = np.random.default_rng(seed)
+    return {"b": rng.standard_normal(8).astype(np.float32),
+            "w": rng.standard_normal((8, 8)).astype(np.float32)}
+
+
+def toy_step(params: dict, step: int, seed: int) -> None:
+    rng = np.random.default_rng(seed * 1_000_003 + step)
+    for name in sorted(params):
+        params[name] = (params[name] + 0.01 * rng.standard_normal(
+            params[name].shape).astype(np.float32))
+
+
+def toy_save(run_dir: str, step: int, params: dict) -> str:
+    """Atomic digest-stamped toy checkpoint (tmp+rename; float32 round-trips
+    json exactly, so restore is bitwise)."""
+    d = os.path.join(run_dir, "toyckpt")
+    os.makedirs(d, exist_ok=True)
+    body = {"step": int(step),
+            "digest": params_digest(params),
+            "params": {k: np.asarray(v, np.float32).tolist()
+                       for k, v in sorted(params.items())}}
+    path = os.path.join(d, f"ck_{step:08d}.json")
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(body, f)
+    os.replace(tmp, path)
+    return path
+
+
+def toy_restore(run_dir: str):
+    """Newest VALID toy checkpoint, scanning past torn/corrupt newer files —
+    the `Checkpointer.restore_latest_valid` contract in miniature."""
+    d = os.path.join(run_dir, "toyckpt")
+    try:
+        names = sorted(os.listdir(d), reverse=True)
+    except FileNotFoundError:
+        return None
+    for name in names:
+        if not name.startswith("ck_") or not name.endswith(".json"):
+            continue
+        path = os.path.join(d, name)
+        try:
+            with open(path) as f:
+                body = json.load(f)
+            params = {k: np.asarray(v, np.float32)
+                      for k, v in body["params"].items()}
+            if params_digest(params) != body["digest"]:
+                continue  # corrupt payload: keep scanning older
+            return {"step": int(body["step"]), "params": params,
+                    "path": path}
+        except (OSError, ValueError, KeyError):
+            continue  # torn file: keep scanning older
+    return None
+
+
+def _toy_cfg(args):
+    from rainbow_iqn_apex_tpu.config import Config
+
+    return Config(
+        results_dir=os.path.dirname(args.dir),
+        run_id=os.path.basename(args.dir),
+        seed=args.seed,
+        failover_standby=True,
+        failover_poll_s=max(args.tick_s, 0.02),
+        heartbeat_interval_s=args.hb_interval,
+        heartbeat_timeout_s=args.hb_timeout,
+        process_id=args.host,
+    )
+
+
+def learner_main(args) -> int:
+    """The toy learner child: claims a learner-role epoch through the REAL
+    O_EXCL markers, leases as role=learner, publishes epoch-stamped params
+    through the real mailbox, checkpoints every --ckpt-every steps."""
+    from rainbow_iqn_apex_tpu.parallel.elastic import (
+        HeartbeatWriter,
+        MailboxSubscriber,
+        StaleEpochError,
+        WeightMailbox,
+    )
+    from rainbow_iqn_apex_tpu.parallel.failover import (
+        LEARNER_ROLE,
+        learner_epoch_at_start,
+        mailbox_path,
+    )
+    from rainbow_iqn_apex_tpu.utils.logging import MetricsLogger
+
+    cfg = _toy_cfg(args)
+    injector = faults.FaultInjector(
+        os.environ.get(faults.ENV_VAR, ""), seed=args.seed)
+    epoch = learner_epoch_at_start(cfg)
+    hb = HeartbeatWriter(
+        os.path.join(args.dir, "heartbeats"), args.host, args.hb_interval,
+        role=LEARNER_ROLE,
+    )
+    hb.update_payload(learner_epoch=epoch)
+    hb.start()
+    metrics = MetricsLogger(
+        os.path.join(args.dir, f"learner_e{epoch}.jsonl"),
+        run_id=args.run_id, echo=False, host=args.host,
+    )
+    metrics.log("failover", event="claim", won=True, epoch=epoch,
+                source="learner_start")
+    mailbox = WeightMailbox(mailbox_path(cfg), host=args.host)
+    # the publisher's own reference reconstruction (same decode path every
+    # consumer runs) is the digest ground truth the harness checks against
+    ref_sub = MailboxSubscriber(mailbox)
+    restored = toy_restore(args.dir)
+    step = restored["step"] if restored else 0
+    params = restored["params"] if restored else toy_params(args.seed)
+    version = mailbox.version()  # disk floor: strictly above any predecessor
+    rc = 0
+    for _ in range(args.max_ticks):
+        if injector.enabled and injector.fire("learner_exit"):
+            metrics.log("fault", event="learner_exit", step=step)
+            metrics.close()
+            os._exit(3)  # the kill: no flush, no lease farewell
+        step += 1
+        toy_step(params, step, args.seed)
+        if step % args.ckpt_every == 0:
+            toy_save(args.dir, step, params)
+        if step % args.publish_every == 0:
+            version += 1
+            try:
+                row = mailbox.publish_params(
+                    dict(params), version, step=step, learner_epoch=epoch)
+            except StaleEpochError:
+                # a successor claimed a higher epoch while this learner was
+                # paused: the zombie fence — refuse to clobber, stand down
+                metrics.log("failover", event="fenced_stale",
+                            surface="mailbox", epoch=epoch)
+                rc = 4
+                break
+            ref = ref_sub.poll()
+            metrics.log("publish", version=version, step=step,
+                        bytes=int(row.get("bytes", 0) or 0),
+                        digest=params_digest(ref) if ref is not None
+                        else None,
+                        epoch=epoch)
+        time.sleep(args.tick_s)
+    hb.stop()
+    metrics.close()
+    return rc
+
+
+def standby_child_main(args) -> int:
+    """The standby child: a REAL `StandbyLearner` tailing the learner's
+    lease, with the jax-heavy takeover replaced by the toy restore+replay
+    (the injected-callback seam run_standby documents for harnesses)."""
+    from rainbow_iqn_apex_tpu.parallel.elastic import (
+        MailboxSubscriber,
+        StaleEpochError,
+        WeightMailbox,
+    )
+    from rainbow_iqn_apex_tpu.parallel.failover import (
+        StandbyLearner,
+        mailbox_path,
+    )
+    from rainbow_iqn_apex_tpu.utils.logging import MetricsLogger
+
+    cfg = _toy_cfg(args)
+    faults.install(faults.FaultInjector(
+        os.environ.get(faults.ENV_VAR, ""), seed=args.seed))
+    metrics = MetricsLogger(
+        os.path.join(args.dir, f"standby_h{args.host}.jsonl"),
+        run_id=args.run_id, echo=False, host=args.host,
+    )
+    mailbox = WeightMailbox(mailbox_path(cfg), host=args.host)
+    ref_sub = MailboxSubscriber(mailbox)
+
+    def takeover(epoch: int, warm_params):
+        # restore the newest VALID toy checkpoint (scanning past the
+        # parent's deliberately torn newest), replay the deterministic
+        # evolution forward, publish strictly above the predecessor with
+        # the NEW learner epoch stamped
+        restored = toy_restore(args.dir)
+        step = restored["step"] if restored else 0
+        params = (restored["params"] if restored
+                  else toy_params(args.seed))
+        version = mailbox.version()
+        fenced = 0
+        for _ in range(args.post_steps):
+            step += 1
+            toy_step(params, step, args.seed)
+            if step % args.ckpt_every == 0:
+                toy_save(args.dir, step, params)
+            if step % args.publish_every == 0:
+                version += 1
+                try:
+                    row = mailbox.publish_params(
+                        dict(params), version, step=step,
+                        learner_epoch=epoch)
+                except StaleEpochError:
+                    fenced += 1
+                    metrics.log("failover", event="fenced_stale",
+                                surface="mailbox", epoch=epoch)
+                    continue
+                ref = ref_sub.poll()
+                metrics.log("publish", version=version, step=step,
+                            bytes=int(row.get("bytes", 0) or 0),
+                            digest=params_digest(ref) if ref is not None
+                            else None,
+                            epoch=epoch)
+            time.sleep(args.tick_s)
+        return {"restored_step": restored["step"] if restored else 0,
+                "restored_path": restored["path"] if restored else None,
+                "final_step": step, "final_version": version,
+                "final_digest": params_digest(params), "fenced": fenced}
+
+    standby = StandbyLearner(cfg, takeover, metrics=metrics)
+    result = standby.run(max_wait_s=args.deadline_s)
+    out = {"takeover": result is not None,
+           "claims_lost": standby.claims_lost}
+    if result is not None:
+        out.update(result)
+        if isinstance(result.get("outcome"), dict):
+            out.update(result["outcome"])  # flatten for the parent's gates
+    tmp = os.path.join(args.dir, f"standby_result_h{args.host}.json.tmp")
+    with open(tmp, "w") as f:
+        json.dump(out, f, indent=2)
+    os.replace(tmp, tmp[:-4])
+    metrics.close()
+    faults.install(None)
+    return 0 if result is not None else 1
+
+
+def failover_main(args) -> int:
+    import signal
+    import subprocess
+
+    from rainbow_iqn_apex_tpu.obs.health import RunHealth
+    from rainbow_iqn_apex_tpu.obs.registry import MetricRegistry
+    from rainbow_iqn_apex_tpu.parallel.elastic import (
+        MailboxSubscriber,
+        WeightMailbox,
+    )
+    from rainbow_iqn_apex_tpu.utils.logging import MetricsLogger
+
+    run_id = f"failover_{args.seed}"
+    run_dir = os.path.join(args.out, "results", run_id)
+    os.makedirs(run_dir, exist_ok=True)
+    metrics = MetricsLogger(
+        os.path.join(run_dir, "metrics.jsonl"), run_id=run_id,
+        echo=not args.quiet, host=0,
+    )
+    registry = MetricRegistry()
+    health = RunHealth(registry, metrics, role="failover")
+    metrics.add_observer(health.observe_row)
+
+    env = dict(os.environ)
+    env["PYTHONPATH"] = _REPO + os.pathsep + env.get("PYTHONPATH", "")
+    standby_host = 9
+
+    def spawn(flag: str, host: int, spec: str = "") -> "subprocess.Popen":
+        argv = [
+            sys.executable, os.path.abspath(__file__), flag,
+            "--dir", run_dir, "--run-id", run_id,
+            "--host", str(host), "--seed", str(args.seed),
+            "--hb-interval", str(args.hb_interval),
+            "--hb-timeout", str(args.hb_timeout),
+            "--tick-s", str(args.tick_s),
+            "--publish-every", str(args.publish_every),
+            "--ckpt-every", str(args.ckpt_every),
+            "--post-steps", str(args.post_steps),
+            "--deadline-s", str(args.deadline_s),
+            "--max-ticks", "100000",
+        ]
+        child_env = dict(env)
+        child_env[faults.ENV_VAR] = spec
+        return subprocess.Popen(argv, env=child_env,
+                                stdout=subprocess.DEVNULL,
+                                stderr=subprocess.STDOUT)
+
+    learner = spawn("--learner", 0)
+    # the standby's FIRST claim attempt is poisoned (standby_claim point):
+    # the re-arm/re-claim path is part of every smoke, not just the tests
+    standby = spawn("--standby-child", standby_host, spec="standby_claim@1")
+
+    mailbox = WeightMailbox(os.path.join(run_dir, "mailbox.json"), host=0)
+    sub = MailboxSubscriber(mailbox, consumer="harness")
+    version_seq: list = []   # every observed mailbox version change
+    adopted: dict = {}       # version -> harness reconstruction digest
+    t_kill = None
+    kill_version = None
+    first_succ_pub_t = None
+    result_path = os.path.join(run_dir,
+                               f"standby_result_h{standby_host}.json")
+    deadline = time.monotonic() + args.deadline_s
+    last_health = {"status": "none"}
+    try:
+        while time.monotonic() < deadline:
+            v = mailbox.version()
+            if v >= 0 and (not version_seq or v != version_seq[-1]):
+                version_seq.append(v)
+                if (t_kill is not None and first_succ_pub_t is None
+                        and v > (kill_version or -1)):
+                    first_succ_pub_t = time.monotonic()
+            params = sub.poll()
+            if params is not None:
+                adopted[sub.version] = params_digest(params)
+            if t_kill is None and v >= args.kill_after_version:
+                kill_version = v
+                metrics.log("fault", event="learner_killed", version=v)
+                learner.send_signal(signal.SIGKILL)
+                learner.wait()
+                t_kill = time.monotonic()
+                # tear the newest toy checkpoint — the write the learner
+                # died mid-way through; the takeover must restore PAST it
+                d = os.path.join(run_dir, "toyckpt")
+                names = (sorted(os.listdir(d), reverse=True)
+                         if os.path.isdir(d) else [])
+                if names:
+                    torn = os.path.join(d, names[0])
+                    with open(torn, "r+") as f:
+                        f.truncate(max(os.path.getsize(torn) // 2, 1))
+            if (t_kill is not None and os.path.exists(result_path)
+                    and standby.poll() is not None):
+                break
+            time.sleep(args.tick_s)
+        # drain: the successor's last publishes may still be in flight
+        for _ in range(20):
+            v = mailbox.version()
+            if v >= 0 and (not version_seq or v != version_seq[-1]):
+                version_seq.append(v)
+            params = sub.poll()
+            if params is not None:
+                adopted[sub.version] = params_digest(params)
+            time.sleep(args.tick_s)
+        health.tick(0, 0)
+        time.sleep(args.tick_s)
+        last_health = health.tick(1, 0)
+    finally:
+        for child in (learner, standby):
+            if child.poll() is None:
+                child.kill()
+                child.wait()
+        metrics.close()
+
+    # ------------------------------------------------------------- gates
+    failures = []
+    res: dict = {}
+    if os.path.exists(result_path):
+        with open(result_path) as f:
+            res = json.load(f)
+    if not res.get("takeover"):
+        failures.append("standby never took the learner role over")
+    if t_kill is None:
+        failures.append("the learner was never killed (no publishes seen)")
+    mttr_value = (round(first_succ_pub_t - t_kill, 3)
+                  if (t_kill is not None and first_succ_pub_t is not None)
+                  else None)
+    if mttr_value is None:
+        failures.append("no successor publish after the kill")
+    else:
+        # the claim must land within the lease timeout plus detection
+        # cadence and the (injected) one-attempt re-arm; the bound is the
+        # RESILIENCE.md MTTR decomposition with generous process-start slack
+        bound = args.hb_timeout + 10.0
+        if mttr_value > bound:
+            failures.append(f"kill->first successor publish took "
+                            f"{mttr_value}s > {bound}s")
+    if any(b <= a for a, b in zip(version_seq, version_seq[1:])):
+        failures.append(f"mailbox versions not strictly monotone across "
+                        f"takeover: {version_seq}")
+    # zero stale adoptions: every version the harness subscriber adopted
+    # must match the publisher's own reference reconstruction digest
+    published: dict = {}
+    for name in sorted(os.listdir(run_dir)):
+        if not ((name.startswith("learner_e")
+                 or name.startswith("standby_h"))
+                and name.endswith(".jsonl")):
+            continue
+        for line in open(os.path.join(run_dir, name)):
+            try:
+                row = json.loads(line)
+            except ValueError:
+                continue
+            if row.get("kind") == "publish" and row.get("digest"):
+                published[int(row["version"])] = row["digest"]
+    if not adopted:
+        failures.append("the harness subscriber never adopted any publish")
+    for v, digest in sorted(adopted.items()):
+        want = published.get(v)
+        if want is None:
+            failures.append(f"adopted version {v} was never published "
+                            "(stale adoption)")
+        elif digest != want:
+            failures.append(f"adoption of v{v} not bit-exact "
+                            f"({digest} != {want})")
+    # bitwise gate: plain kill->resume replay from the SAME checkpoint the
+    # successor restored must land on the same bytes
+    if res.get("takeover"):
+        if res.get("restored_path") is None:
+            failures.append("the takeover restored no checkpoint (the torn "
+                            "newest should have older valid siblings)")
+        else:
+            with open(res["restored_path"]) as f:
+                body = json.load(f)
+            replay = {k: np.asarray(vv, np.float32)
+                      for k, vv in body["params"].items()}
+            for s in range(int(body["step"]) + 1,
+                           int(res["final_step"]) + 1):
+                toy_step(replay, s, args.seed)
+            if params_digest(replay) != res.get("final_digest"):
+                failures.append(
+                    "post-takeover state diverged from plain kill->resume "
+                    f"({params_digest(replay)} != {res.get('final_digest')})")
+        if res.get("fenced", 0):
+            failures.append(f"the successor's own publishes were fenced "
+                            f"{res['fenced']}x (epoch ordering broke)")
+    # the standby's injected first-claim failure must have left a reasoned
+    # loser row before the re-claim won
+    injected_claim_rows = 0
+    standby_jsonl = os.path.join(run_dir, f"standby_h{standby_host}.jsonl")
+    if os.path.exists(standby_jsonl):
+        for line in open(standby_jsonl):
+            try:
+                row = json.loads(line)
+            except ValueError:
+                continue
+            if (row.get("kind") == "failover" and row.get("event") == "claim"
+                    and not row.get("won")
+                    and row.get("reason") == "injected_fault"):
+                injected_claim_rows += 1
+    if res.get("takeover") and injected_claim_rows == 0:
+        failures.append("the injected standby_claim failure left no "
+                        "reasoned claim row (the re-arm path is silent)")
+
+    from scripts.lint_jsonl import lint_file  # noqa: E402
+
+    lint_errors = []
+    for name in sorted(os.listdir(run_dir)):
+        if name.endswith(".jsonl"):
+            lint_errors += lint_file(os.path.join(run_dir, name))
+    if lint_errors:
+        failures.append(f"lint errors: {lint_errors[:5]}")
+
+    # report-only bench row (scripts/bench_diff.py REPORTED): MTTR is
+    # machine-weather, never gated on trajectory
+    bench = {
+        "path": "failover_mttr",
+        "metric": "failover_mttr_s",
+        "value": mttr_value,
+        "unit": "s",
+        "claim_s": res.get("claim_s"),
+        "restore_s": res.get("restore_s"),
+        "mttr_detect_s": res.get("mttr_s"),
+    }
+    if failures:
+        bench["status"] = "gate_failed"
+    print(json.dumps(bench))
+    summary = {
+        "ok": not failures,
+        "takeover": bool(res.get("takeover")),
+        "epoch": res.get("epoch"),
+        "mttr_s": mttr_value,
+        "claim_s": res.get("claim_s"),
+        "restore_s": res.get("restore_s"),
+        "versions": version_seq,
+        "adoptions": len(adopted),
+        "restored_step": res.get("restored_step"),
+        "final_step": res.get("final_step"),
+        "final_health": last_health.get("status"),
+        "failures": failures,
+    }
+    with open(os.path.join(run_dir, "failover_summary.json"), "w") as f:
+        json.dump(summary, f, indent=2)
+    print(json.dumps(summary, indent=2) if args.json else (
+        f"failover_smoke: {'OK' if summary['ok'] else 'FAILED'} "
+        f"mttr_s={mttr_value} versions={version_seq} "
+        f"adoptions={len(adopted)}"
+        + "".join(f"\n  FAIL {f}" for f in failures)))
+    return 0 if summary["ok"] else 1
+
+
 def parse_args(argv=None):
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("--frames", type=int, default=2000,
@@ -692,8 +1191,22 @@ def parse_args(argv=None):
     ap.add_argument("--hb-interval", type=float, default=0.05)
     ap.add_argument("--hb-timeout", type=float, default=0.3)
     ap.add_argument("--tick-s", type=float, default=0.01)
+    # learner failover smoke (--kill-learner; make failover-smoke)
+    ap.add_argument("--kill-learner", action="store_true",
+                    help="learner-failover smoke: SIGKILL the toy learner "
+                         "mid-run with a live standby and gate the takeover "
+                         "(docs/RESILIENCE.md 'learner failover')")
+    ap.add_argument("--kill-after-version", type=int, default=4,
+                    help="mailbox version at which the learner is killed")
+    ap.add_argument("--ckpt-every", type=int, default=2,
+                    help=argparse.SUPPRESS)
+    ap.add_argument("--post-steps", type=int, default=30,
+                    help=argparse.SUPPRESS)
     # internal: actor-child mode
     ap.add_argument("--actor", action="store_true", help=argparse.SUPPRESS)
+    ap.add_argument("--learner", action="store_true", help=argparse.SUPPRESS)
+    ap.add_argument("--standby-child", action="store_true",
+                    help=argparse.SUPPRESS)
     ap.add_argument("--dir", help=argparse.SUPPRESS)
     ap.add_argument("--run-id", default="soak", help=argparse.SUPPRESS)
     ap.add_argument("--host", type=int, default=1, help=argparse.SUPPRESS)
@@ -712,6 +1225,12 @@ def main(argv=None) -> int:
     args = parse_args(argv)
     if args.actor:
         return actor_main(args)
+    if args.learner:
+        return learner_main(args)
+    if args.standby_child:
+        return standby_child_main(args)
+    if args.kill_learner:
+        return failover_main(args)
     return soak_main(args)
 
 

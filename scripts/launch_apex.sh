@@ -14,7 +14,22 @@
 # monitor has long since dropped its shard and will readmit it on the next
 # successful relaunch (`host_alive` -> `shard_readmit`).  Disable with
 # RIA_RESPAWN_ATTEMPTS=0 for a scheduler that does its own restarts.
+#
+# Learner failover (docs/RESILIENCE.md "learner failover"): `--standby`
+# launches a hot-standby learner INSTEAD of the blind restart loop — it
+# tails the learner's lease (parallel/failover.py) and claims the learner
+# role the moment the lease expires, restoring `--resume auto` at the next
+# learner epoch, so the fleet converges onto the successor instead of
+# waiting out the backoff ladder.  Run it on a second host with the same
+# GAME/RUN_ID; the learner itself must run with --failover-standby so its
+# publishes carry a fencable epoch.
 set -euo pipefail
+
+STANDBY=0
+if [[ "${1:-}" == "--standby" ]]; then
+  STANDBY=1
+  shift
+fi
 
 GAME="${1:-Pong}"
 RUN_ID="${2:-apex_$(date +%s)}"
@@ -34,6 +49,20 @@ run_once() {
     --resume auto \
     "${@}"
 }
+
+if (( STANDBY )); then
+  # the standby is its own supervisor: it blocks on the learner's lease and
+  # takes the role over in-process — no respawn loop wraps it.  A distinct
+  # --process-id keeps its lease file from clobbering the learner's.
+  exec python train_agent_apex.py \
+    --role standby \
+    --env-id "atari:${GAME}" \
+    --run-id "${RUN_ID}" \
+    --failover-standby \
+    --process-id 1 \
+    --resume auto \
+    "${@:3}"
+fi
 
 if [[ "${RESPAWN_ATTEMPTS}" == "0" ]]; then
   run_once "${@:3}"

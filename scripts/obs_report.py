@@ -195,6 +195,47 @@ def _league_section(by_kind: Dict[str, List[Dict[str, Any]]]) -> Dict[str, Any]:
     }
 
 
+def _failover_section(
+    by_kind: Dict[str, List[Dict[str, Any]]]
+) -> Dict[str, Any]:
+    """Fold learner-failover rows (parallel/failover.py;
+    docs/RESILIENCE.md "learner failover"): takeover count and MTTR, the
+    claim-vs-restore latency split the RUNBOOK triage keys on, claim races
+    lost, and fenced stale publishes/write-backs by surface (a non-empty
+    surface table means a ZOMBIE predecessor kept running after takeover
+    and every one of its writes was refused).  Empty dict for runs without
+    failover rows."""
+    rows = by_kind.get("failover", [])
+    if not rows:
+        return {}
+    events: Dict[str, int] = {}
+    fenced_by_surface: Dict[str, int] = {}
+    for row in rows:
+        ev = str(row.get("event", "unknown"))
+        events[ev] = events.get(ev, 0) + 1
+        if ev == "fenced_stale":
+            surface = str(row.get("surface", "unknown"))
+            fenced_by_surface[surface] = fenced_by_surface.get(surface, 0) + 1
+    takeovers = [r for r in rows if r.get("event") == "takeover"]
+    restores = [r for r in rows if r.get("event") == "restore"]
+    claims = [r for r in rows if r.get("event") == "claim"]
+    last_takeover = takeovers[-1] if takeovers else {}
+    last_restore = restores[-1] if restores else {}
+    return {
+        "rows": len(rows),
+        "events": events,
+        "takeovers": len(takeovers),
+        "mttr_s": last_takeover.get("mttr_s"),
+        "warm": last_takeover.get("warm"),
+        "epoch": last_takeover.get("epoch"),
+        "restore_s": last_restore.get("restore_s"),
+        "claims_won": sum(1 for r in claims if r.get("won")),
+        "claims_lost": sum(1 for r in claims if not r.get("won")),
+        "fenced_stale": events.get("fenced_stale", 0),
+        "fenced_by_surface": fenced_by_surface,
+    }
+
+
 def _net_section(by_kind: Dict[str, List[Dict[str, Any]]]) -> Dict[str, Any]:
     """Fold cross-host serving rows (serving/net/): per-peer transport
     health — newest rtt/bytes from the periodic stats rows, flap counts
@@ -472,6 +513,9 @@ def aggregate(rows: List[Dict[str, Any]]) -> Dict[str, Any]:
         # league runs (league/): per-member fitness/generation/exploits +
         # event totals (the PBT story in counts)
         "league": _league_section(by_kind),
+        # learner failover (parallel/failover.py): takeovers + MTTR, the
+        # claim/restore latency split, fenced zombie writes by surface
+        "failover": _failover_section(by_kind),
         "shed_total": shed_total,
         "final_eval": {
             k: v for k, v in last_eval.items()
@@ -654,6 +698,17 @@ def render(report: Dict[str, Any]) -> str:
                 f"last_copy_source={snap.get('last_copy_source')} "
                 f"lr={snap.get('lr')} n_step={snap.get('n_step')}"
             )
+    fo = report.get("failover") or {}
+    if fo:
+        lines.append(
+            f"failover: takeovers={fo['takeovers']} mttr_s={fo['mttr_s']} "
+            f"restore_s={fo['restore_s']} warm={fo['warm']} "
+            f"epoch={fo['epoch']} claims_won={fo['claims_won']} "
+            f"claims_lost={fo['claims_lost']} "
+            f"fenced_stale={fo['fenced_stale']}"
+        )
+        for surface, n in sorted(fo["fenced_by_surface"].items()):
+            lines.append(f"  fenced surface {surface}: {n} refused")
     e = report["elastic"]
     if any(e.values()):
         lines.append(

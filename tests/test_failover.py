@@ -1,0 +1,433 @@
+"""Epoch-fenced hot-standby learner failover (parallel/failover.py +
+the fence threaded through elastic/quant_publish/checkpoint/replay-net;
+ISSUE 17, docs/RESILIENCE.md "Learner failover").
+
+What tier-1 asserts here:
+
+1. the O_EXCL claim primitive: N racers for one (role, epoch), exactly one
+   winner; `latest_role_epoch` is the floor a successor claims above;
+2. `EpochFence`: monotone latch, counted refusals — and with failover off
+   (no epoch above 0 ever claimed) `stale` is identically False, the
+   bitwise off-path guarantee;
+3. the zombie fence at EVERY publish surface: the in-process
+   `QuantPublishMixin.publish_weights` refusal, the authoritative
+   `WeightMailbox` disk-row `StaleEpochError` (both `publish` and
+   `publish_params`), and the replay-net server's `learner_epoch` latch
+   (update + snapshot refusals, persisted across a server respawn);
+4. checkpoint outranking: a successor's epoch-k+1 checkpoint beats the
+   deceased learner's even when the zombie's step counter ran ahead, and a
+   torn side-car ranks last instead of crashing the scan;
+5. the standby itself (`chaos`-marked): two standbys racing one expired
+   lease — one takeover, one reasoned loser row that re-arms; an injected
+   `standby_claim` fault re-arms the same way; warm mode hands the takeover
+   the pre-adopted params.
+
+`make failover-smoke` layers the REAL multi-process kill on top
+(scripts/chaos_soak.py --kill-learner): SIGKILL mid-publish, torn newest
+checkpoint, MTTR/monotonicity/bit-exactness gates.
+"""
+
+import os
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from rainbow_iqn_apex_tpu.config import Config
+from rainbow_iqn_apex_tpu.parallel import failover
+from rainbow_iqn_apex_tpu.parallel.elastic import (
+    EpochFence,
+    HeartbeatWriter,
+    StaleEpochError,
+    WeightMailbox,
+    claim_role_epoch,
+    heartbeat_dir,
+    latest_role_epoch,
+)
+from rainbow_iqn_apex_tpu.parallel.failover import (
+    LEARNER_ROLE,
+    StandbyLearner,
+    learner_epoch_at_start,
+    refresh_fence,
+)
+from rainbow_iqn_apex_tpu.utils import faults
+
+
+class _Rows:
+    """Stub metrics logger recording (kind, fields) tuples."""
+
+    def __init__(self):
+        self.rows = []
+
+    def log(self, kind, **fields):
+        self.rows.append((kind, fields))
+
+    def of(self, kind, event=None):
+        return [f for k, f in self.rows
+                if k == kind and (event is None or f.get("event") == event)]
+
+
+# --------------------------------------------------------- claim primitive
+def test_claim_role_epoch_exactly_one_winner_under_race(tmp_path):
+    """16 threads race the SAME (role, epoch) marker: the filesystem picks
+    exactly one winner — the property the whole takeover protocol rests on."""
+    hb = str(tmp_path / "hb")
+    n = 16
+    barrier = threading.Barrier(n)
+    wins = []
+
+    def racer():
+        barrier.wait()
+        if claim_role_epoch(hb, LEARNER_ROLE, 3):
+            wins.append(threading.get_ident())
+
+    threads = [threading.Thread(target=racer) for _ in range(n)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert len(wins) == 1
+    assert latest_role_epoch(hb, LEARNER_ROLE) == 3
+    # a second claim of a TAKEN epoch always loses; the next epoch is open
+    assert not claim_role_epoch(hb, LEARNER_ROLE, 3)
+    assert claim_role_epoch(hb, LEARNER_ROLE, 4)
+    assert latest_role_epoch(hb, LEARNER_ROLE) == 4
+
+
+def test_latest_role_epoch_empty_and_garbage(tmp_path):
+    hb = str(tmp_path / "hb")
+    assert latest_role_epoch(hb, LEARNER_ROLE) == -1  # no dir yet
+    os.makedirs(hb)
+    assert latest_role_epoch(hb, LEARNER_ROLE) == -1
+    # unparseable / foreign names never crash or count
+    for name in ("learner.exyz", "learner.e", "actor.e9", "h0.json"):
+        open(os.path.join(hb, name), "w").close()
+    assert latest_role_epoch(hb, LEARNER_ROLE) == -1
+    assert claim_role_epoch(hb, LEARNER_ROLE, 0)
+    assert latest_role_epoch(hb, LEARNER_ROLE) == 0
+
+
+def test_learner_epoch_at_start_off_is_zero_and_writes_nothing(tmp_path):
+    cfg = Config(results_dir=str(tmp_path), run_id="r0")
+    assert learner_epoch_at_start(cfg) == 0
+    assert not os.path.exists(heartbeat_dir(cfg))  # bitwise off path
+
+
+def test_learner_epoch_at_start_double_launch_resolves_to_two_epochs(
+        tmp_path):
+    """A scheduler double-launch of the learner: each start claims its own
+    epoch through the same O_EXCL markers, so the younger fences the elder
+    instead of split-braining."""
+    cfg = Config(results_dir=str(tmp_path), run_id="r0",
+                 failover_standby=True)
+    assert learner_epoch_at_start(cfg) == 0
+    assert learner_epoch_at_start(cfg) == 1
+    assert latest_role_epoch(heartbeat_dir(cfg), LEARNER_ROLE) == 1
+
+
+# ----------------------------------------------------------------- fence
+def test_epoch_fence_monotone_latch_counts_refusals():
+    fence = EpochFence()
+    assert fence.epoch == 0 and not fence.stale(0)
+    assert fence.observe(3) == 3
+    assert fence.observe(1) == 3  # never lowers
+    assert fence.stale(2) and fence.stale(0)
+    assert fence.refusals == 2
+    assert not fence.stale(3) and not fence.stale(7)
+    assert fence.refusals == 2
+
+
+def test_epoch_fence_off_path_is_identically_false():
+    """With failover off no epoch above 0 is ever claimed or observed, so
+    every fenced surface's `stale(0)` check is identically False — the
+    fenced code paths ARE the pre-failover behaviour."""
+    fence = EpochFence()
+    for _ in range(100):
+        fence.observe(0)
+        assert not fence.stale(0)
+    assert fence.refusals == 0
+
+
+def test_refresh_fence_latches_claim_markers(tmp_path):
+    """A zombie paused through the whole takeover learns it was superseded
+    from the claim markers alone — no message delivery required."""
+    hb = str(tmp_path / "hb")
+    claim_role_epoch(hb, LEARNER_ROLE, 0)
+    fence = EpochFence()
+    assert refresh_fence(fence, hb) == 0
+    assert not fence.stale(0)
+    claim_role_epoch(hb, LEARNER_ROLE, 1)  # the standby took over
+    assert refresh_fence(fence, hb) == 1
+    assert fence.stale(0)  # the epoch-0 zombie is now refused
+
+
+# ------------------------------------------------- zombie fence: mailbox
+def test_mailbox_refuses_stale_epoch_publish(tmp_path):
+    box = WeightMailbox(str(tmp_path / "mb.json"))
+    box.publish(1, step=10, learner_epoch=1)
+    assert box.read()["learner_epoch"] == 1
+    with pytest.raises(StaleEpochError):
+        box.publish(2, step=20, learner_epoch=0)  # the zombie
+    row = box.read()
+    assert row["version"] == 1 and row["learner_epoch"] == 1  # untouched
+    box.publish(2, step=20, learner_epoch=2)  # the successor passes
+    assert box.read()["learner_epoch"] == 2
+
+
+def test_mailbox_refuses_stale_epoch_publish_params(tmp_path):
+    params = {"w": np.arange(6, dtype=np.float32)}
+    box = WeightMailbox(str(tmp_path / "mb.json"))
+    row = box.publish_params(params, 0, learner_epoch=1)
+    assert row["learner_epoch"] == 1 and row["bytes"] > 0
+    with pytest.raises(StaleEpochError):
+        box.publish_params({"w": params["w"] * 2}, 1, learner_epoch=0)
+    # the refusal wrote NOTHING: chain and row still the successor's
+    assert box.version() == 0
+    out = box.read_params()
+    assert out is not None
+    np.testing.assert_array_equal(out["w"], params["w"])
+
+
+def test_mailbox_unstamped_publish_is_pre_failover_byte_for_byte(tmp_path):
+    """learner_epoch=None (every pre-failover caller) writes a row with NO
+    epoch key at all — the off path is the old wire format exactly."""
+    box = WeightMailbox(str(tmp_path / "mb.json"))
+    box.publish(5, step=50)
+    assert "learner_epoch" not in box.read()
+
+
+# -------------------------------------------- zombie fence: quant publish
+def test_quant_publish_fence_refuses_zombie_broadcast():
+    from rainbow_iqn_apex_tpu.parallel.quant_publish import QuantPublishMixin
+
+    class _Driver(QuantPublishMixin):
+        def __init__(self, metrics):
+            self.weights_version = 7
+            self._epoch_fence = None
+            self.learner_epoch = 0
+            self.fenced_publishes = 0
+            self._obs_metrics = metrics
+            self._obs_registry = None
+
+    rows = _Rows()
+    drv = _Driver(rows)
+    fence = EpochFence()
+    drv.attach_epoch_fence(fence, learner_epoch=1)
+    fence.observe(2)  # a successor claimed while this learner was paused
+    assert drv.publish_weights() == 7  # refused: version unchanged
+    assert drv.fenced_publishes == 1
+    (fenced,) = rows.of("failover", "fenced_stale")
+    assert fenced["surface"] == "publish" and fenced["epoch"] == 1
+
+    # current epoch: the fence passes through to the real broadcast (which
+    # this stub deliberately lacks — reaching it proves the pass-through)
+    drv2 = _Driver(rows)
+    drv2.attach_epoch_fence(EpochFence(), learner_epoch=2)
+    with pytest.raises(AttributeError):
+        drv2.publish_weights()
+    assert drv2.fenced_publishes == 0
+
+
+# ---------------------------------------- zombie fence: replay-net server
+def test_replay_server_learner_epoch_latch_and_persistence(tmp_path):
+    from rainbow_iqn_apex_tpu.parallel.sharded_replay import ShardedReplay
+    from rainbow_iqn_apex_tpu.replay.net.server import ReplayShardServer
+
+    def _mem():
+        return ShardedReplay.build(1, 64, 2, frame_shape=(8, 8), history=2,
+                                   n_step=3, gamma=0.9, seed=0)
+
+    prefix = os.path.join(str(tmp_path), "shard0")
+    srv = ReplayShardServer(_mem(), snapshot_prefix=prefix)
+    try:
+        assert not srv._stale_learner({})  # unstamped wire format passes
+        assert not srv._stale_learner({"learner_epoch": 2})  # latches
+        assert srv.learner_epoch == 2
+        assert srv._stale_learner({"learner_epoch": 1})  # the zombie
+        assert srv.fenced_learner_writes == 1
+        assert not srv._stale_learner({"learner_epoch": 3})  # successor
+    finally:
+        srv.stop()
+
+    # the latch survives a server respawn: a patient zombie stays refused
+    srv2 = ReplayShardServer(_mem(), snapshot_prefix=prefix)
+    try:
+        assert srv2.learner_epoch == 3
+        assert srv2._stale_learner({"learner_epoch": 2})
+    finally:
+        srv2.stop()
+
+
+# --------------------------------------------------- checkpoint outranking
+def test_checkpoint_successor_epoch_outranks_zombie_step(tmp_path):
+    """The deceased epoch-0 learner's step counter ran AHEAD (step 30) of
+    the successor's first epoch-1 save (step 22): resume must pick the
+    successor's — ordering is (learner_epoch, step), not step alone."""
+    jax = pytest.importorskip("jax")
+    from rainbow_iqn_apex_tpu.ops.learn import init_train_state
+    from rainbow_iqn_apex_tpu.utils.checkpoint import Checkpointer
+
+    cfg = Config(compute_dtype="float32", frame_height=44, frame_width=44,
+                 history_length=2, hidden_size=64, num_cosines=16,
+                 num_tau_samples=8, num_tau_prime_samples=8,
+                 num_quantile_samples=4)
+    state = init_train_state(cfg, 4, jax.random.PRNGKey(0))
+    ckpt = Checkpointer(str(tmp_path))
+    ckpt.save(10, state, extra={"frames": 10})  # pre-failover: no stamp
+    ckpt.save(30, state, extra={"frames": 30})  # zombie ran ahead, epoch 0
+    ckpt.save(22, state, extra={"frames": 22, "learner_epoch": 1})
+    ckpt.wait()
+    assert ckpt._steps_by_epoch() == (22, 30, 10)
+    assert ckpt.latest_valid_step() == 22  # side-car-only validation
+
+    # tear the successor's side-car: it ranks LAST (epoch -1), never
+    # crashes the scan, and resume falls back to the newest whole step
+    extra_dir = os.path.join(str(tmp_path), "22", "extra")
+    for name in os.listdir(extra_dir):
+        open(os.path.join(extra_dir, name), "w").close()
+    ckpt2 = Checkpointer(str(tmp_path))
+    assert ckpt2._steps_by_epoch() == (30, 10, 22)
+    assert ckpt2.latest_valid_step() == 30
+
+
+# ----------------------------------------------------------- the standby
+def _standby_cfg(tmp_path, pid):
+    return Config(results_dir=str(tmp_path), run_id="r0",
+                  failover_standby=True, failover_poll_s=0.02,
+                  heartbeat_timeout_s=0.15, process_id=pid)
+
+
+def _dead_learner_lease(tmp_path, epoch=0):
+    """One learner heartbeat, then silence — a lease that reads stale."""
+    hb = heartbeat_dir(Config(results_dir=str(tmp_path), run_id="r0"))
+    w = HeartbeatWriter(hb, 0, 0.05, injector=faults.FaultInjector(""),
+                        role=LEARNER_ROLE)
+    w.update_payload(learner_epoch=epoch)
+    w.beat()
+    return hb
+
+
+@pytest.mark.chaos
+def test_two_standbys_race_one_takeover_one_reasoned_loser(tmp_path,
+                                                           monkeypatch):
+    """Both standbys watch the lease expire and compute the SAME target
+    epoch before either claims (the barrier widens the real race window to
+    certainty): O_EXCL picks one takeover; the loser emits a reasoned
+    `claim won=false reason=lost_race` row and re-arms."""
+    _dead_learner_lease(tmp_path)
+    time.sleep(0.25)  # past heartbeat_timeout_s: the lease is stale
+
+    barrier = threading.Barrier(2)
+    real_claim = failover.claim_role_epoch
+
+    def racing_claim(directory, role, epoch):
+        barrier.wait(timeout=10)  # both floors read before either claims
+        return real_claim(directory, role, epoch)
+
+    monkeypatch.setattr(failover, "claim_role_epoch", racing_claim)
+
+    takeovers = []
+    standbys, rows = [], []
+    for pid in (1, 2):
+        r = _Rows()
+        rows.append(r)
+        standbys.append(StandbyLearner(
+            _standby_cfg(tmp_path, pid),
+            takeover=lambda epoch, warm, pid=pid: takeovers.append(
+                (pid, epoch, warm)),
+            metrics=r, injector=faults.FaultInjector(""),
+        ))
+    results = [None, None]
+
+    def drive(i):
+        results[i] = standbys[i].poll()
+
+    threads = [threading.Thread(target=drive, args=(i,)) for i in (0, 1)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+
+    winners = [r for r in results if r is not None]
+    assert len(winners) == 1 and len(takeovers) == 1
+    assert winners[0]["epoch"] == 1 and takeovers[0][1] == 1
+    loser_i = results.index(None)
+    assert standbys[loser_i].claims_lost == 1
+    (lost,) = rows[loser_i].of("failover", "claim")
+    assert lost["won"] is False and lost["reason"] == "lost_race"
+    winner_rows = rows[1 - loser_i]
+    assert winner_rows.of("failover", "takeover")
+    assert winner_rows.of("failover", "restore")
+    # the loser re-arms: its death latch reset, ready to tail the successor
+    assert standbys[loser_i].result is None
+
+
+@pytest.mark.chaos
+def test_injected_claim_fault_rearms_then_wins(tmp_path):
+    """`standby_claim@1` (the FS hiccup mid-O_EXCL): the first attempt
+    fails with a reasoned row, the next poll retries the race and wins."""
+    _dead_learner_lease(tmp_path)
+    time.sleep(0.25)
+    rows = _Rows()
+    takeovers = []
+    s = StandbyLearner(
+        _standby_cfg(tmp_path, 1),
+        takeover=lambda epoch, warm: takeovers.append(epoch),
+        metrics=rows, injector=faults.FaultInjector("standby_claim@1"),
+    )
+    assert s.poll() is None  # injected failure: no takeover yet
+    (injected,) = rows.of("failover", "claim")
+    assert injected["won"] is False
+    assert injected["reason"] == "injected_fault"
+    out = s.poll()  # re-armed: the retry wins
+    assert out is not None and out["epoch"] == 1 and takeovers == [1]
+
+
+@pytest.mark.chaos
+def test_standby_ignores_fresh_lease_and_absent_learner(tmp_path):
+    """No claim while the learner renews, and — critically — no claim when
+    no learner has EVER beaten: absence is not death."""
+    cfg = _standby_cfg(tmp_path, 1)
+    s = StandbyLearner(cfg, takeover=lambda e, w: None, metrics=_Rows(),
+                       injector=faults.FaultInjector(""))
+    assert s.poll() is None  # empty heartbeat dir: nothing to succeed
+    hb = _dead_learner_lease(tmp_path)
+    assert s.poll() is None  # fresh lease: on standby duty
+    assert latest_role_epoch(hb, LEARNER_ROLE) == -1  # nothing claimed
+
+
+@pytest.mark.chaos
+def test_warm_standby_hands_takeover_the_preadopted_params(tmp_path):
+    """failover_warm: the standby tails publish_params while on duty and
+    the takeover callback receives the pre-adopted tree (bit-exact against
+    the publisher's reconstruction)."""
+    box = WeightMailbox(str(tmp_path / "mb.json"))
+    params = {"w": np.linspace(0.0, 1.0, 12, dtype=np.float32)}
+    box.publish_params(params, 0, learner_epoch=0)
+    _dead_learner_lease(tmp_path)
+
+    cfg = Config(results_dir=str(tmp_path), run_id="r0",
+                 failover_standby=True, failover_warm=True,
+                 failover_poll_s=0.02, heartbeat_timeout_s=0.15,
+                 process_id=1)
+    got = {}
+    s = StandbyLearner(cfg, takeover=lambda e, warm: got.update(
+        epoch=e, warm=warm), metrics=_Rows(),
+        mailbox=box, injector=faults.FaultInjector(""))
+    assert s.poll() is None  # fresh lease: warm-tailing only
+    time.sleep(0.25)
+    out = s.poll()
+    assert out is not None and out["warm"] is True
+    assert got["epoch"] == 1 and got["warm"] is not None
+    # bit-exact against the PUBLISHER'S reconstruction (int8_delta is lossy
+    # vs the raw tree; the chain replay is the cross-process contract)
+    np.testing.assert_array_equal(got["warm"]["w"], box.read_params()["w"])
+
+
+# ------------------------------------------------------------ default off
+def test_failover_config_defaults_off():
+    cfg = Config()
+    assert cfg.failover_standby is False
+    assert cfg.failover_warm is False
+    assert cfg.failover_poll_s == 0.5

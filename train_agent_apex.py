@@ -59,6 +59,13 @@ def main(argv=None) -> int:
         from rainbow_iqn_apex_tpu.parallel.apex import train_apex
 
         summary = train_apex(cfg)
+    elif cfg.role == "standby":
+        # hot-standby learner (parallel/failover.py; launch_apex.sh
+        # --standby): jax-free until it actually claims the learner role,
+        # then re-enters the apex entry with --resume auto
+        from rainbow_iqn_apex_tpu.parallel.failover import run_standby
+
+        summary = run_standby(cfg)
     elif cfg.role == "anakin" and cfg.architecture == "iqn":
         from rainbow_iqn_apex_tpu.train_anakin import train_anakin
 
@@ -69,9 +76,9 @@ def main(argv=None) -> int:
         summary = train_anakin_r2d2(cfg)
     else:
         print(
-            f"unknown --role '{cfg.role}' (want 'single', 'apex' or 'anakin'; "
-            "the reference's separate learner/actor processes are one SPMD "
-            "program here)",
+            f"unknown --role '{cfg.role}' (want 'single', 'apex', 'anakin' "
+            "or 'standby'; the reference's separate learner/actor processes "
+            "are one SPMD program here)",
             file=sys.stderr,
         )
         return 2
