@@ -92,6 +92,14 @@ class Config:
     # failover_standby.
     failover_poll_s: float = 0.5  # standby lease-poll cadence in seconds
     # (bounds claim latency at ~poll + heartbeat_timeout_s)
+    failover_takeover_deadline_s: float = 120.0  # how long a standby treats
+    # a claim marker ABOVE every learner-role lease as "takeover in
+    # progress" (a sibling won the race and is mid-restore) before presuming
+    # the claimant died without ever leasing the role and reopening the
+    # claim race.  A winner that advertises its lease immediately (the
+    # run_standby path) never runs this clock out; the deadline is the
+    # fallback for a winner killed between its O_EXCL claim and its first
+    # lease beat.
 
     # ---- environment (SURVEY §2 row 2) -------------------------------------------
     env_id: str = "toy:catch"  # "toy:catch", "toy:chain", or "atari:<Game>"

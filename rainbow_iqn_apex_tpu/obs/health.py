@@ -263,6 +263,22 @@ class RunHealth:
                     self._win_faults["failover_fenced"] += 1
                 self.registry.counter(
                     "failover_fenced_total", "health").inc()
+            elif event == "zombie_exit":
+                # the fence's terminal edge: a superseded incarnation saw
+                # the successor's claim and exited its train loop — counted
+                # like the per-surface refusals (a human should know a
+                # zombie existed), degrading the window the same way
+                with self._lock:
+                    self.fault_counts["failover_zombie_exit"] += 1
+                    self._win_faults["failover_zombie_exit"] += 1
+                self.registry.counter(
+                    "failover_zombie_exits_total", "health").inc()
+            elif event == "holdoff":
+                # takeover-in-progress wait: a standby deferring to a
+                # sibling's claimed-but-not-yet-leased takeover — normal
+                # race resolution, counted, never degrading
+                self.registry.counter(
+                    "failover_holdoffs_total", "health").inc()
             elif event == "claim":
                 self.registry.counter(
                     "failover_claims_total", "health").inc()

@@ -124,14 +124,19 @@ REQUIRED_KEYS: Dict[str, frozenset] = {
     # learner-failover rows (parallel/failover.py; docs/RESILIENCE.md
     # "learner failover"):
     "failover": frozenset({"event"}),  # standby/takeover lifecycle (event:
-    # claim/takeover/restore/fenced_stale.  "claim" is one O_EXCL role-epoch
-    # race outcome — carries epoch + won, losers add a reasoned `reason` and
-    # re-arm; "restore" carries restore_s (+ step/warm) for the recovery-
-    # latency split; "takeover" carries epoch/mttr_s/warm — RunHealth folds
-    # it window-degraded until the first clean post-takeover learn row;
+    # claim/holdoff/takeover/restore/fenced_stale/zombie_exit.  "claim" is
+    # one O_EXCL role-epoch race outcome — carries epoch + won, losers add
+    # a reasoned `reason` and re-arm; "holdoff" is a standby deferring to a
+    # sibling's claimed-but-not-yet-leased takeover (epoch/lease_epoch/
+    # deadline_s — the dual-takeover guard, once per episode); "restore"
+    # carries restore_s (+ step/warm) for the recovery-latency split;
+    # "takeover" carries epoch/mttr_s/warm — RunHealth folds it
+    # window-degraded until the first clean post-takeover learn row;
     # "fenced_stale" carries `surface` (publish/mailbox/writeback/
     # replay_net/league) + the refused epoch — the zombie-learner refusal
-    # witness obs_report's `failover:` section counts)
+    # witness obs_report's `failover:` section counts; "zombie_exit" is the
+    # terminal edge — the superseded incarnation observed the successor's
+    # claim (fence_epoch) and exited its train loop)
     "lag": frozenset({"step"}),  # periodic lag-attribution row: per-metric
     # window percentiles of the always-on lag_* histograms (sample age at
     # learn time, ring retirement, router dispatch, batcher slot wait) plus

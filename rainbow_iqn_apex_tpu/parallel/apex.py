@@ -794,6 +794,25 @@ def train_apex(cfg: Config, max_frames: Optional[int] = None) -> Dict[str, Any]:
                 heartbeat.beat()  # visible before the first renewal interval
             metrics.log("failover", event="claim", won=True,
                         epoch=learner_epoch, source="learner_start")
+
+    def _zombie_detected(at_step: int) -> bool:
+        """Refresh the learner-epoch fence from the claim markers and answer
+        whether a SUCCESSOR epoch has appeared — this incarnation is then a
+        zombie and must EXIT, not merely fence its publishes: a fenced loop
+        that keeps training burns the device indefinitely and keeps writing
+        force=True checkpoints into the same Orbax directory the successor
+        owns (two concurrent CheckpointManagers — torn steps, pruning
+        races).  Emits the terminal failover row on detection."""
+        if lfence is None:
+            return False
+        refresh_fence(lfence, heartbeat_dir(cfg))
+        if lfence.epoch <= learner_epoch:
+            return False
+        metrics.log("failover", event="zombie_exit", epoch=learner_epoch,
+                    fence_epoch=lfence.epoch, step=at_step, frames=frames)
+        return True
+
+    zombie = False
     # staleness fence (parallel/elastic.py): the fused loop adopts the
     # published version atomically with the params, so lag is structurally 0
     # here and the fence can never fire — observe() keeps the
@@ -1405,13 +1424,16 @@ def train_apex(cfg: Config, max_frames: Optional[int] = None) -> Dict[str, Any]:
                             **({} if reuse_k == 1
                                else {"replay_ratio": reuse_k}),
                         )
-                        if lfence is not None:
-                            # the zombie's wake-up path: claim markers are
-                            # plain files, visible to a process that was
-                            # paused through the whole takeover the moment
-                            # it resumes — latch any successor epoch so the
-                            # next publish/write-back/snapshot refuses
-                            refresh_fence(lfence, heartbeat_dir(cfg))
+                        # the zombie's wake-up path: claim markers are
+                        # plain files, visible to a process that was
+                        # paused through the whole takeover the moment it
+                        # resumes.  A latched successor epoch is TERMINAL:
+                        # stop training (the per-surface fences would
+                        # refuse everything anyway), never checkpoint
+                        # again, and fall through to the zombie return.
+                        if _zombie_detected(step):
+                            zombie = True
+                            break
                         if monitor is not None:
                             # a preempted host stops heartbeating; the
                             # host_dead row is the external supervisor's
@@ -1457,6 +1479,15 @@ def train_apex(cfg: Config, max_frames: Optional[int] = None) -> Dict[str, Any]:
                                 **_eval_learner(cfg, env, driver),
                             )
                     if cadence_hit(step, cfg.checkpoint_interval, reuse_k):
+                        # re-check the fence at the WRITE itself: the
+                        # checkpoint cadence need not share a step with the
+                        # metrics cadence, and a zombie's force=True save
+                        # into the successor's live Orbax dir is the one
+                        # fenced surface a refusal cannot undo after the
+                        # fact
+                        if _zombie_detected(step):
+                            zombie = True
+                            break
                         if not _drain():  # checkpoint only verified params
                             continue
                         # every host calls save — Orbax treats it as a
@@ -1484,6 +1515,8 @@ def train_apex(cfg: Config, max_frames: Optional[int] = None) -> Dict[str, Any]:
                             # server-side snapshots, fenced by this step so
                             # a rewound learner can't re-trigger older ones
                             rplane.request_snapshot(step)
+            if zombie:
+                break  # superseded: stop acting/appending too, not just learning
         # end of run: the still-in-flight tail retires (write-back + guard)
         # before the final eval/checkpoint read the state
         _drain()
@@ -1498,6 +1531,31 @@ def train_apex(cfg: Config, max_frames: Optional[int] = None) -> Dict[str, Any]:
             heartbeat.stop()
         if league_hb is not None:
             league_hb.stop()
+    # last fence look before the final writes: a run that ended NORMALLY
+    # while a successor was claiming (fence latched between the last cadence
+    # and loop exit) must not push a final checkpoint/replay snapshot into
+    # the successor's live run dir either
+    if not zombie and _zombie_detected(driver.step):
+        zombie = True
+    if zombie:
+        # A superseded incarnation stops touching the run dir HERE: no
+        # final eval (its rows would read as authoritative), no final
+        # checkpoint or replay snapshot (the successor's CheckpointManager
+        # owns the directory now).  The terminal failover row already
+        # landed; wait() only joins this process's in-flight save threads.
+        ckpt.wait()
+        metrics.close()
+        return {
+            "frames": frames,
+            "learn_steps": driver.step,
+            "lanes": lanes_total,
+            "train_return_mean": (
+                float(np.mean(returns)) if returns else float("nan")),
+            "rollbacks": sup.rollbacks,
+            "stalls": sup.stalls,
+            "io_faults": sup.io_faults,
+            "zombie_exit": True,
+        }
     if is_main and spec is not None:
         final_eval = _eval_multigame(
             cfg, spec, driver, metrics, driver.step, games_obs)

@@ -19,7 +19,15 @@ with a standby learner and a learner-role epoch fence:
   weights at versions strictly above the deceased learner's, so
   `StalenessFence`/`WeightMailbox`/`FleetRollout` consumers converge onto
   it without adopting anything stale.  The loser emits a reasoned
-  ``failover`` row and re-arms as the NEW learner's standby.
+  ``failover`` row and re-arms as the NEW learner's standby — and while
+  the winner is mid-restore (its learner-role lease not yet written) the
+  loser **holds off**: a claim marker above every lease it has ever seen
+  reads as "takeover in progress" (``holdoff`` row), and only a claimant
+  silent past ``failover_takeover_deadline_s`` reopens the race.  The
+  winner shortens that window to one beat by flipping its own lease to
+  role=learner at the new epoch the instant the claim lands
+  (``lease_writer``), so exactly one learner exists at every point of the
+  protocol, not just at the O_EXCL file.
 - **Zombie fencing**: a paused-not-dead learner (GC stall, network
   partition) that wakes after takeover carries a superseded
   ``learner_epoch``.  Every publish surface it touches — the driver
@@ -53,6 +61,7 @@ from typing import Any, Callable, Dict, List, Optional
 from rainbow_iqn_apex_tpu.parallel.elastic import (
     EpochFence,
     HeartbeatMonitor,
+    HeartbeatWriter,
     Lease,
     MailboxSubscriber,
     WeightMailbox,
@@ -117,6 +126,7 @@ class StandbyLearner:
                  metrics=None, registry=None,
                  monitor: Optional[HeartbeatMonitor] = None,
                  mailbox: Optional[WeightMailbox] = None,
+                 lease_writer: Optional[HeartbeatWriter] = None,
                  injector: Optional[faults.FaultInjector] = None,
                  clock: Callable[[], float] = time.monotonic):
         self.cfg = cfg
@@ -134,6 +144,13 @@ class StandbyLearner:
             MailboxSubscriber(mailbox, consumer="standby")
             if self.warm and mailbox is not None else None
         )
+        # the winner flips this lease (role -> learner, stamped with the new
+        # epoch) the instant the claim is won, BEFORE the possibly
+        # process-lifetime restore: sibling standbys judge "takeover in
+        # progress" by it instead of waiting out the takeover deadline
+        self.lease_writer = lease_writer
+        self.takeover_deadline_s = float(
+            getattr(cfg, "failover_takeover_deadline_s", 120.0))
         self.injector = injector if injector is not None else faults.get()
         self.clock = clock
         # the standby's own view of the highest learner epoch in play —
@@ -145,6 +162,7 @@ class StandbyLearner:
         self._warm_params: Optional[Any] = None
         self._warm_version = -1
         self._death_t: Optional[float] = None
+        self._holdoff_t0: Optional[float] = None  # takeover-in-progress wait
         self._lock = threading.Lock()
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
@@ -192,14 +210,33 @@ class StandbyLearner:
         if not won:
             # a sibling standby won the filesystem race: it IS the learner
             # now — emit the reasoned loser row and go back to standby duty
-            # watching the new incarnation's lease
+            # watching the new incarnation's lease.  The hold-off clock
+            # resets so the WINNER gets a full takeover deadline: the next
+            # poll sees its claim marker above every lease and waits for
+            # its learner-role lease instead of claiming epoch+1 unopposed
+            # (two concurrent learners — the dual-takeover race).
             with self._lock:
                 self.claims_lost += 1
                 self._death_t = None
+                self._holdoff_t0 = None
             self._row("claim", won=False, epoch=epoch, claim_s=claim_s,
                       reason="lost_race")
             return None
         self._row("claim", won=True, epoch=epoch, claim_s=claim_s)
+        if self.lease_writer is not None:
+            # Advertise the new incumbency IMMEDIATELY, before the (possibly
+            # process-lifetime) restore: sibling standbys see a fresh
+            # learner-role lease at this epoch through the whole recovery
+            # instead of the deceased learner's stale one — without it they
+            # can only hold off on the claim marker until the takeover
+            # deadline.  A failed beat degrades to exactly that hold-off, so
+            # it must not abort the takeover itself.
+            try:
+                self.lease_writer.update_payload(
+                    role=LEARNER_ROLE, learner_epoch=epoch)
+                self.lease_writer.beat()
+            except OSError:
+                pass
         # the takeover row lands when the role is WON, before the (possibly
         # process-lifetime — run_standby's callback IS the resumed train
         # loop) recovery work: RunHealth degrades the window at the right
@@ -243,9 +280,32 @@ class StandbyLearner:
         if any(lease.fresh for lease in leases):
             with self._lock:
                 self._death_t = None  # a live learner: nothing to do
+                self._holdoff_t0 = None
             return None
         if not leases:
             return None  # no learner has EVER beaten; absence is not death
+        claimed = latest_role_epoch(self.directory, LEARNER_ROLE)
+        lease_peak = max(lease.learner_epoch for lease in leases)
+        if claimed > lease_peak:
+            # A claim marker ABOVE every learner-role lease ever written: a
+            # sibling won the race and is mid-restore — its learner lease
+            # only appears once its takeover beats (the lease_writer
+            # advertisement, or the resumed train loop's own heartbeat).
+            # Claiming now would be a SECOND, unopposed takeover — two
+            # concurrent learners restoring into one run dir, exactly the
+            # split brain the O_EXCL race exists to prevent — so hold off.
+            # Only a claimant silent past the takeover deadline is presumed
+            # dead mid-restore; then the claim race reopens above its epoch.
+            with self._lock:
+                first = self._holdoff_t0 is None
+                if first:
+                    self._holdoff_t0 = now
+                held_s = now - self._holdoff_t0
+            if first:
+                self._row("holdoff", epoch=claimed, lease_epoch=lease_peak,
+                          deadline_s=self.takeover_deadline_s)
+            if held_s < self.takeover_deadline_s:
+                return None
         with self._lock:
             if self._death_t is None:
                 self._death_t = now
@@ -294,23 +354,40 @@ def run_standby(cfg, max_wait_s: Optional[float] = None) -> Dict[str, Any]:
     train_agent_apex.py --role standby).
 
     Tails the learner's lease in this run's heartbeat dir, writes its own
-    ``standby`` lease when heartbeats are on (requires a process_id
-    DISTINCT from the learner's — the lease file is keyed by it), and on
-    takeover re-enters the standard apex entry with ``resume="auto"`` as
-    process 0: `train_apex` claims the NEXT learner-role epoch itself
-    (strictly above both the deceased learner's and this standby's claim
-    marker), restores the newest VALID checkpoint — scanning past a torn
-    newest step — plus the CRC-verified replay snapshot, and resumes
-    publishing strictly above the predecessor.  Warm mode additionally
-    tails the run's mailbox so harnesses that inject their own takeover
-    callback (scripts/chaos_soak.py) start from the freshest publish; the
+    ``standby`` lease when heartbeats are on (a process_id DISTINCT from
+    the learner's is REQUIRED — see below), and on takeover re-enters the
+    standard apex entry with ``resume="auto"`` as process 0: `train_apex`
+    claims the NEXT learner-role epoch itself (strictly above both the
+    deceased learner's and this standby's claim marker), restores the
+    newest VALID checkpoint — scanning past a torn newest step — plus the
+    CRC-verified replay snapshot, and resumes publishing strictly above
+    the predecessor.  The standby's lease doubles as the takeover
+    advertisement: the moment the claim is won it flips to role=learner at
+    the new epoch, so sibling standbys hold off through the restore
+    instead of racing a second takeover.  Warm mode additionally tails the
+    run's mailbox so harnesses that inject their own takeover callback
+    (scripts/chaos_soak.py) start from the freshest publish; the
     train_apex path restores from the checkpoint either way.
+
+    Raises ValueError when ``process_id`` is left at the learner's id (0):
+    that standby would write no lease of its own (invisible to
+    HeartbeatMonitor and obs) AND filter the learner's lease out of its
+    own death detection (the self-exclusion in ``_learner_leases``), so it
+    could never take over — refusing loudly beats a silent no-op standby.
 
     Returns {"takeover": bool, ...} with the StandbyLearner result fields
     (epoch/mttr_s/claim_s/restore_s/outcome) when a takeover happened."""
-    from rainbow_iqn_apex_tpu.parallel.elastic import HeartbeatWriter
     from rainbow_iqn_apex_tpu.utils.logging import MetricsLogger
 
+    pid = int(getattr(cfg, "process_id", 0) or 0)
+    if pid == 0:
+        raise ValueError(
+            "run_standby: process_id 0 is the learner's id — a standby "
+            "sharing it writes no lease of its own (invisible to the "
+            "HeartbeatMonitor and obs) and excludes the learner's lease "
+            "from its own death detection, so it would never take over; "
+            "launch with a distinct --process-id (launch_apex.sh "
+            "--standby uses 1)")
     run_dir = os.path.join(cfg.results_dir, cfg.run_id)
     metrics = MetricsLogger(
         os.path.join(run_dir, "standby.jsonl"), cfg.run_id,
@@ -330,13 +407,14 @@ def run_standby(cfg, max_wait_s: Optional[float] = None) -> Dict[str, Any]:
 
     mailbox = (WeightMailbox(mailbox_path(cfg))
                if getattr(cfg, "failover_warm", False) else None)
-    standby = StandbyLearner(cfg, takeover, metrics=metrics, mailbox=mailbox)
     heartbeat = None
-    if cfg.heartbeat_interval_s > 0 and getattr(cfg, "process_id", 0) != 0:
+    if cfg.heartbeat_interval_s > 0:
         heartbeat = HeartbeatWriter(
-            heartbeat_dir(cfg), cfg.process_id, cfg.heartbeat_interval_s,
+            heartbeat_dir(cfg), pid, cfg.heartbeat_interval_s,
             role="standby",
         ).start()
+    standby = StandbyLearner(cfg, takeover, metrics=metrics, mailbox=mailbox,
+                             lease_writer=heartbeat)
     try:
         result = standby.run(max_wait_s=max_wait_s)
     finally:

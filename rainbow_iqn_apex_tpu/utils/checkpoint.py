@@ -9,6 +9,7 @@ so resume is exact for the learner and statistically faithful for actors.
 
 from __future__ import annotations
 
+import logging
 import os
 from typing import Any, Dict, Optional, Tuple
 
@@ -123,17 +124,33 @@ class Checkpointer:
         even when the zombie's step counter ran ahead — the successor can
         never be outranked by its predecessor.  Checkpoints without the
         stamp (every pre-failover run) read as epoch 0, so the order
-        degenerates to plain step-descending — the seed behaviour."""
-        def epoch_of(step: int) -> int:
-            try:
-                return int(self.restore_extra(step).get("learner_epoch", 0))
-            except Exception:  # torn side-car: rank lowest, still a candidate
-                return -1
+        degenerates to plain step-descending — the seed behaviour.
 
+        The side-car reads are ranked in ONE pass per scan with a retry:
+        a MISSING stamp is epoch 0 (a valid pre-failover save), while a
+        side-car that fails to READ twice (torn write, or a genuinely flaky
+        filesystem) ranks -1 — below every whole checkpoint but still a
+        candidate — and is logged, so one transient hiccup can neither
+        silently demote the newest valid step for good nor pass unnoticed."""
         steps = sorted(self._mngr.all_steps(), reverse=True)
         if len(steps) < 2:
             return tuple(steps)
-        return tuple(sorted(steps, key=lambda s: (epoch_of(s), s),
+        epochs: Dict[int, int] = {}
+        for step in steps:
+            for attempt in (0, 1):
+                try:
+                    epochs[step] = int(
+                        self.restore_extra(step).get("learner_epoch", 0))
+                    break
+                except Exception:
+                    if attempt:  # failed twice: torn side-car, rank lowest
+                        logging.getLogger(__name__).warning(
+                            "checkpoint step %d: extras side-car unreadable "
+                            "after retry; ranking it below intact steps",
+                            step,
+                        )
+                        epochs[step] = -1
+        return tuple(sorted(steps, key=lambda s: (epochs[s], s),
                             reverse=True))
 
     def refresh(self) -> Optional[int]:

@@ -20,7 +20,16 @@ What tier-1 asserts here:
 5. the standby itself (`chaos`-marked): two standbys racing one expired
    lease — one takeover, one reasoned loser row that re-arms; an injected
    `standby_claim` fault re-arms the same way; warm mode hands the takeover
-   the pre-adopted params.
+   the pre-adopted params;
+6. the dual-takeover guard: a claim marker above every learner lease reads
+   as "takeover in progress" — the loser HOLDS OFF instead of claiming
+   epoch+1 unopposed, the winner's immediate lease advertisement stands
+   siblings down, and only a claimant silent past
+   `failover_takeover_deadline_s` reopens the race;
+7. zombie termination: a superseded `train_apex` incarnation observes the
+   successor's claim at its metrics cadence and EXITS (`zombie_exit` row,
+   no final eval/checkpoint into the successor's Orbax dir) instead of
+   training fenced forever.
 
 `make failover-smoke` layers the REAL multi-process kill on top
 (scripts/chaos_soak.py --kill-learner): SIGKILL mid-publish, torn newest
@@ -38,6 +47,7 @@ from rainbow_iqn_apex_tpu.config import Config
 from rainbow_iqn_apex_tpu.parallel import failover
 from rainbow_iqn_apex_tpu.parallel.elastic import (
     EpochFence,
+    HeartbeatMonitor,
     HeartbeatWriter,
     StaleEpochError,
     WeightMailbox,
@@ -362,6 +372,19 @@ def test_two_standbys_race_one_takeover_one_reasoned_loser(tmp_path,
     # the loser re-arms: its death latch reset, ready to tail the successor
     assert standbys[loser_i].result is None
 
+    # the dual-takeover regression: the loser's NEXT poll still sees only
+    # the DECEASED learner's stale lease (the winner here never leases the
+    # role — it has no lease_writer and its restore "runs" forever), and
+    # before the hold-off it would claim epoch 2 via O_EXCL unopposed —
+    # two concurrent learners.  Now the winner's claim marker above every
+    # lease reads as "takeover in progress" and the loser stands down.
+    assert standbys[loser_i].poll() is None
+    assert latest_role_epoch(heartbeat_dir(standbys[loser_i].cfg),
+                             LEARNER_ROLE) == 1  # no second takeover
+    (held,) = rows[loser_i].of("failover", "holdoff")
+    assert held["epoch"] == 1 and held["lease_epoch"] == 0
+    assert standbys[loser_i].result is None
+
 
 @pytest.mark.chaos
 def test_injected_claim_fault_rearms_then_wins(tmp_path):
@@ -425,9 +448,141 @@ def test_warm_standby_hands_takeover_the_preadopted_params(tmp_path):
     np.testing.assert_array_equal(got["warm"]["w"], box.read_params()["w"])
 
 
+# --------------------------------------------- the dual-takeover guard
+@pytest.mark.chaos
+def test_holdoff_deadline_reopens_claim_race(tmp_path):
+    """A claimant that died BETWEEN its O_EXCL claim and its first lease
+    beat: the sibling holds off (one `holdoff` row per episode, nothing
+    claimed) until `failover_takeover_deadline_s` runs out, then presumes
+    the claimant dead mid-restore and reclaims strictly ABOVE its epoch."""
+    hb = _dead_learner_lease(tmp_path)
+    time.sleep(0.25)  # the learner's lease is stale
+    claim_role_epoch(hb, LEARNER_ROLE, 1)  # a sibling won the race... died
+
+    t = [100.0]  # injectable clock: drive the deadline without sleeping
+    cfg = Config(results_dir=str(tmp_path), run_id="r0",
+                 failover_standby=True, failover_poll_s=0.02,
+                 heartbeat_timeout_s=0.15, process_id=2,
+                 failover_takeover_deadline_s=5.0)
+    rows = _Rows()
+    takeovers = []
+    s = StandbyLearner(cfg, takeover=lambda e, w: takeovers.append(e),
+                       metrics=rows, injector=faults.FaultInjector(""),
+                       clock=lambda: t[0])
+    assert s.poll() is None  # takeover in progress: defer to the claimant
+    (held,) = rows.of("failover", "holdoff")
+    assert held["epoch"] == 1 and held["lease_epoch"] == 0
+    assert held["deadline_s"] == 5.0
+    assert latest_role_epoch(hb, LEARNER_ROLE) == 1  # nothing claimed
+    t[0] += 4.0
+    assert s.poll() is None  # still inside the deadline
+    assert len(rows.of("failover", "holdoff")) == 1  # row once per episode
+    t[0] += 2.0  # deadline blown: the claimant never advertised a lease
+    out = s.poll()
+    assert out is not None and out["epoch"] == 2 and takeovers == [2]
+    assert latest_role_epoch(hb, LEARNER_ROLE) == 2
+
+
+@pytest.mark.chaos
+def test_winner_advertises_lease_and_sibling_stands_down(tmp_path):
+    """The winner flips its OWN lease to role=learner at the new epoch the
+    instant the claim lands — before the (possibly process-lifetime)
+    restore — so a sibling's next poll sees a fresh learner lease and goes
+    back to standby duty instead of waiting out the takeover deadline."""
+    hb = _dead_learner_lease(tmp_path)
+    time.sleep(0.25)
+    writer = HeartbeatWriter(hb, 1, 0.05, injector=faults.FaultInjector(""),
+                             role="standby")
+    writer.beat()
+    winner = StandbyLearner(_standby_cfg(tmp_path, 1),
+                            takeover=lambda e, w: None, metrics=_Rows(),
+                            lease_writer=writer,
+                            injector=faults.FaultInjector(""))
+    out = winner.poll()
+    assert out is not None and out["epoch"] == 1
+
+    # the advertisement is on disk: the winner's lease reads learner@1
+    lease = HeartbeatMonitor(hb, 0.15).leases()[1]
+    assert lease.role == LEARNER_ROLE and lease.learner_epoch == 1
+
+    # a sibling sees a FRESH learner lease through the whole restore: no
+    # hold-off episode, no death latch, and certainly no second claim
+    rows = _Rows()
+    sibling = StandbyLearner(_standby_cfg(tmp_path, 2),
+                             takeover=lambda e, w: None, metrics=rows,
+                             injector=faults.FaultInjector(""))
+    assert sibling.poll() is None
+    assert not rows.of("failover", "holdoff")
+    assert latest_role_epoch(hb, LEARNER_ROLE) == 1
+
+
+def test_run_standby_refuses_learner_process_id(tmp_path):
+    """process_id 0 is the learner's id: that standby would write no lease
+    of its own AND filter the learner's lease out of its death detection —
+    a silent no-op standby.  run_standby refuses loudly instead."""
+    cfg = Config(results_dir=str(tmp_path), run_id="r0",
+                 failover_standby=True)
+    with pytest.raises(ValueError, match="process_id 0"):
+        failover.run_standby(cfg, max_wait_s=0.01)
+
+
+# ----------------------------------------------------- zombie termination
+@pytest.mark.chaos
+def test_train_apex_zombie_exits_when_superseded(tmp_path):
+    """The fence refresh is TERMINAL in the train loop: once a successor
+    claims a higher learner-role epoch, the superseded incarnation logs a
+    `zombie_exit` row and RETURNS early — no final eval, no force=True
+    checkpoint into the successor's live Orbax dir — instead of training
+    fenced (publishes refused, device burning) to max_frames."""
+    pytest.importorskip("jax")
+    import json
+
+    from rainbow_iqn_apex_tpu.parallel.apex import train_apex
+
+    cfg = Config(
+        compute_dtype="float32", frame_height=80, frame_width=80,
+        history_length=2, hidden_size=64, num_cosines=16,
+        num_tau_samples=8, num_tau_prime_samples=8, num_quantile_samples=4,
+        batch_size=16, learner_devices=4, num_actors=1,
+        num_envs_per_actor=8, replay_shards=2, env_id="toy:catch",
+        learn_start=512, frames_per_learn=8, memory_capacity=4096,
+        metrics_interval=10, checkpoint_interval=0, eval_interval=0,
+        eval_episodes=2, results_dir=str(tmp_path / "results"),
+        checkpoint_dir=str(tmp_path / "ckpt"), run_id="zmb",
+        failover_standby=True,
+    )
+    hb = heartbeat_dir(cfg)
+
+    def usurp():
+        # the successor: the instant the learner's own claim marker lands,
+        # claim the NEXT epoch — the learner is a zombie from then on
+        deadline = time.time() + 60
+        while time.time() < deadline:
+            mine = latest_role_epoch(hb, LEARNER_ROLE)
+            if mine >= 0:
+                claim_role_epoch(hb, LEARNER_ROLE, mine + 1)
+                return
+            time.sleep(0.01)
+
+    t = threading.Thread(target=usurp, daemon=True)
+    t.start()
+    summary = train_apex(cfg, max_frames=8_000)
+    t.join(timeout=5)
+    assert summary.get("zombie_exit") is True
+    assert summary["frames"] < 8_000  # exited at the cadence, not run out
+    assert "eval_score_mean" not in summary  # the final writes were skipped
+    with open(os.path.join(str(tmp_path / "results"), "zmb",
+                           "metrics.jsonl")) as fh:
+        rows = [json.loads(line) for line in fh]
+    (exit_row,) = [r for r in rows if r.get("kind") == "failover"
+                   and r.get("event") == "zombie_exit"]
+    assert exit_row["fence_epoch"] > exit_row["epoch"]
+
+
 # ------------------------------------------------------------ default off
 def test_failover_config_defaults_off():
     cfg = Config()
     assert cfg.failover_standby is False
     assert cfg.failover_warm is False
     assert cfg.failover_poll_s == 0.5
+    assert cfg.failover_takeover_deadline_s == 120.0
