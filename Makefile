@@ -2,7 +2,7 @@
 
 PY ?= python
 
-.PHONY: test test-fast serve-smoke serve-bench chaos-smoke obs-smoke soak-smoke failover-smoke perf-smoke fleet-smoke quant-smoke trace-smoke multitask-smoke net-smoke replaynet-smoke league-smoke static-smoke
+.PHONY: test test-fast serve-smoke serve-bench chaos-smoke obs-smoke soak-smoke failover-smoke perf-smoke fleet-smoke quant-smoke trace-smoke multitask-smoke net-smoke replaynet-smoke obsnet-smoke league-smoke static-smoke
 
 # tier-1: fast unit + integration tests on the virtual 8-device CPU mesh
 test-fast:
@@ -81,6 +81,40 @@ replaynet-smoke:
 	JAX_PLATFORMS=cpu $(PY) scripts/replay_net_smoke.py --duration 12 \
 	  --out /tmp/ria_replaynet_smoke
 	$(PY) scripts/lint_jsonl.py /tmp/ria_replaynet_smoke
+
+# live-telemetry-plane smoke (docs/OBSERVABILITY.md "Live fleet
+# telemetry"): the `obsnet`-marked tests (label escaping, /healthz crash
+# path, relay shed-not-stall, fleet fold transitions, alert edges,
+# obs_top golden — tier-1 too), then the REAL multi-process soak: 1 obs
+# collector + 3 toy trainers discovered purely via lease files, the
+# collector SIGKILLed cold mid-load and respawned at a bumped epoch;
+# gates (self-asserted, exit 1): training rows never stall, relays
+# shed + reconnect, the fleet view re-converges to ok on the NEW
+# incarnation — and the run dir lints as strict schema-versioned JSONL
+# (obs_net/alert/fleet_health rows included); obs_report must render the
+# `obsnet:` section off the soak's rows; then the obs_net_overhead bench
+# row must show the relayed learn loop within 3% of the obs_net=False
+# default (the never-load-bearing plane's cost gate)
+obsnet-smoke:
+	JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_obs_net.py -q -m obsnet
+	rm -rf /tmp/ria_obsnet_smoke
+	JAX_PLATFORMS=cpu $(PY) scripts/obs_net_smoke.py --duration 12 \
+	  --out /tmp/ria_obsnet_smoke
+	$(PY) scripts/lint_jsonl.py /tmp/ria_obsnet_smoke/obs_net_smoke
+	$(PY) scripts/obs_report.py /tmp/ria_obsnet_smoke/obs_net_smoke \
+	  | tee /tmp/ria_obsnet_smoke/report.txt
+	grep -q "obsnet:" /tmp/ria_obsnet_smoke/report.txt
+	JAX_PLATFORMS=cpu BENCH_OBSNET_ONLY=1 BENCH_WATCHDOG_SECS=240 \
+	  $(PY) bench.py | tee /tmp/ria_obsnet_smoke/bench.jsonl
+	$(PY) scripts/lint_jsonl.py /tmp/ria_obsnet_smoke/bench.jsonl
+	$(PY) -c "import json; rows = [json.loads(l) for l in \
+	  open('/tmp/ria_obsnet_smoke/bench.jsonl') if l.strip()]; \
+	  r = [x for x in rows if x.get('path') == 'obs_net_overhead'][-1]; \
+	  assert r.get('status') is None, 'obs_net_overhead row: %s' % r['status']; \
+	  print('obs_net_overhead: %.2f%% (relayed %.2f vs off %.2f steps/s)' \
+	        % (100 * r['value'], r['on_steps_per_sec'], \
+	           r['off_steps_per_sec'])); \
+	  assert r['value'] <= 0.03, 'obs_net relay overhead above 3%'"
 
 # chaos smoke: every named fault-injection point exercised end to end
 # (NaN rollback, corrupt-checkpoint fallback, torn-snapshot CRC, retried

@@ -135,6 +135,16 @@ def measure() -> None:
         ):
             print(json.dumps(row), flush=True)
         return
+    # obsnet mode (make obsnet-smoke / BENCH_OBSNET_ONLY=1): only the
+    # telemetry-relay overhead row — the <=3% learn-loop tax gate needs
+    # nothing else
+    if os.environ.get("BENCH_OBSNET_ONLY") == "1":
+        for row in _run_row_budgeted(
+            "obs_net_overhead", "obs_net_overhead_frac",
+            _measure_obs_net_overhead, left, share=0.9,
+        ):
+            print(json.dumps(row), flush=True)
+        return
     # multitask mode (make multitask-smoke / BENCH_MULTITASK_ONLY=1): only
     # the 2-game-vs-1-game learner-throughput row
     if os.environ.get("BENCH_MULTITASK_ONLY") == "1":
@@ -609,6 +619,170 @@ def _measure_trace_overhead(left=None) -> list:
         "traced_steps_per_sec": round(best_t, 2),
         "untraced_steps_per_sec": round(best_u, 2),
         "sample_every": sample_every,
+        "reps": rep,
+    }]
+
+
+def _measure_obs_net_overhead(left=None) -> list:
+    """Live-telemetry-plane overhead row (ISSUE 18): the SAME toy learner
+    loop as the trace_overhead row — sharded replay append + prefetch
+    sample + jitted learn + write-back ring, a MetricsLogger emitting one
+    `learn` row per step in BOTH arms — once with an ObsRelay attached and
+    STREAMING to a live loopback ObsCollector (the production obs_net
+    wiring: observer fan-out, spool, framed-socket sends, periodic registry
+    snapshots, collector ingest on the same box) and once at the obs_net
+    default (no relay constructed).  The arms differ only in the relay, so
+    ``overhead_frac`` = 1 - on/off is exactly what the acceptance bounds:
+    what turning the live fleet view on costs the learn loop.  `make
+    obsnet-smoke` gates the row at <= 3%."""
+    if left is None:
+        left = lambda: float("inf")  # noqa: E731
+    import shutil
+    import tempfile
+
+    import jax
+    import numpy as np
+
+    from rainbow_iqn_apex_tpu.config import Config
+    from rainbow_iqn_apex_tpu.obs.net.collector import ObsCollector
+    from rainbow_iqn_apex_tpu.obs.net.relay import ObsRelay
+    from rainbow_iqn_apex_tpu.obs.registry import MetricRegistry
+    from rainbow_iqn_apex_tpu.ops.learn import build_learn_step, init_train_state
+    from rainbow_iqn_apex_tpu.parallel.sharded_replay import ShardedReplay
+    from rainbow_iqn_apex_tpu.utils.logging import MetricsLogger
+    from rainbow_iqn_apex_tpu.utils.prefetch import make_replay_prefetcher
+    from rainbow_iqn_apex_tpu.utils.writeback import WritebackRing
+
+    platform = jax.devices()[0].platform
+    h = w = int(os.environ.get("BENCH_ON_FRAME", "44"))
+    lanes = int(os.environ.get("BENCH_ON_LANES", "64"))
+    ticks = int(os.environ.get("BENCH_ON_TICKS", "4"))
+    iters = int(os.environ.get("BENCH_ON_ITERS", "120"))
+    # same convergence discipline as trace_overhead: a 3% gate is thinner
+    # than single-rep scheduler noise, so interleave best-ofs
+    reps = int(os.environ.get("BENCH_ON_REPS", "4"))
+    max_reps = int(os.environ.get("BENCH_ON_MAX_REPS", "8"))
+    num_actions = 6
+    cfg = Config().replace(
+        compute_dtype="float32", frame_height=h, frame_width=w,
+        history_length=2, hidden_size=32, num_cosines=8,
+        num_tau_samples=4, num_tau_prime_samples=4, num_quantile_samples=4,
+        batch_size=16, multi_step=3, prefetch_depth=2,
+    )
+    learn = jax.jit(build_learn_step(cfg, num_actions))
+    rng = np.random.default_rng(0)
+    pool = [
+        (
+            rng.integers(0, 255, (lanes, h, w), dtype=np.uint8),
+            rng.integers(0, num_actions, lanes).astype(np.int64),
+            rng.normal(size=lanes).astype(np.float32),
+            (rng.random(lanes) < 0.01),
+        )
+        for _ in range(16)
+    ]
+    tmpdir = tempfile.mkdtemp(prefix="ria_obsnet_bench_")
+
+    def run(relayed: bool, run_iters: int, tag: int) -> float:
+        memory = ShardedReplay.build(
+            1, 1 << 15, lanes, frame_shape=(h, w), history=2, n_step=3,
+            gamma=0.99, priority_exponent=0.5, seed=0,
+        )
+        logger = MetricsLogger(
+            os.path.join(tmpdir, f"obsnet_{tag}_{int(relayed)}.jsonl"),
+            "bench", echo=False)
+        collector = relay = None
+        if relayed:
+            collector = ObsCollector(
+                host="127.0.0.1", port=0, tick_s=0.5, serve_http=False,
+                rules=[])
+            relay = ObsRelay(
+                collector_addr=("127.0.0.1", collector.port),
+                role="learner", run_id="bench",
+                registry=MetricRegistry(), logger=logger, snapshot_s=0.5)
+            logger.add_observer(relay.observe)
+
+        def actor_tick(t: int) -> None:
+            f, a, r, d = pool[t % len(pool)]
+            memory.append_batch(f, a, r, d)
+
+        for t in range(4096 // lanes + 8):
+            actor_tick(t)
+        state = init_train_state(cfg, num_actions, jax.random.PRNGKey(0))
+        key = jax.random.PRNGKey(1)
+        pf = make_replay_prefetcher(memory, cfg, lambda: 0.6)
+        ring = WritebackRing(cfg.writeback_depth)
+        try:
+            for _ in range(3):  # compile + warm
+                idx, batch = pf.get()
+                key, k = jax.random.split(key)
+                state, info = learn(state, batch, k)
+            jax.block_until_ready(info["loss"])
+            n = 0
+            t0 = time.perf_counter()
+            for i in range(run_iters):
+                for t in range(ticks):
+                    actor_tick(i * ticks + t)
+                step = i + 1
+                idx, batch = pf.get()
+                key, k = jax.random.split(key)
+                state, info = learn(state, batch, k)
+                retired = ring.push(step, idx, info)
+                if retired is not None:
+                    memory.update_priorities(retired.idx, retired.priorities)
+                logger.log("learn", step=step, frames=step * lanes * ticks,
+                           loss=0.5)
+                n = step
+                if left() < 15:
+                    break
+            for retired in ring.drain():
+                memory.update_priorities(retired.idx, retired.priorities)
+            jax.block_until_ready(info["loss"])
+            return n / (time.perf_counter() - t0)
+        finally:
+            pf.close()
+            if relay is not None:
+                relay.close(flush_timeout_s=1.0)
+            if collector is not None:
+                collector.stop()
+            logger.close()
+
+    best_off = best_on = 0.0
+    rep = 0
+    try:
+        while rep < max_reps and left() > 25:
+            prev = (best_off, best_on)
+            order = (False, True) if rep % 2 == 0 else (True, False)
+            for relayed in order:
+                sps = run(relayed, iters, rep)
+                if relayed:
+                    best_on = max(best_on, sps)
+                else:
+                    best_off = max(best_off, sps)
+                if left() < 20:
+                    break
+            rep += 1
+            if rep >= reps and best_off and best_on:
+                if best_off <= prev[0] * 1.02 and best_on <= prev[1] * 1.02:
+                    break
+    finally:
+        shutil.rmtree(tmpdir, ignore_errors=True)
+    if not (best_off and best_on):
+        return []
+    overhead = max(1.0 - best_on / best_off, 0.0)
+    return [{
+        "metric": "obs_net_overhead_frac",
+        "value": round(overhead, 4),
+        "unit": (
+            f"fraction of learn-loop throughput lost to the obs_net relay "
+            f"(toy {h}x{w}x2 batch={cfg.batch_size} loop on {platform}, one "
+            f"learn row logged per step, relay streaming to a live loopback "
+            f"collector vs the obs_net=False default; "
+            f"best-of-{rep} interleaved reps x {iters} iters)"
+        ),
+        "vs_baseline": None,
+        "path": "obs_net_overhead",
+        "on_steps_per_sec": round(best_on, 2),
+        "off_steps_per_sec": round(best_off, 2),
         "reps": rep,
     }]
 

@@ -81,6 +81,11 @@ DEFAULT_OFF: Dict[str, object] = {
     "resume": "",
     "failover_standby": False,
     "failover_warm": False,
+    "obs_net": False,
+    "obs_net_host": "",
+    "obs_net_port": 0,
+    "obs_net_advertise": "",
+    "obs_net_http_port": 0,
 }
 
 _DOC_CFG_RE = re.compile(r"`cfg\.([A-Za-z_][A-Za-z0-9_]*)`")

@@ -58,6 +58,7 @@ JAX_FREE_MODULES: Tuple[str, ...] = (
     "rainbow_iqn_apex_tpu/obs/pipeline_trace.py",
     "rainbow_iqn_apex_tpu/obs/registry.py",
     "rainbow_iqn_apex_tpu/netcore/",
+    "rainbow_iqn_apex_tpu/obs/net/",
     "rainbow_iqn_apex_tpu/obs/schema.py",
     "rainbow_iqn_apex_tpu/parallel/elastic.py",
     "rainbow_iqn_apex_tpu/parallel/failover.py",
@@ -72,6 +73,7 @@ JAX_FREE_MODULES: Tuple[str, ...] = (
     "rainbow_iqn_apex_tpu/utils/quantize.py",
     "scripts/lint_jsonl.py",
     "scripts/obs_report.py",
+    "scripts/obs_top.py",
     "scripts/relay_watch.py",
 )
 

@@ -51,6 +51,45 @@ class Config:
     # spans off; the always-on lag_* metrics cost a few histogram writes per
     # batch either way and change no numerics (off-path stays bitwise).
 
+    # ---- live fleet telemetry plane (obs/net/; docs/OBSERVABILITY.md) -------------
+    obs_net: bool = False  # relay gate: attach an ObsRelay to this process's
+    # MetricsLogger — every row it logs (and periodic registry snapshots)
+    # streams to the lease-discovered obs collector through a bounded
+    # non-blocking spool.  False (default) = no relay machinery runs and
+    # every code path is bitwise the pre-plane behaviour (tier-1 asserted).
+    # Telemetry is never load-bearing: a dead collector sheds rows, the
+    # local JSONL continues untouched.
+    obs_net_host: str = ""  # bind address for this process's ObsCollector
+    # ("" = no collector in this process, the default; the collector
+    # process sets it and registers an `obs_collector` lease carrying
+    # addr:port, same discovery as the replay/serving planes)
+    obs_net_port: int = 0  # collector listen port; 0 = ephemeral — the
+    # lease payload advertises whatever was bound
+    obs_net_advertise: str = ""  # address relays dial ("" = the bind host;
+    # set it when binding a wildcard or behind NAT)
+    obs_net_http_port: int = 0  # collector's aggregated /metrics + /fleetz
+    # HTTP port; 0 = ephemeral (the lease advertises it as `http_port`)
+    obs_net_spool: int = 2048  # relay spool capacity in rows: the buffering
+    # horizon an unreachable collector is ridden out over; a FULL spool
+    # sheds the NEWEST row with a counted, rate-limited reasoned row — the
+    # env/learn loop never blocks on telemetry
+    obs_net_snapshot_s: float = 5.0  # tier-2 cost knob: seconds between
+    # relay registry snapshots (counters/gauges/histograms shipped as one
+    # frame).  0 = rows-only (tier 1): the relay costs one deque append per
+    # logged row and nothing else
+    obs_net_stale_s: float = 10.0  # collector: a host whose stream has been
+    # silent this long degrades the fleet with reason `stale_host`
+    obs_net_resolution_s: float = 1.0  # time-series store bucket width —
+    # points landing in the same bucket downsample to last-write-wins
+    obs_net_window: int = 600  # ring-buffered points kept per series
+    obs_net_tick_s: float = 2.0  # collector fold cadence: fleet health +
+    # SLO alert evaluation + `fleet_health` row emission interval
+    obs_net_learn_floor: float = 0.0  # SLO alert: fleet learner steps/s
+    # below this floor fires `slo_learn_floor`; 0 = rule off
+    obs_net_shed_ceiling: float = 0.0  # SLO alert: shed rate (rows/s over
+    # the window, from health shed_total) above this fires
+    # `slo_shed_spike`; 0 = rule off
+
     # ---- resilience (utils/faults.py + parallel/supervisor.py; RESILIENCE.md) ----
     fault_spec: str = ""  # chaos injection, e.g. "nan_loss@5,checkpoint_write@1"
     # (point@n = fire on n-th call, point:p = seeded probability, bare point =

@@ -97,6 +97,15 @@ class LeagueController:
         self.exploit_skips = 0
         self._offsets: Dict[str, int] = {}  # member jsonl tail offsets
         self._last_sweep = self.clock()
+        # live fleet telemetry (obs/net/): the controller is a device-less
+        # role that should still show up on the fleet dashboard — attach a
+        # relay to its logger when the plane is on (None otherwise)
+        self.obs_relay = None
+        if metrics is not None and getattr(cfg, "obs_net", False):
+            from rainbow_iqn_apex_tpu.obs.net.relay import ObsRelay
+
+            self.obs_relay = ObsRelay.attach(
+                cfg, metrics, registry=registry, role="league")
 
         os.makedirs(self.league_dir, exist_ok=True)
         # ---- population: resume genomes from disk, else seed them --------
@@ -370,3 +379,6 @@ class LeagueController:
 
     def stop_all(self) -> None:
         self.sup.stop_all()
+        if self.obs_relay is not None:
+            self.obs_relay.close()
+            self.obs_relay = None

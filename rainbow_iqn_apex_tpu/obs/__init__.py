@@ -150,6 +150,17 @@ class RunObs:
             self.http = ObsHTTPServer(
                 self.registry, self.health.healthz, port=port
             ).start()
+        # live fleet telemetry (obs/net/; docs/OBSERVABILITY.md "Live fleet
+        # telemetry"): with cfg.obs_net the run's rows + registry snapshots
+        # also stream to the lease-discovered collector.  Lazy import keeps
+        # the plane's code entirely off the default path (attach returns
+        # None when the gate is off, so nothing is constructed either).
+        self.relay = None
+        if getattr(cfg, "obs_net", False):
+            from rainbow_iqn_apex_tpu.obs.net.relay import ObsRelay
+
+            self.relay = ObsRelay.attach(
+                cfg, metrics, registry=self.registry, role=role)
         self._steps = self.registry.gauge("learn_step", role)
         self._frames = self.registry.gauge("frames", role)
         self._closed = False
@@ -201,6 +212,9 @@ class RunObs:
         try:
             self.periodic(step, frames, **gauges)
         finally:
+            if self.relay is not None:
+                self.relay.close()
+                self.relay = None
             if self.http is not None:
                 self.http.stop()
                 self.http = None

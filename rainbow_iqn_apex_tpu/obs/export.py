@@ -32,22 +32,48 @@ def _prom_name(name: str) -> str:
     return "ria_" + _NAME_RE.sub("_", name)
 
 
-def prometheus_text(registry: MetricRegistry) -> str:
-    """The registry in Prometheus text exposition format (v0.0.4)."""
+def escape_label_value(value: str) -> str:
+    """Prometheus text-format label-value escaping: backslash, double
+    quote, and newline must be escaped or a hostile/odd role (or host)
+    string corrupts the whole exposition (one bad label breaks every
+    scraper parsing the page, not just its own line)."""
+    return (
+        str(value)
+        .replace("\\", "\\\\")
+        .replace('"', '\\"')
+        .replace("\n", "\\n")
+    )
+
+
+def _label_str(pairs: "list[tuple[str, str]]") -> str:
+    if not pairs:
+        return ""
+    inner = ",".join(
+        f'{k}="{escape_label_value(v)}"' for k, v in pairs
+    )
+    return "{" + inner + "}"
+
+
+def prometheus_text(
+    registry: MetricRegistry,
+    extra_labels: Optional[Dict[str, str]] = None,
+) -> str:
+    """The registry in Prometheus text exposition format (v0.0.4).
+
+    ``extra_labels`` ride on every sample — the obs collector re-exports
+    one registry per fleet host with ``{"host": ...}`` here."""
+    extra = sorted((extra_labels or {}).items())
     lines = []
     for name, role, metric in registry.collect():
         pname = _prom_name(name)
-        label = f'{{role="{role}"}}' if role else ""
+        base = ([("role", role)] if role else []) + extra
+        label = _label_str(base)
         if isinstance(metric, Histogram):
             snap = metric.snapshot()
             lines.append(f"# TYPE {pname} summary")
             for q, key in (("0.5", "p50"), ("0.9", "p90"), ("0.99", "p99")):
                 if key in snap:
-                    qlabel = (
-                        f'{{role="{role}",quantile="{q}"}}'
-                        if role
-                        else f'{{quantile="{q}"}}'
-                    )
+                    qlabel = _label_str(base + [("quantile", q)])
                     lines.append(f"{pname}{qlabel} {snap[key]:.6g}")
             lines.append(f"{pname}_count{label} {metric.total_count}")
             lines.append(f"{pname}_sum{label} {metric.total_sum:.6g}")
@@ -69,9 +95,16 @@ class ObsHTTPServer:
         health_fn: Optional[Callable[[], Dict[str, Any]]] = None,
         port: int = 0,
         host: str = "127.0.0.1",
+        metrics_text_fn: Optional[Callable[[], str]] = None,
+        routes: Optional[Dict[str, Callable[[], Dict[str, Any]]]] = None,
     ):
         self.registry = registry
         self.health_fn = health_fn
+        # the obs collector overrides /metrics with its host-labelled fleet
+        # aggregate and mounts extra JSON endpoints (/fleetz) here; plain
+        # runs leave both None and serve exactly the pre-fleet surface
+        self.metrics_text_fn = metrics_text_fn
+        self.routes = dict(routes or {})
         outer = self
 
         class Handler(BaseHTTPRequestHandler):
@@ -90,11 +123,12 @@ class ObsHTTPServer:
                 path = self.path.split("?", 1)[0]
                 try:
                     if path == "/metrics":
-                        self._send(
-                            200,
-                            prometheus_text(outer.registry),
-                            "text/plain; version=0.0.4",
+                        text = (
+                            outer.metrics_text_fn()
+                            if outer.metrics_text_fn is not None
+                            else prometheus_text(outer.registry)
                         )
+                        self._send(200, text, "text/plain; version=0.0.4")
                     elif path == "/healthz":
                         health = (
                             outer.health_fn() if outer.health_fn is not None
@@ -104,10 +138,37 @@ class ObsHTTPServer:
                         self._send(
                             code, json.dumps(sanitize(health)), "application/json"
                         )
+                    elif path in outer.routes:
+                        self._send(
+                            200,
+                            json.dumps(sanitize(outer.routes[path]())),
+                            "application/json",
+                        )
                     else:
                         self._send(404, "not found\n", "text/plain")
                 except (BrokenPipeError, ConnectionResetError):
                     pass  # client went away mid-scrape; nothing to serve
+                except Exception as e:
+                    # a broken health/route callback must answer a reasoned
+                    # 500, not kill the response mid-scrape with a traceback
+                    # (the pre-r18 /healthz crash path): count it, then try
+                    # to tell the scraper what broke — best-effort, the
+                    # headers may already be gone
+                    outer.registry.counter(
+                        "obs_http_errors_total", "obs"
+                    ).inc()
+                    try:
+                        self._send(
+                            500,
+                            json.dumps(
+                                {"status": "error",
+                                 "error": type(e).__name__,
+                                 "path": path}
+                            ),
+                            "application/json",
+                        )
+                    except Exception:
+                        pass
 
         self._httpd = ThreadingHTTPServer((host, port), Handler)
         self._httpd.daemon_threads = True

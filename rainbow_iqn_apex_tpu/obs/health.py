@@ -169,6 +169,20 @@ class RunHealth:
                     self._win_faults["replay_net_flap"] += 1
                 self.registry.counter(
                     "replay_net_flaps_total", "health").inc()
+        elif kind == "obs_net":
+            # live telemetry plane flaps (obs/net/): a relay disconnect /
+            # reconnect / spool shed means the LIVE fleet view is lossy or
+            # churning this window — training is untouched (the plane is
+            # never load-bearing and the local JSONL stays complete), but
+            # an operator watching the dashboard is watching a partial
+            # fleet, so the window degrades with the reason counted
+            event = row.get("event")
+            if event in ("disconnect", "reconnect", "spool_shed"):
+                with self._lock:
+                    self.fault_counts["obs_net_flap"] += 1
+                    self._win_faults["obs_net_flap"] += 1
+                self.registry.counter(
+                    "obs_net_flaps_total", "health").inc()
         elif kind == "gossip":
             # federation visibility only: stale peers skew dispatch but the
             # router stays correct (its own view is authoritative), so the

@@ -1,0 +1,202 @@
+"""Declarative SLO alerting over the collector's fleet view.
+
+An ``AlertRule`` is data, not code: a rule names the series it watches
+(row kind + numeric field per (host, role) target), the comparison, and
+the debounce — the engine turns the fleet's SeriesStore into firing /
+resolved EDGES, emitted as schema'd ``alert`` rows.  Edges, not levels:
+a page-worthy condition logs exactly once when it starts and once when it
+clears, however many ticks it spans, so the JSONL stays greppable
+("alert rows = incidents") and a flapping metric can't flood the log
+faster than its own flap rate.
+
+Rule kinds:
+  threshold  fire when the latest value (or, with ``rate=True``, the
+             per-second rate of a monotone series) crosses ``limit``;
+  absence    fire when a target has logged NOTHING for ``absence_s``
+             (heartbeat absence — the dead-host alert that needs no
+             cooperating signal from the dead host);
+  budget     fire when any consumer's publish->adopt p99 in the target's
+             newest `lag` row exceeds that row's own carried budget (the
+             PR-9 propagation budget, fleet edition).
+
+``default_rules(cfg)`` is the shipped SLO set; all of it is opt-in via
+``obs_net_*`` knobs whose 0 defaults leave each rule off.  jax-free.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from typing import Any, Dict, List, Optional
+
+
+@dataclasses.dataclass(frozen=True)
+class AlertRule:
+    """One declarative SLO.  ``row_kind``/``field`` select the series;
+    exactly one of the kind-specific knobs gives the rule its meaning."""
+
+    name: str
+    why: str  # human sentence carried in every edge row (RUNBOOK pointer)
+    kind: str = "threshold"  # threshold | absence | budget
+    row_kind: str = ""  # series selector ("" + absence = any row at all)
+    field: str = ""
+    op: str = "gt"  # threshold: fire when value <op> limit (gt | lt)
+    limit: float = 0.0
+    rate: bool = False  # threshold compares the per-second RATE of a
+    # monotone series (e.g. learn `step`) instead of its level
+    absence_s: float = 0.0
+    role: str = ""  # restrict to targets of this role ("" = every target)
+    for_s: float = 0.0  # condition must HOLD this long before the firing
+    # edge (debounce: one slow tick is noise, a sustained breach is an SLO)
+
+
+class AlertEngine:
+    """Evaluate rules against the collector's store; emit edge rows.
+
+    Single-threaded by contract: only the collector's tick thread calls
+    ``evaluate`` (the lock lives in the collector around the store view),
+    so firing state needs no lock of its own."""
+
+    def __init__(self, rules: List[AlertRule], logger=None, registry=None):
+        self.rules = list(rules)
+        self.logger = logger
+        self.registry = registry
+        # (rule.name, target) -> since-monotonic while breached-not-yet-
+        # fired; promoted to -1.0 once the firing edge is emitted
+        self._state: Dict[tuple, float] = {}
+
+    def firing(self) -> List[Dict[str, str]]:
+        """Currently-firing (rule, target) pairs — the /fleetz view."""
+        return [
+            {"alert": name, "target": target}
+            for (name, target), since in sorted(self._state.items())
+            if since < 0
+        ]
+
+    def _edge(self, rule: AlertRule, target: str, state: str,
+              value: Optional[float]) -> None:
+        if self.registry is not None:
+            self.registry.counter(
+                f"alerts_{state}_total", "obs_net").inc()
+        if self.logger is None:
+            return
+        try:
+            self.logger.log(
+                "alert", alert=rule.name, state=state, target=target,
+                value=value, limit=rule.limit, why=rule.why)
+        except Exception:
+            pass  # alerting must never take down the collector
+
+    def _value(self, rule: AlertRule, store, target: str
+               ) -> Optional[float]:
+        if rule.rate:
+            return store.rate(target, rule.row_kind, rule.field)
+        return store.latest(target, rule.row_kind, rule.field)
+
+    def _breached(self, rule: AlertRule, store, target: str,
+                  last_rows: Dict[str, Dict[str, Any]],
+                  age_s: float) -> "tuple[bool, Optional[float]]":
+        if rule.kind == "absence":
+            return age_s > rule.absence_s, age_s
+        if rule.kind == "budget":
+            row = last_rows.get("lag")
+            if not row:
+                return False, None
+            budget = row.get("publish_adopt_budget_ms")
+            per = row.get("publish_adopt_ms_by_consumer") or {}
+            if not budget:
+                return False, None
+            worst = max(
+                (float((s or {}).get("p99", 0.0)) for s in per.values()),
+                default=0.0)
+            return worst > float(budget), worst
+        value = self._value(rule, store, target)
+        if value is None:
+            return False, None  # no data is absence's job, not threshold's
+        if rule.op == "lt":
+            return value < rule.limit, value
+        return value > rule.limit, value
+
+    def evaluate(self, store, targets: Dict[str, Dict[str, Any]],
+                 now: Optional[float] = None) -> List[Dict[str, Any]]:
+        """One tick: ``targets`` maps "host/role" -> {"role", "age_s",
+        "last_rows"} as prepared (under the collector's lock) by the tick
+        thread.  Returns the edges emitted, newest state included."""
+        now = time.monotonic() if now is None else now
+        edges: List[Dict[str, Any]] = []
+        live_keys = set()
+        for rule in self.rules:
+            for target, info in targets.items():
+                if rule.role and info.get("role") != rule.role:
+                    continue
+                key = (rule.name, target)
+                breached, value = self._breached(
+                    rule, store, target, info.get("last_rows") or {},
+                    float(info.get("age_s", 0.0)))
+                since = self._state.get(key)
+                if breached:
+                    live_keys.add(key)
+                    if since is None:
+                        self._state[key] = now  # breach observed; debounce
+                    if self._state[key] >= 0 and (
+                            now - self._state[key] >= rule.for_s):
+                        self._state[key] = -1.0
+                        self._edge(rule, target, "firing", value)
+                        edges.append({"alert": rule.name, "target": target,
+                                      "state": "firing", "value": value})
+                elif since is not None:
+                    if since < 0:  # was firing: emit the resolved edge
+                        self._edge(rule, target, "resolved", value)
+                        edges.append({"alert": rule.name, "target": target,
+                                      "state": "resolved", "value": value})
+                    del self._state[key]  # sub-debounce breaches just reset
+        # a target that vanished entirely (host evicted + lease cleaned up)
+        # resolves its firing alerts rather than pinning them forever
+        for key in [k for k in self._state if k not in live_keys
+                    and k[1] not in targets]:
+            if self._state[key] < 0:
+                rule = next((r for r in self.rules if r.name == key[0]), None)
+                if rule is not None:
+                    self._edge(rule, key[1], "resolved", None)
+                    edges.append({"alert": key[0], "target": key[1],
+                                  "state": "resolved", "value": None})
+            del self._state[key]
+        return edges
+
+
+def default_rules(cfg) -> List[AlertRule]:
+    """The shipped SLO set; every rule gated on its own knob so the
+    zero-config engine evaluates only heartbeat absence + the PR-9 budget
+    (both self-calibrating — no threshold to mis-set)."""
+    rules: List[AlertRule] = []
+    floor = float(getattr(cfg, "obs_net_learn_floor", 0.0) or 0.0)
+    if floor > 0:
+        rules.append(AlertRule(
+            name="learn_steps_floor",
+            why=(f"learner throughput below the {floor:g} steps/s SLO "
+                 "floor (RUNBOOK: slow learner triage)"),
+            row_kind="learn", field="step", rate=True,
+            op="lt", limit=floor, role="learner", for_s=5.0))
+    ceiling = float(getattr(cfg, "obs_net_shed_ceiling", 0.0) or 0.0)
+    if ceiling > 0:
+        rules.append(AlertRule(
+            name="obs_shed_spike",
+            why=(f"telemetry spool shedding above {ceiling:g} rows/s — "
+                 "the collector is unreachable or underwater and live "
+                 "visibility is lossy (local JSONL remains complete)"),
+            row_kind="obs_net", field="shed_rows", rate=True,
+            op="gt", limit=ceiling, for_s=2.0))
+    stale_s = float(getattr(cfg, "obs_net_stale_s", 10.0) or 10.0)
+    rules.append(AlertRule(
+        name="host_silent",
+        why=("no telemetry from this host past the staleness budget — "
+             "process dead, partitioned, or its relay wedged (RUNBOOK: "
+             "degraded-host triage)"),
+        kind="absence", absence_s=stale_s))
+    rules.append(AlertRule(
+        name="publish_adopt_budget",
+        why=("a consumer's publish->adopt p99 exceeds the propagation "
+             "budget its own lag row carries — it will fence (shed "
+             "frames) or serve stale-beyond-budget answers"),
+        kind="budget"))
+    return rules

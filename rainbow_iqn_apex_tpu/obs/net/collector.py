@@ -1,0 +1,582 @@
+"""ObsCollector: the `obs_collector` lease role — the fleet's live view.
+
+One collector per run ingests every relay's row stream (netcore framed
+sockets, one-way), and turns them into three live surfaces:
+
+  * a ring-buffered downsampling time-series store keyed
+    (host/role, row kind, numeric field) — the substrate the SLO alert
+    engine (alerts.py) and the dashboard (scripts/obs_top.py) query;
+  * a fleet-wide RunHealth: one per-host obs/health.py fold (logger=None —
+    the fold is silent; the JSONL of record is each host's own) plus an
+    aggregate status that NAMES offenders per host/role.  A host that
+    goes silent past ``obs_net_stale_s`` degrades the fleet with reason
+    ``stale_host`` — absence is a signal, not a gap;
+  * the existing ObsHTTPServer re-exporting aggregated Prometheus text
+    (every sample labelled ``host=``) plus a ``/fleetz`` JSON endpoint
+    with per-host status + staleness, which scripts/obs_top.py renders.
+
+The collector is NEVER load-bearing: it holds no training state, no relay
+blocks on it (their spools shed), and killing it mid-run costs only live
+visibility — restart it and the relays re-discover the new incarnation's
+lease (epoch bumped, so a lingering stale file never wins) and reconnect.
+
+jax-free: the collector owns no device and typically runs beside the
+league controller or on a CPU-only ops host.
+"""
+
+from __future__ import annotations
+
+import collections
+import selectors
+import socket
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+from rainbow_iqn_apex_tpu.netcore import framing
+from rainbow_iqn_apex_tpu.obs.export import (
+    ObsHTTPServer,
+    _label_str,
+    _prom_name,
+    prometheus_text,
+)
+from rainbow_iqn_apex_tpu.obs.health import RunHealth
+from rainbow_iqn_apex_tpu.obs.net.alerts import AlertEngine, default_rules
+from rainbow_iqn_apex_tpu.obs.registry import MetricRegistry
+
+_MAX_FRAME = 8 << 20  # telemetry frames are small; a peer declaring more
+# is broken or hostile — drop the connection, not the collector
+_RECV_BYTES = 1 << 16
+_STATUS_RANK = {"ok": 0, "degraded": 1, "failing": 2}
+
+
+class SeriesStore:
+    """Ring-buffered downsampled series: (target, kind, field) -> deque of
+    (bucket_start_s, last_value) at ``resolution_s`` granularity, bounded
+    at ``window`` buckets.  Last-write-wins within a bucket — telemetry
+    trend data, not an archive (the JSONL is the archive)."""
+
+    def __init__(self, resolution_s: float = 1.0, window: int = 600):
+        self.resolution_s = max(float(resolution_s), 1e-3)
+        self.window = max(int(window), 2)
+        self._lock = threading.Lock()
+        self._series: Dict[tuple, "collections.deque"] = {}
+
+    def add(self, target: str, kind: str, field: str, value: float,
+            now: Optional[float] = None) -> None:
+        now = time.time() if now is None else now
+        bucket = now - (now % self.resolution_s)
+        key = (target, kind, field)
+        with self._lock:
+            dq = self._series.get(key)
+            if dq is None:
+                dq = self._series[key] = collections.deque(
+                    maxlen=self.window)
+            if dq and dq[-1][0] == bucket:
+                dq[-1] = (bucket, float(value))
+            else:
+                dq.append((bucket, float(value)))
+
+    def latest(self, target: str, kind: str, field: str
+               ) -> Optional[float]:
+        with self._lock:
+            dq = self._series.get((target, kind, field))
+            return dq[-1][1] if dq else None
+
+    def rate(self, target: str, kind: str, field: str,
+             span_s: float = 30.0) -> Optional[float]:
+        """Per-second rate of a monotone series over the trailing span
+        (first/last sample inside it).  None until two buckets exist."""
+        with self._lock:
+            dq = self._series.get((target, kind, field))
+            if not dq or len(dq) < 2:
+                return None
+            pts = list(dq)
+        cutoff = pts[-1][0] - span_s
+        pts = [p for p in pts if p[0] >= cutoff]
+        if len(pts) < 2 or pts[-1][0] <= pts[0][0]:
+            return None
+        return (pts[-1][1] - pts[0][1]) / (pts[-1][0] - pts[0][0])
+
+    def series(self, target: str, kind: str, field: str
+               ) -> List[tuple]:
+        with self._lock:
+            dq = self._series.get((target, kind, field))
+            return list(dq) if dq else []
+
+    def keys(self) -> List[tuple]:
+        with self._lock:
+            return sorted(self._series)
+
+
+class _HostState:
+    """Per-(host/role) fold state; mutated only under the collector's
+    lock (the RunHealth inside carries its own)."""
+
+    def __init__(self, host: int, role: str, run: str, pid: int):
+        self.host = int(host)
+        self.role = str(role)
+        self.run = str(run)
+        self.pid = int(pid)
+        self.health = RunHealth(MetricRegistry(), logger=None, role=role)
+        self.last_seen = time.monotonic()
+        self.rows = 0
+        self.last_step = 0
+        self.last_rows: Dict[str, Dict[str, Any]] = {}  # kind -> newest row
+        self.snapshot: Dict[str, Any] = {}  # newest registry as_dict()
+        self.status = "ok"
+        self.reasons: List[str] = []
+
+
+class _Conn:
+    """One relay connection; touched only by the ingest thread."""
+
+    def __init__(self, sock: socket.socket, peer: str):
+        self.sock = sock
+        self.peer = peer
+        self.reader = framing.FrameReader(_MAX_FRAME)
+        self.target: Optional[str] = None  # set by the hello frame
+
+
+class ObsCollector:
+    """Accept loop + tick loop + HTTP re-export; see the module docstring.
+
+    ``from_config`` is the default-off seam (None unless
+    ``cfg.obs_net_host`` names a bind address); ``attach_lease`` stamps
+    the `obs_collector` contract fields onto a HeartbeatWriter so relays
+    and dashboards can find this incarnation."""
+
+    def __init__(
+        self,
+        host: str = "127.0.0.1",
+        port: int = 0,
+        advertise: str = "",
+        http_port: int = 0,
+        stale_s: float = 10.0,
+        resolution_s: float = 1.0,
+        window: int = 600,
+        tick_s: float = 2.0,
+        logger=None,
+        registry: Optional[MetricRegistry] = None,
+        rules: Optional[list] = None,
+        serve_http: bool = True,
+    ):
+        self.host = host
+        self.advertise = advertise or host
+        self.stale_s = float(stale_s)
+        self.tick_s = max(float(tick_s), 0.05)
+        self.logger = logger
+        self.registry = registry if registry is not None else MetricRegistry()
+        self.store = SeriesStore(resolution_s=resolution_s, window=window)
+        self.engine = AlertEngine(
+            rules if rules is not None else [],
+            logger=logger, registry=self.registry)
+        self._lock = threading.Lock()
+        self._hosts: Dict[str, _HostState] = {}
+        self._fleet: Dict[str, Any] = {"status": "ok", "hosts": {}}
+        self._firing: List[Dict[str, str]] = []
+        self._stop = threading.Event()
+        self._listener = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._listener.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._listener.bind((host, port))
+        self._listener.listen(128)
+        self._listener.setblocking(False)
+        self.port = self._listener.getsockname()[1]
+        self.http: Optional[ObsHTTPServer] = None
+        if serve_http:
+            self.http = ObsHTTPServer(
+                self.registry,
+                health_fn=self.fleet_healthz,
+                port=http_port,
+                host=host,
+                metrics_text_fn=self.metrics_text,
+                routes={"/fleetz": self.fleetz},
+            ).start()
+        self._serve_thread = threading.Thread(
+            target=self._serve, name="obsnet-collector", daemon=True)
+        self._tick_thread = threading.Thread(
+            target=self._tick_loop, name="obsnet-tick", daemon=True)
+        self._serve_thread.start()
+        self._tick_thread.start()
+
+    # ------------------------------------------------------------- plumbing
+    @classmethod
+    def from_config(cls, cfg, logger=None) -> Optional["ObsCollector"]:
+        """None unless ``cfg.obs_net_host`` names a bind address — running
+        a collector is a per-process role decision, not a fleet default."""
+        bind = getattr(cfg, "obs_net_host", "")
+        if not bind:
+            return None
+        return cls(
+            host=bind,
+            port=getattr(cfg, "obs_net_port", 0),
+            advertise=getattr(cfg, "obs_net_advertise", ""),
+            http_port=getattr(cfg, "obs_net_http_port", 0),
+            stale_s=getattr(cfg, "obs_net_stale_s", 10.0),
+            resolution_s=getattr(cfg, "obs_net_resolution_s", 1.0),
+            window=getattr(cfg, "obs_net_window", 600),
+            tick_s=getattr(cfg, "obs_net_tick_s", 2.0),
+            logger=logger,
+            rules=default_rules(cfg),
+        )
+
+    def attach_lease(self, writer) -> None:
+        """Stamp the discovery contract onto this process's lease BEFORE
+        ``writer.start()``: relays dial ``addr:port``; dashboards hit
+        ``http_port``.  The writer's role must be "obs_collector"."""
+        writer.update_payload(
+            addr=self.advertise, port=self.port,
+            http_port=self.http.port if self.http is not None else 0)
+
+    def _log(self, event: str, **fields: Any) -> None:
+        if self.logger is not None:
+            try:
+                self.logger.log("obs_net", event=event, collector=True,
+                                **fields)
+            except Exception:
+                pass
+
+    # --------------------------------------------------------------- ingest
+    def _serve(self) -> None:
+        sel = selectors.DefaultSelector()
+        sel.register(self._listener, selectors.EVENT_READ, None)
+        conns: Dict[int, _Conn] = {}
+        try:
+            while not self._stop.is_set():
+                for key, _ in sel.select(timeout=0.2):
+                    if key.data is None:
+                        try:
+                            sock, addr = self._listener.accept()
+                        except OSError:
+                            continue
+                        sock.setblocking(False)
+                        conn = _Conn(sock, f"{addr[0]}:{addr[1]}")
+                        conns[sock.fileno()] = conn
+                        sel.register(sock, selectors.EVENT_READ, conn)
+                        self.registry.counter(
+                            "obsnet_accepts_total", "obs_net").inc()
+                    else:
+                        self._read(sel, conns, key.data)
+        finally:
+            for conn in list(conns.values()):
+                try:
+                    conn.sock.close()
+                except OSError:
+                    pass
+            sel.close()
+
+    def _read(self, sel, conns, conn: _Conn) -> None:
+        try:
+            data = conn.sock.recv(_RECV_BYTES)
+        except BlockingIOError:
+            return
+        except OSError:
+            data = b""
+        if not data:
+            self._close_conn(sel, conns, conn, "eof")
+            return
+        try:
+            frames = conn.reader.feed(data)
+        except framing.FrameError as e:
+            self.registry.counter("obsnet_bad_frames_total", "obs_net").inc()
+            self._close_conn(sel, conns, conn, type(e).__name__)
+            return
+        for header, _ in frames:
+            self._ingest(conn, header)
+
+    def _close_conn(self, sel, conns, conn: _Conn, why: str) -> None:
+        try:
+            sel.unregister(conn.sock)
+        except (KeyError, ValueError):
+            pass
+        conns.pop(conn.sock.fileno(), None)
+        try:
+            conn.sock.close()
+        except OSError:
+            pass
+        if conn.target is not None:
+            self._log("relay_gone", target=conn.target, why=why)
+
+    def _ingest(self, conn: _Conn, header: Dict[str, Any]) -> None:
+        op = header.get("op")
+        if op == "hello":
+            target = f"{header.get('host', 0)}/{header.get('role', '?')}"
+            conn.target = target
+            with self._lock:
+                st = self._hosts.get(target)
+                if st is None:
+                    st = self._hosts[target] = _HostState(
+                        header.get("host", 0), header.get("role", "?"),
+                        header.get("run", ""), header.get("pid", 0))
+                st.last_seen = time.monotonic()
+            self.registry.counter("obsnet_hellos_total", "obs_net").inc()
+            self._log("relay_hello", target=target)
+            return
+        if conn.target is None:
+            # rows before hello: a peer not speaking the protocol
+            self.registry.counter(
+                "obsnet_orphan_frames_total", "obs_net").inc()
+            return
+        with self._lock:
+            st = self._hosts.get(conn.target)
+            if st is None:
+                return
+            st.last_seen = time.monotonic()
+            if op == "snap":
+                st.snapshot = dict(header.get("metrics") or {})
+                return
+        if op != "rows":
+            self.registry.counter(
+                "obsnet_unknown_ops_total", "obs_net").inc()
+            return
+        rows = header.get("rows") or []
+        for row in rows:
+            if isinstance(row, dict):
+                self._ingest_row(st, conn.target, row)
+        self.registry.counter(
+            "obsnet_rows_total", "obs_net").inc(len(rows))
+
+    def _ingest_row(self, st: _HostState, target: str,
+                    row: Dict[str, Any]) -> None:
+        kind = str(row.get("kind", ""))
+        # the health fold carries its own lock; numeric fields feed the
+        # series store (bool excluded: True is not a sample)
+        st.health.observe_row(row)
+        for field, value in row.items():
+            if field in ("t", "ts", "schema", "host") or isinstance(
+                    value, bool):
+                continue
+            if isinstance(value, (int, float)):
+                self.store.add(target, kind, field, value)
+        with self._lock:
+            st.rows += 1
+            st.last_rows[kind] = row
+            if kind == "learn":
+                st.last_step = int(row.get("step", st.last_step) or 0)
+
+    # ----------------------------------------------------------------- tick
+    def _tick_loop(self) -> None:
+        while not self._stop.wait(self.tick_s):
+            try:
+                self.tick()
+            except Exception:
+                # the fleet view is best-effort; one bad tick (e.g. a
+                # half-ingested row shape) must not kill the loop
+                self.registry.counter(
+                    "obsnet_tick_errors_total", "obs_net").inc()
+
+    def tick(self, now: Optional[float] = None) -> Dict[str, Any]:
+        """Fold one fleet-health window: per-host status (stale hosts
+        degrade with reason ``stale_host``), aggregate with offenders
+        named, one ``fleet_health`` row, one alert-engine pass."""
+        now = time.monotonic() if now is None else now
+        with self._lock:
+            items = list(self._hosts.items())
+        targets: Dict[str, Dict[str, Any]] = {}
+        hosts_view: Dict[str, Any] = {}
+        worst = "ok"
+        offenders: List[str] = []
+        stale_hosts = 0
+        for target, st in items:
+            age = max(now - st.last_seen, 0.0)
+            if age > self.stale_s:
+                status, reasons = "degraded", ["stale_host"]
+                stale_hosts += 1
+            else:
+                hrow = st.health.tick(st.last_step)
+                status = hrow["status"]
+                reasons = self._reasons(hrow)
+            with self._lock:
+                st.status, st.reasons = status, reasons
+                last_rows = dict(st.last_rows)
+                rows = st.rows
+            targets[target] = {"role": st.role, "age_s": age,
+                               "last_rows": last_rows}
+            hosts_view[target] = {
+                "host": st.host, "role": st.role, "status": status,
+                "reasons": reasons, "age_s": round(age, 3),
+                "rows": rows, "step": st.last_step, "pid": st.pid,
+            }
+            if _STATUS_RANK[status] > _STATUS_RANK[worst]:
+                worst = status
+            if status != "ok":
+                offenders.append(f"{target}: {','.join(reasons) or status}")
+        edges = self.engine.evaluate(self.store, targets, now=now)
+        firing = self.engine.firing()
+        fleet = {
+            "status": worst,
+            "hosts": hosts_view,
+            "offenders": sorted(offenders),
+            "hosts_total": len(items),
+            "hosts_stale": stale_hosts,
+            "alerts_firing": firing,
+        }
+        with self._lock:
+            self._fleet = fleet
+            self._firing = firing
+        self.registry.gauge("fleet_status", "obs_net").set(
+            _STATUS_RANK[worst])
+        self.registry.gauge("fleet_hosts", "obs_net").set(len(items))
+        self.registry.gauge("fleet_hosts_stale", "obs_net").set(stale_hosts)
+        self.registry.gauge("fleet_alerts_firing", "obs_net").set(
+            len(firing))
+        if self.logger is not None:
+            try:
+                self.logger.log("fleet_health", **fleet)
+            except Exception:
+                pass
+        return {"fleet": fleet, "edges": edges}
+
+    @staticmethod
+    def _reasons(hrow: Dict[str, Any]) -> List[str]:
+        out = []
+        if hrow.get("faults_window"):
+            out.append("faults")
+        if hrow.get("hosts_dead"):
+            out.append("dead_hosts")
+        if hrow.get("hosts_fenced"):
+            out.append("fenced")
+        if hrow.get("lag_consumers"):
+            out.append("lagging")
+        if hrow.get("takeover_pending"):
+            out.append("takeover_pending")
+        if hrow.get("nan_strikes"):
+            out.append("nan_strikes")
+        if not out and hrow.get("status") not in (None, "ok"):
+            out.append(str(hrow.get("status")))
+        return out
+
+    # ------------------------------------------------------------- surfaces
+    def fleetz(self) -> Dict[str, Any]:
+        """/fleetz: the newest fleet fold, verbatim + a timestamp."""
+        with self._lock:
+            out = dict(self._fleet)
+        out["ts"] = round(time.time(), 3)
+        out["collector"] = {
+            "port": self.port,
+            "http_port": self.http.port if self.http is not None else 0,
+            "stale_s": self.stale_s,
+        }
+        return out
+
+    def fleet_healthz(self) -> Dict[str, Any]:
+        """/healthz serves the FLEET aggregate: this endpoint is the
+        fleet's health, the collector process itself being trivially alive
+        if it answered."""
+        with self._lock:
+            fleet = self._fleet
+            return {"status": fleet.get("status", "ok"),
+                    "hosts_total": fleet.get("hosts_total", 0),
+                    "hosts_stale": fleet.get("hosts_stale", 0),
+                    "offenders": fleet.get("offenders", [])}
+
+    def metrics_text(self) -> str:
+        """Aggregated Prometheus text: the collector's own registry plus
+        every host's newest snapshot re-exported with ``host=`` labels.
+        Snapshot scalars export as gauges (the wire as_dict() view does not
+        carry counter-vs-gauge kinds; rate() belongs to the scraper) and
+        histogram snapshots as summary quantiles."""
+        parts = [prometheus_text(self.registry)]
+        with self._lock:
+            snaps = [(t, dict(st.snapshot)) for t, st in
+                     sorted(self._hosts.items()) if st.snapshot]
+        for target, snap in snaps:
+            lines: List[str] = []
+            for key in sorted(snap):
+                value = snap[key]
+                name, _, rest = key.partition("{")
+                role = rest[:-1] if rest.endswith("}") else ""
+                pname = _prom_name(name)
+                base = ([("role", role)] if role else []) + [
+                    ("host", target)]
+                if isinstance(value, dict):
+                    lines.append(f"# TYPE {pname} summary")
+                    for q, k in (("0.5", "p50"), ("0.9", "p90"),
+                                 ("0.99", "p99")):
+                        if k in value:
+                            qlabel = _label_str(base + [("quantile", q)])
+                            lines.append(
+                                f"{pname}{qlabel} {value[k]:.6g}")
+                    lines.append(
+                        f"{pname}_count{_label_str(base)} "
+                        f"{value.get('count', 0):.6g}")
+                elif isinstance(value, (int, float)) and not isinstance(
+                        value, bool):
+                    lines.append(f"# TYPE {pname} gauge")
+                    lines.append(
+                        f"{pname}{_label_str(base)} {float(value):.6g}")
+            parts.append("\n".join(lines) + "\n" if lines else "")
+        return "".join(parts)
+
+    # ------------------------------------------------------------ lifecycle
+    def stats(self) -> Dict[str, Any]:
+        with self._lock:
+            return {"hosts": len(self._hosts),
+                    "status": self._fleet.get("status", "ok"),
+                    "alerts_firing": len(self._firing),
+                    "port": self.port}
+
+    def stop(self) -> None:
+        """Idempotent teardown; never raises."""
+        if self._stop.is_set():
+            return
+        self._stop.set()
+        try:
+            self._listener.close()
+        except OSError:
+            pass
+        self._serve_thread.join(timeout=5)
+        self._tick_thread.join(timeout=5)
+        if self.http is not None:
+            self.http.stop()
+        self._log("collector_stop", **self.stats())
+
+
+def run_collector(cfg, stop_event=None, ready_fn=None):
+    """Run the `obs_collector` role in this process until ``stop_event``.
+
+    The standalone driver: builds the run-dir logger
+    (``obs_collector.jsonl``), claims a fresh lease epoch (so a restarted
+    collector supersedes its own stale file in every relay's discovery),
+    advertises addr/port/http_port on the lease, and parks.  Returns the
+    collector's lifetime stats dict.  ``ready_fn(collector)`` fires once
+    the lease is live — the smoke's synchronization hook."""
+    import os
+    import threading as _threading
+
+    from rainbow_iqn_apex_tpu.parallel.elastic import (
+        HeartbeatWriter,
+        heartbeat_dir,
+        next_lease_epoch,
+    )
+    from rainbow_iqn_apex_tpu.utils.logging import MetricsLogger
+
+    stop_event = stop_event if stop_event is not None else _threading.Event()
+    run_dir = os.path.join(cfg.results_dir, cfg.run_id)
+    os.makedirs(run_dir, exist_ok=True)
+    pid = int(getattr(cfg, "process_id", 0) or 0)
+    logger = MetricsLogger(
+        os.path.join(run_dir, "obs_collector.jsonl"), cfg.run_id,
+        echo=False, host=pid)
+    collector = ObsCollector.from_config(cfg, logger=logger)
+    if collector is None:
+        logger.close()
+        raise ValueError("run_collector: cfg.obs_net_host is unset — "
+                         "nothing to bind (docs/OBSERVABILITY.md)")
+    hb = heartbeat_dir(cfg)
+    writer = HeartbeatWriter(
+        hb, pid, max(getattr(cfg, "heartbeat_interval_s", 1.0), 0.1),
+        role="obs_collector", epoch=next_lease_epoch(hb, pid))
+    collector.attach_lease(writer)
+    writer.start()
+    try:
+        if ready_fn is not None:
+            ready_fn(collector)
+        while not stop_event.wait(0.2):
+            pass
+    finally:
+        stats = collector.stats()
+        writer.stop()
+        collector.stop()
+        logger.close()
+    return stats

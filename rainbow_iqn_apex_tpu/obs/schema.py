@@ -142,6 +142,23 @@ REQUIRED_KEYS: Dict[str, frozenset] = {
     # learn time, ring retirement, router dispatch, batcher slot wait) plus
     # publish_adopt_ms_by_consumer and the max_weight_lag-derived
     # publish_adopt_budget_ms RunHealth folds breaches against
+    # live fleet telemetry rows (obs/net/; docs/OBSERVABILITY.md "Live
+    # fleet telemetry"):
+    "obs_net": frozenset({"event"}),  # telemetry-plane lifecycle + stats
+    # (relay side: connect/disconnect/reconnect/spool_shed carry `relay` +
+    # `collector`, "stats" is the periodic spool/sent/shed snapshot;
+    # collector side: relay_hello/relay_gone/collector_stop carry
+    # `collector`: true.  RunHealth folds the relay flap + shed events as
+    # window-degraded, same story as `net`/`replay_net` — live visibility
+    # is churning even though the local JSONL is untouched)
+    "alert": frozenset({"alert", "state"}),  # one SLO edge from the
+    # collector's alert engine (obs/net/alerts.py): state firing/resolved,
+    # `target` is "host/role", `value`/`limit`/`why` make the row
+    # self-contained — alert rows are incidents, not levels
+    "fleet_health": frozenset({"status", "hosts"}),  # the collector's
+    # periodic fleet fold: aggregate status (worst host wins), per-target
+    # status/reasons/staleness under `hosts`, offenders NAMED per
+    # host/role, hosts_total/hosts_stale/alerts_firing gauges riding along
 }
 
 HEALTH_STATUSES = ("ok", "degraded", "failing")

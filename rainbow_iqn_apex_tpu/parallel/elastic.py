@@ -311,6 +311,11 @@ class Lease:
     # (epoch climbs) while the learner ROLE stays at one learner_epoch until
     # a standby takes over.  Standbys fence takeover claims on it.
     learner_epoch: int = 0
+    # live fleet telemetry payload (obs/net/): where the obs collector's
+    # aggregated /metrics + /fleetz HTTP endpoint listens — dashboards
+    # (scripts/obs_top.py) discover it through the same lease the relays
+    # dial, no second discovery channel
+    http_port: int = 0
 
 
 # ---------------------------------------------------------- lease monitoring
@@ -386,6 +391,7 @@ class HeartbeatMonitor:
                 learner_epoch=int(payload.get("learner_epoch", 0) or 0),
                 addr=str(payload.get("addr", "") or ""),
                 port=int(payload.get("port", 0) or 0),
+                http_port=int(payload.get("http_port", 0) or 0),
             )
         return out
 

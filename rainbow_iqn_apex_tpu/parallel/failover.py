@@ -394,6 +394,14 @@ def run_standby(cfg, max_wait_s: Optional[float] = None) -> Dict[str, Any]:
         echo=False, host=getattr(cfg, "process_id", 0),
     )
     faults.install_from(cfg)
+    # live fleet telemetry (obs/net/): an idle standby is exactly the kind
+    # of silent process a dashboard must see — attach a relay when the
+    # plane is on (None otherwise; the standby stays jax-free either way)
+    obs_relay = None
+    if getattr(cfg, "obs_net", False):
+        from rainbow_iqn_apex_tpu.obs.net.relay import ObsRelay
+
+        obs_relay = ObsRelay.attach(cfg, metrics, role="standby")
 
     def takeover(epoch: int, warm_params: Optional[Any]) -> Any:
         # the jax-heavy half, imported only when the role is actually
@@ -420,6 +428,8 @@ def run_standby(cfg, max_wait_s: Optional[float] = None) -> Dict[str, Any]:
     finally:
         if heartbeat is not None:
             heartbeat.stop()
+        if obs_relay is not None:
+            obs_relay.close()
         metrics.close()
     if result is None:
         return {"takeover": False, "claims_lost": standby.claims_lost}

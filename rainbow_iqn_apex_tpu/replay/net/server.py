@@ -139,6 +139,10 @@ class ReplayShardServer:
         # op, read (under the lock) by every reply — the event loop never
         # touches the un-thread-safe memory itself
         self._adv: Dict[str, Any] = {}
+        # live fleet telemetry (obs/net/): from_config attaches a relay so
+        # a disaggregated replay host shows up on the fleet dashboard like
+        # every other role; None on the default path and direct constructs
+        self.obs_relay = None
         self._refresh_advisory()
         if snapshot_prefix is not None:
             self._maybe_restore()
@@ -151,12 +155,17 @@ class ReplayShardServer:
         — replay stays in-process, bitwise the pre-net path."""
         if not getattr(cfg, "replay_net_host", ""):
             return None
-        return cls(
+        srv = cls(
             memory, shard_base=int(cfg.replay_net_shard_base),
             host=cfg.replay_net_host, port=cfg.replay_net_port,
             advertise=cfg.replay_net_advertise or None,
             max_frame_bytes=int(cfg.replay_net_max_frame_mb) << 20,
             epoch=epoch, snapshot_prefix=snapshot_prefix, logger=logger)
+        if logger is not None and getattr(cfg, "obs_net", False):
+            from rainbow_iqn_apex_tpu.obs.net.relay import ObsRelay
+
+            srv.obs_relay = ObsRelay.attach(cfg, logger, role="replay_shard")
+        return srv
 
     def attach_lease(self, writer) -> None:
         """Advertise ``addr:port`` (and the shard block) in this server's
@@ -208,6 +217,9 @@ class ReplayShardServer:
             self._listener.close()
         except OSError:
             pass
+        if self.obs_relay is not None:
+            self.obs_relay.close()
+            self.obs_relay = None
 
     # -------------------------------------------------------------- event loop
     def _run(self) -> None:

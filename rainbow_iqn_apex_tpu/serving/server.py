@@ -72,6 +72,16 @@ class PolicyServer:
             if metrics_path
             else None
         )
+        # live fleet telemetry (obs/net/): serving hosts stream their rows
+        # + registry snapshots to the fleet collector too; None (nothing
+        # constructed) whenever the plane is off or there is no logger
+        self.obs_relay = None
+        if self.metrics.logger is not None and getattr(cfg, "obs_net", False):
+            from rainbow_iqn_apex_tpu.obs.net.relay import ObsRelay
+
+            self.obs_relay = ObsRelay.attach(
+                cfg, self.metrics.logger, registry=self.metrics.registry,
+                role="serve")
         self._obs_shape_early = tuple(state_shape or cfg.state_shape)
         # calibration for the quantization agreement gate: callers with real
         # traffic/replay frames pass them via engine.set_calibration; the
@@ -217,6 +227,9 @@ class PolicyServer:
         if self.obs_http is not None:
             self.obs_http.stop()
         self.metrics.emit(final=True)
+        if self.obs_relay is not None:
+            self.obs_relay.close()  # drains the final row before the close
+            self.obs_relay = None
         if self.metrics.logger is not None:
             self.metrics.logger.close()
         return self.metrics.stats()

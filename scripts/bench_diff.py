@@ -69,6 +69,10 @@ REPORTED = {
     # first-successor-publish latency is process-start machine weather; the
     # trajectory records it so a regression SHOWS without gating on it
     "failover_mttr": "value",
+    # the telemetry-relay tax on the learn loop is gated ABSOLUTE <= 3%
+    # inline in `make obsnet-smoke` (like trace_overhead in trace-smoke);
+    # recorded here so drift across rounds shows too
+    "obs_net_overhead": "value",
 }
 
 

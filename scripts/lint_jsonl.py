@@ -29,7 +29,10 @@ and scripts/ must name a registered kind, so registry and emitters move
 in the same commit.  Replay-reuse runs (cfg.replay_ratio > 1) extend
 ``learn``/``health``/``lag`` rows with optional payload keys under the
 same strict-JSON rules; the bench rows perf-smoke lints carry no ``kind``
-and skip schema validation by design.
+and skip schema validation by design.  The telemetry-plane soak
+(`make obsnet-smoke`) lints its run dir the same way: relay/collector
+lifecycle ``obs_net`` rows, SLO-edge ``alert`` rows, and the collector's
+periodic ``fleet_health`` fold all validate through this one registry.
 """
 
 from __future__ import annotations

@@ -318,6 +318,51 @@ def _replaynet_section(
     }
 
 
+def _obsnet_section(
+    by_kind: Dict[str, List[Dict[str, Any]]]
+) -> Dict[str, Any]:
+    """Fold live-telemetry-plane rows (obs/net/): relay lifecycle/shed
+    counts, the newest relay stats row, the newest collector fleet fold,
+    and alert edge totals — the offline answer to "was the live view
+    complete while this ran".  Empty dict when the plane was off."""
+    rows = by_kind.get("obs_net", [])
+    alerts = by_kind.get("alert", [])
+    fleet = by_kind.get("fleet_health", [])
+    if not rows and not alerts and not fleet:
+        return {}
+    events: Dict[str, int] = {}
+    for row in rows:
+        ev = str(row.get("event", "unknown"))
+        events[ev] = events.get(ev, 0) + 1
+    stats = [r for r in rows if r.get("event") == "stats"]
+    last = stats[-1] if stats else {}
+    last_fleet = fleet[-1] if fleet else {}
+    firing = sum(1 for a in alerts if a.get("state") == "firing")
+    resolved = sum(1 for a in alerts if a.get("state") == "resolved")
+    worst = "ok"
+    for r in fleet:
+        s = r.get("status")
+        if s == "failing" or (s == "degraded" and worst == "ok"):
+            worst = s
+    return {
+        "rows": len(rows),
+        "events": events,
+        "flaps": sum(events.get(e, 0) for e in
+                     ("disconnect", "reconnect", "spool_shed")),
+        "sent_rows": last.get("sent_rows"),
+        "shed_rows": last.get("shed_rows"),
+        "spool_depth": last.get("spool_depth"),
+        "reconnects": last.get("reconnects"),
+        "alerts_firing_edges": firing,
+        "alerts_resolved_edges": resolved,
+        "fleet_rows": len(fleet),
+        "fleet_last_status": last_fleet.get("status"),
+        "fleet_worst_status": worst if fleet else None,
+        "fleet_hosts": last_fleet.get("hosts_total"),
+        "fleet_offenders": last_fleet.get("offenders", []),
+    }
+
+
 def _quant_section(by_kind: Dict[str, List[Dict[str, Any]]]) -> Dict[str, Any]:
     """Fold quant/publish/quant_fallback rows: is the quantized path live,
     what did the gate last measure, and how many publish bytes the delta/
@@ -504,6 +549,9 @@ def aggregate(rows: List[Dict[str, Any]]) -> Dict[str, Any]:
         # cross-host replay plane (replay/net/): newest plane stats +
         # lifecycle flap counts (the remote-replay starvation triage input)
         "replaynet": _replaynet_section(by_kind),
+        # live telemetry plane (obs/net/): relay shed/reconnect counts,
+        # alert edges, the collector's newest fleet fold + named offenders
+        "obsnet": _obsnet_section(by_kind),
         # quantized inference + compressed distribution: gate agreement,
         # fallback count, publish bytes saved vs fp32-full
         "quant": _quant_section(by_kind),
@@ -653,6 +701,20 @@ def render(report: Dict[str, Any]) -> str:
         )
         if rn.get("events"):
             lines.append(f"  replaynet events: {rn['events']}")
+    on = report.get("obsnet") or {}
+    if on:
+        lines.append(
+            f"obsnet:  rows={on['rows']} flaps={on['flaps']} "
+            f"sent={on['sent_rows']} shed={on['shed_rows']} "
+            f"reconnects={on['reconnects']} "
+            f"alert_edges={on['alerts_firing_edges']}+"
+            f"{on['alerts_resolved_edges']} "
+            f"fleet last={on['fleet_last_status']} "
+            f"worst={on['fleet_worst_status']} "
+            f"hosts={on['fleet_hosts']}"
+        )
+        if on.get("fleet_offenders"):
+            lines.append(f"  offenders: {on['fleet_offenders']}")
     q = report["quant"]
     if q["gates"] or q["fallbacks"] or q["publishes"]:
         lines.append(

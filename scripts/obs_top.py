@@ -1,0 +1,172 @@
+#!/usr/bin/env python
+"""obs_top: live terminal dashboard over the obs collector's /fleetz.
+
+`top` for the training fleet — no curses, no dependencies: poll the
+collector's HTTP surface, render one plain-text frame per interval
+(ANSI home+clear between frames on a TTY), one line per host/role with
+status, throughput, lag, and firing alerts.  ``--once`` prints a single
+frame and exits 0/1/2 by fleet status — the CI/cron probe mode.
+
+The collector is found the same way relays find it: point ``--url`` at
+it directly, or give ``--results/--run`` and obs_top reads the
+`obs_collector` lease's advertised ``http_port`` (scripts never need a
+second discovery channel).
+
+Usage:
+    python scripts/obs_top.py --url http://127.0.0.1:9100
+    python scripts/obs_top.py --results results --run run0 --once
+
+jax-free (analysis/imports.py enforces it): ops laptops have no devices.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+import urllib.request
+from typing import Any, Dict, Optional
+
+_STATUS_GLYPH = {"ok": "ok      ", "degraded": "DEGRADED", "failing": "FAILING "}
+_EXIT_BY_STATUS = {"ok": 0, "degraded": 1, "failing": 2}
+
+
+def discover_url(results_dir: str, run_id: str, timeout_s: float = 30.0
+                 ) -> Optional[str]:
+    """The freshest `obs_collector` lease's advertised HTTP endpoint."""
+    from rainbow_iqn_apex_tpu.parallel.elastic import HeartbeatMonitor
+    import os
+
+    hb = os.path.join(results_dir, run_id, "heartbeats")
+    best = None
+    for lease in HeartbeatMonitor(hb, timeout_s).leases().values():
+        if (lease.role == "obs_collector" and lease.fresh
+                and lease.addr and lease.http_port):
+            if best is None or lease.epoch > best.epoch:
+                best = lease
+    return f"http://{best.addr}:{best.http_port}" if best else None
+
+
+def fetch_json(url: str, timeout_s: float = 3.0) -> Optional[Dict[str, Any]]:
+    try:
+        with urllib.request.urlopen(url, timeout=timeout_s) as resp:
+            return json.loads(resp.read().decode())
+    except Exception:
+        return None
+
+
+def fetch_text(url: str, timeout_s: float = 3.0) -> str:
+    try:
+        with urllib.request.urlopen(url, timeout=timeout_s) as resp:
+            return resp.read().decode()
+    except Exception:
+        return ""
+
+
+def _rates(fleetz: Dict[str, Any], prev: Optional[Dict[str, Any]],
+           dt_s: float) -> Dict[str, Dict[str, float]]:
+    """Per-target steps/s and rows/s between two /fleetz frames ({} keys
+    absent on the first frame — render shows '-')."""
+    out: Dict[str, Dict[str, float]] = {}
+    if not prev or dt_s <= 0:
+        return out
+    old = prev.get("hosts") or {}
+    for target, h in (fleetz.get("hosts") or {}).items():
+        o = old.get(target)
+        if not o:
+            continue
+        out[target] = {
+            "steps_s": max(h.get("step", 0) - o.get("step", 0), 0) / dt_s,
+            "rows_s": max(h.get("rows", 0) - o.get("rows", 0), 0) / dt_s,
+        }
+    return out
+
+
+def render(fleetz: Dict[str, Any], metrics_text: str = "",
+           rates: Optional[Dict[str, Dict[str, float]]] = None,
+           now: Optional[float] = None) -> str:
+    """One dashboard frame as plain text (pure: golden-tested)."""
+    rates = rates or {}
+    lines = []
+    status = fleetz.get("status", "?")
+    lines.append(
+        f"fleet {status.upper():9s} hosts={fleetz.get('hosts_total', 0)} "
+        f"stale={fleetz.get('hosts_stale', 0)} "
+        f"alerts={len(fleetz.get('alerts_firing') or [])}")
+    lines.append(
+        f"{'host/role':<18} {'status':<8} {'age_s':>7} {'step':>10} "
+        f"{'steps/s':>8} {'rows/s':>8}  reasons")
+    for target in sorted(fleetz.get("hosts") or {}):
+        h = fleetz["hosts"][target]
+        r = rates.get(target, {})
+        steps_s = f"{r['steps_s']:.1f}" if "steps_s" in r else "-"
+        rows_s = f"{r['rows_s']:.1f}" if "rows_s" in r else "-"
+        lines.append(
+            f"{target:<18} {_STATUS_GLYPH.get(h.get('status'), '?       ')} "
+            f"{h.get('age_s', 0):>7.1f} {h.get('step', 0):>10d} "
+            f"{steps_s:>8} {rows_s:>8}  "
+            f"{','.join(h.get('reasons') or []) or '-'}")
+    firing = fleetz.get("alerts_firing") or []
+    if firing:
+        lines.append("alerts firing:")
+        for a in firing:
+            lines.append(f"  {a.get('alert')}  @ {a.get('target')}")
+    offenders = fleetz.get("offenders") or []
+    if offenders:
+        lines.append("offenders: " + "; ".join(offenders))
+    # a couple of collector-side lines from /metrics keep the frame honest
+    # about the plane itself (ingest volume, tick errors)
+    for want in ("ria_obsnet_rows_total", "ria_fleet_alerts_firing",
+                 "ria_obsnet_tick_errors_total"):
+        for line in metrics_text.splitlines():
+            if line.startswith(want + "{") or line.startswith(want + " "):
+                lines.append(line)
+                break
+    return "\n".join(lines) + "\n"
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--url", default="",
+                    help="collector base URL (http://host:port)")
+    ap.add_argument("--results", default="results")
+    ap.add_argument("--run", default="run0")
+    ap.add_argument("--interval", type=float, default=2.0)
+    ap.add_argument("--once", action="store_true",
+                    help="print one frame; exit 0 ok / 1 degraded / "
+                         "2 failing (or unreachable)")
+    args = ap.parse_args(argv)
+
+    url = args.url or discover_url(args.results, args.run)
+    if not url:
+        print("obs_top: no --url and no fresh obs_collector lease found",
+              file=sys.stderr)
+        return 2
+    url = url.rstrip("/")
+
+    prev, prev_t = None, 0.0
+    while True:
+        fleetz = fetch_json(url + "/fleetz")
+        now = time.time()
+        if fleetz is None:
+            frame = f"collector unreachable at {url}\n"
+            status = "failing"
+        else:
+            metrics = fetch_text(url + "/metrics")
+            frame = render(fleetz, metrics,
+                           _rates(fleetz, prev, now - prev_t), now=now)
+            status = fleetz.get("status", "failing")
+            prev, prev_t = fleetz, now
+        if args.once:
+            sys.stdout.write(frame)
+            return _EXIT_BY_STATUS.get(status, 2)
+        if sys.stdout.isatty():
+            sys.stdout.write("\x1b[H\x1b[2J")
+        sys.stdout.write(f"{url}  {time.strftime('%H:%M:%S')}\n" + frame)
+        sys.stdout.flush()
+        time.sleep(args.interval)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,493 @@
+"""Live fleet telemetry plane (obs/net/; ISSUE 18).
+
+Loopback suite over REAL sockets, no jax:
+
+1. Prometheus label-value escaping — a role/host string carrying
+   backslash / quote / newline must not corrupt the exposition page
+   (the satellite-1 regression);
+2. /healthz crash path: a raising health callback answers a reasoned
+   500 (error name in the JSON body) and is counted, never a torn
+   response (satellite 2);
+3. relay -> collector end-to-end: rows stream, registry snapshots
+   re-export on /metrics with ``host=`` labels, /fleetz folds the host;
+4. relay shed-not-stall: with no collector, ``observe`` stays a bounded
+   deque append — the spool sheds the newest row, counted + reasoned,
+   and the local JSONL keeps every row;
+5. relay reconnect: a killed collector's replacement (same addr) is
+   re-dialed and streaming resumes, ``reconnects`` counted;
+6. fleet fold transitions: ok -> degraded with the offender NAMED
+   (fault window) -> heal; a silent host degrades with reason
+   ``stale_host`` and heals when rows resume;
+7. AlertEngine edges: threshold (rate + level), absence, budget, the
+   ``for_s`` debounce, vanished-target auto-resolve — firing and
+   resolved each emitted exactly once per episode;
+8. ``default_rules`` gating: zero-config ships only the self-calibrating
+   pair; the throughput/shed rules appear with their knobs;
+9. obs_top's pure ``render`` against a golden frame;
+10. the ``obs_net_*`` family defaults OFF: both ``from_config``
+    constructors return None on an unconfigured Config.
+
+``make obsnet-smoke`` runs the multi-process SIGKILL soak on top
+(scripts/obs_net_smoke.py).
+"""
+
+import json
+import socket
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+from rainbow_iqn_apex_tpu.config import Config
+from rainbow_iqn_apex_tpu.obs.export import (
+    ObsHTTPServer,
+    escape_label_value,
+    prometheus_text,
+)
+from rainbow_iqn_apex_tpu.obs.net.alerts import (
+    AlertEngine,
+    AlertRule,
+    default_rules,
+)
+from rainbow_iqn_apex_tpu.obs.net.collector import ObsCollector, SeriesStore
+from rainbow_iqn_apex_tpu.obs.net.relay import ObsRelay
+from rainbow_iqn_apex_tpu.obs.registry import MetricRegistry
+from rainbow_iqn_apex_tpu.utils.faults import RetryPolicy
+from rainbow_iqn_apex_tpu.utils.logging import MetricsLogger
+from scripts.obs_top import render
+
+pytestmark = pytest.mark.obsnet
+
+_FAST_RETRY = RetryPolicy(attempts=3, base_delay_s=0.01, max_delay_s=0.05)
+
+
+def _wait(predicate, timeout_s=5.0, interval_s=0.02):
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        if predicate():
+            return True
+        time.sleep(interval_s)
+    return predicate()
+
+
+def _collector(**kwargs):
+    kwargs.setdefault("tick_s", 30.0)  # manual tick() drives the tests
+    kwargs.setdefault("serve_http", False)
+    kwargs.setdefault("rules", [])
+    return ObsCollector(host="127.0.0.1", port=0, **kwargs)
+
+
+def _relay(port, **kwargs):
+    kwargs.setdefault("retry", _FAST_RETRY)
+    kwargs.setdefault("snapshot_s", 0.0)
+    return ObsRelay(
+        collector_addr=("127.0.0.1", port), host_id=kwargs.pop("host_id", 0),
+        role=kwargs.pop("role", "learner"), run_id="t", **kwargs)
+
+
+def _dead_port():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+# --------------------------------------------------------------- satellite 1
+def test_label_value_escaping():
+    assert escape_label_value('a"b') == 'a\\"b'
+    assert escape_label_value("a\\b") == "a\\\\b"
+    assert escape_label_value("a\nb") == "a\\nb"
+
+
+def test_prometheus_text_survives_hostile_labels():
+    reg = MetricRegistry()
+    reg.counter("evil", 'ro"le\n\\x').inc(3)
+    text = prometheus_text(reg, extra_labels={"host": '1/lea"rner'})
+    # every exposition line stays a single line: the raw newline inside the
+    # role must have been escaped, not emitted
+    sample = [ln for ln in text.splitlines() if ln.startswith("ria_evil{")]
+    assert len(sample) == 1
+    assert 'role="ro\\"le\\n\\\\x"' in sample[0]
+    assert 'host="1/lea\\"rner"' in sample[0]
+    assert sample[0].endswith(" 3")
+
+
+# --------------------------------------------------------------- satellite 2
+def test_healthz_crash_path_answers_500():
+    reg = MetricRegistry()
+
+    def broken():
+        raise ZeroDivisionError("boom")
+
+    srv = ObsHTTPServer(reg, health_fn=broken).start()
+    try:
+        with pytest.raises(urllib.error.HTTPError) as exc:
+            urllib.request.urlopen(srv.url + "/healthz", timeout=3)
+        assert exc.value.code == 500
+        body = json.loads(exc.value.read().decode())
+        assert body["error"] == "ZeroDivisionError"
+        assert body["path"] == "/healthz"
+        assert reg.counter("obs_http_errors_total", "obs").get() == 1
+        # a broken extra route takes the same path
+        srv.routes["/fleetz"] = broken
+        with pytest.raises(urllib.error.HTTPError) as exc:
+            urllib.request.urlopen(srv.url + "/fleetz", timeout=3)
+        assert exc.value.code == 500
+        assert reg.counter("obs_http_errors_total", "obs").get() == 2
+        # /metrics still serves after the crashes
+        with urllib.request.urlopen(srv.url + "/metrics", timeout=3) as resp:
+            assert resp.status == 200
+    finally:
+        srv.stop()
+
+
+# ------------------------------------------------------------- end-to-end
+def test_relay_streams_rows_and_snapshots_to_collector():
+    reg = MetricRegistry()
+    reg.counter("frames_total", "actor").inc(7)
+    col = _collector(serve_http=True)
+    relay = _relay(col.port, registry=reg, snapshot_s=0.05)
+    try:
+        for step in range(20):
+            relay.observe({"kind": "learn", "step": step, "loss": 0.5})
+        assert _wait(lambda: col.registry.counter(
+            "obsnet_rows_total", "obs_net").get() >= 20)
+        assert _wait(lambda: relay.stats()["snapshots_sent"] >= 1)
+        fleet = col.tick()["fleet"]
+        assert fleet["status"] == "ok"
+        assert fleet["hosts"]["0/learner"]["step"] == 19
+        assert fleet["hosts"]["0/learner"]["rows"] == 20
+        # the series store folded the numeric fields
+        assert col.store.latest("0/learner", "learn", "step") == 19.0
+        # the host's snapshot re-exports with host= labels on /metrics
+        assert _wait(lambda: 'host="0/learner"' in col.metrics_text())
+        text = col.metrics_text()
+        assert 'ria_frames_total{role="actor",host="0/learner"} 7' in text
+        # /fleetz over real HTTP
+        with urllib.request.urlopen(
+                col.http.url + "/fleetz", timeout=3) as resp:
+            fz = json.loads(resp.read().decode())
+        assert fz["hosts_total"] == 1
+        assert fz["collector"]["port"] == col.port
+        assert relay.stats()["shed_rows"] == 0
+    finally:
+        relay.close()
+        col.stop()
+
+
+def test_relay_sheds_newest_never_stalls_without_collector(tmp_path):
+    logger = MetricsLogger(str(tmp_path / "m.jsonl"), "t", echo=False)
+    reg = MetricRegistry()
+    relay = ObsRelay(collector_addr=("127.0.0.1", _dead_port()),
+                     role="actor", run_id="t", registry=reg, logger=logger,
+                     spool_rows=8, snapshot_s=0.0, retry=_FAST_RETRY)
+    logger.add_observer(relay.observe)
+    try:
+        t0 = time.monotonic()
+        for step in range(200):
+            logger.log("learn", step=step, frames=step, loss=0.1)
+        elapsed = time.monotonic() - t0
+        # no socket I/O on the logging path: 200 rows in well under the
+        # first connect timeout even on a loaded CI box
+        assert elapsed < 2.0
+        stats = relay.stats()
+        assert stats["spool_depth"] <= 8
+        assert stats["shed_rows"] >= 150
+        assert stats["sent_rows"] == 0
+        assert reg.counter("obsnet_shed_rows_total", "obs_net").get() \
+            == stats["shed_rows"]
+    finally:
+        relay.close()
+        logger.close()
+    # the local JSONL is untouched by the dead collector: every learn row
+    # is there, plus the reasoned shed row
+    rows = [json.loads(ln) for ln in
+            (tmp_path / "m.jsonl").read_text().splitlines()]
+    assert sum(1 for r in rows if r["kind"] == "learn") == 200
+    shed = [r for r in rows
+            if r["kind"] == "obs_net" and r["event"] == "spool_shed"]
+    assert shed and "spool full" in shed[0]["why"]
+
+
+def test_relay_reconnects_to_restarted_collector():
+    col = _collector()
+    port = col.port
+    relay = _relay(port)
+    try:
+        relay.observe({"kind": "learn", "step": 1})
+        assert _wait(lambda: col.registry.counter(
+            "obsnet_rows_total", "obs_net").get() >= 1)
+        col.stop()
+        col2 = ObsCollector(host="127.0.0.1", port=port, tick_s=30.0,
+                            serve_http=False, rules=[])
+        try:
+            # keep rows flowing: a dead TCP peer only surfaces on a FAILED
+            # send, which is what flips the relay into redial (rows in
+            # flight at the break are lost — at-most-once by design)
+            step = [1]
+
+            def _pump():
+                step[0] += 1
+                relay.observe({"kind": "learn", "step": step[0]})
+                return col2.registry.counter(
+                    "obsnet_rows_total", "obs_net").get() >= 1
+
+            assert _wait(_pump, timeout_s=10, interval_s=0.1)
+            assert relay.stats()["reconnects"] >= 1
+            fleet = col2.tick()["fleet"]
+            assert fleet["hosts"]["0/learner"]["step"] >= 2
+        finally:
+            col2.stop()
+    finally:
+        relay.close()
+        col.stop()
+
+
+# ------------------------------------------------------------- fleet fold
+def test_fleet_degrades_with_named_offender_then_heals():
+    col = _collector()
+    good = _relay(col.port, host_id=0, role="learner")
+    bad = _relay(col.port, host_id=1, role="actor")
+    try:
+        good.observe({"kind": "learn", "step": 5})
+        bad.observe({"kind": "learn", "step": 5})
+        assert _wait(lambda: col.registry.counter(
+            "obsnet_rows_total", "obs_net").get() >= 2)
+        assert col.tick()["fleet"]["status"] == "ok"
+        # host 1 logs a fault row: its window degrades, and the aggregate
+        # NAMES it — the other host stays ok
+        bad.observe({"kind": "fault", "event": "io_retry", "attempt": 1})
+        assert _wait(lambda: col.store.latest(
+            "1/actor", "fault", "attempt") is not None)
+        fleet = col.tick()["fleet"]
+        assert fleet["status"] == "degraded"
+        assert fleet["hosts"]["1/actor"]["status"] == "degraded"
+        assert fleet["hosts"]["1/actor"]["reasons"] == ["faults"]
+        assert fleet["hosts"]["0/learner"]["status"] == "ok"
+        assert fleet["offenders"] == ["1/actor: faults"]
+        # the fault window closed with the tick: next fold heals
+        assert col.tick()["fleet"]["status"] == "ok"
+    finally:
+        good.close()
+        bad.close()
+        col.stop()
+
+
+def test_silent_host_degrades_as_stale_then_heals():
+    col = _collector(stale_s=10.0)
+    relay = _relay(col.port)
+    try:
+        relay.observe({"kind": "learn", "step": 1})
+        assert _wait(lambda: col.registry.counter(
+            "obsnet_rows_total", "obs_net").get() >= 1)
+        now = time.monotonic()
+        assert col.tick(now=now)["fleet"]["status"] == "ok"
+        # silence past the staleness budget: degraded, reason stale_host
+        fleet = col.tick(now=now + 60.0)["fleet"]
+        assert fleet["status"] == "degraded"
+        assert fleet["hosts"]["0/learner"]["reasons"] == ["stale_host"]
+        assert fleet["offenders"] == ["0/learner: stale_host"]
+        assert fleet["hosts_stale"] == 1
+        # rows resume -> fresh again
+        relay.observe({"kind": "learn", "step": 2})
+        assert _wait(lambda: col.store.latest(
+            "0/learner", "learn", "step") == 2.0)
+        fleet = col.tick()["fleet"]
+        assert fleet["status"] == "ok"
+        assert fleet["hosts_stale"] == 0
+    finally:
+        relay.close()
+        col.stop()
+
+
+def test_fleet_health_row_lands_in_collector_jsonl(tmp_path):
+    logger = MetricsLogger(str(tmp_path / "c.jsonl"), "t", echo=False)
+    col = _collector(logger=logger)
+    relay = _relay(col.port)
+    try:
+        relay.observe({"kind": "learn", "step": 1})
+        assert _wait(lambda: col.registry.counter(
+            "obsnet_rows_total", "obs_net").get() >= 1)
+        col.tick()
+    finally:
+        relay.close()
+        col.stop()
+        logger.close()
+    rows = [json.loads(ln) for ln in
+            (tmp_path / "c.jsonl").read_text().splitlines()]
+    fh = [r for r in rows if r["kind"] == "fleet_health"]
+    assert fh and fh[-1]["status"] == "ok"
+    assert fh[-1]["hosts_total"] == 1
+    # every row kind the plane emits lints against the shared schema
+    from scripts.lint_jsonl import lint_file
+    assert lint_file(str(tmp_path / "c.jsonl")) == []
+
+
+# ------------------------------------------------------------- alert edges
+def _targets(age_s=0.0, last_rows=None, role="learner", target="0/learner"):
+    return {target: {"role": role, "age_s": age_s,
+                     "last_rows": last_rows or {}}}
+
+
+def test_threshold_rate_alert_fires_and_resolves(tmp_path):
+    logger = MetricsLogger(str(tmp_path / "a.jsonl"), "t", echo=False)
+    reg = MetricRegistry()
+    rule = AlertRule(name="learn_steps_floor", why="slow", row_kind="learn",
+                     field="step", rate=True, op="lt", limit=50.0,
+                     role="learner", for_s=0.0)
+    engine = AlertEngine([rule], logger=logger, registry=reg)
+    store = SeriesStore(resolution_s=1.0, window=600)
+    store.add("0/learner", "learn", "step", 0, now=100.0)
+    store.add("0/learner", "learn", "step", 100, now=110.0)  # 10 steps/s
+    edges = engine.evaluate(store, _targets(), now=110.0)
+    assert edges == [{"alert": "learn_steps_floor", "target": "0/learner",
+                      "state": "firing", "value": 10.0}]
+    assert engine.firing() == [{"alert": "learn_steps_floor",
+                                "target": "0/learner"}]
+    # still breached: no duplicate edge
+    assert engine.evaluate(store, _targets(), now=111.0) == []
+    # throughput recovers past the floor -> resolved exactly once
+    store.add("0/learner", "learn", "step", 2100, now=120.0)
+    edges = engine.evaluate(store, _targets(), now=120.0)
+    assert [e["state"] for e in edges] == ["resolved"]
+    assert engine.firing() == []
+    logger.close()
+    rows = [json.loads(ln) for ln in
+            (tmp_path / "a.jsonl").read_text().splitlines()]
+    alerts = [r for r in rows if r["kind"] == "alert"]
+    assert [a["state"] for a in alerts] == ["firing", "resolved"]
+    assert alerts[0]["alert"] == "learn_steps_floor"
+    assert reg.counter("alerts_firing_total", "obs_net").get() == 1
+    assert reg.counter("alerts_resolved_total", "obs_net").get() == 1
+
+
+def test_threshold_debounce_needs_sustained_breach():
+    rule = AlertRule(name="hot", why="w", row_kind="sys", field="temp",
+                     op="gt", limit=90.0, for_s=5.0)
+    engine = AlertEngine([rule])
+    store = SeriesStore()
+    store.add("0/learner", "sys", "temp", 95.0, now=0.0)
+    assert engine.evaluate(store, _targets(), now=0.0) == []  # breach starts
+    assert engine.evaluate(store, _targets(), now=3.0) == []  # sub-debounce
+    edges = engine.evaluate(store, _targets(), now=6.0)  # held 6s >= for_s
+    assert [e["state"] for e in edges] == ["firing"]
+    # a dip below resets the debounce clock without a resolved edge (the
+    # alert never fired for THIS episode once resolved)
+    store.add("0/learner", "sys", "temp", 50.0, now=7.0)
+    edges = engine.evaluate(store, _targets(), now=7.0)
+    assert [e["state"] for e in edges] == ["resolved"]
+    store.add("0/learner", "sys", "temp", 95.0, now=8.0)
+    assert engine.evaluate(store, _targets(), now=8.0) == []  # new debounce
+
+
+def test_absence_alert_and_vanished_target_resolution():
+    rule = AlertRule(name="host_silent", why="w", kind="absence",
+                     absence_s=10.0)
+    engine = AlertEngine([rule])
+    store = SeriesStore()
+    edges = engine.evaluate(store, _targets(age_s=20.0), now=0.0)
+    assert [e["state"] for e in edges] == ["firing"]
+    # target evicted entirely (lease cleaned up): auto-resolve, not a
+    # firing alert pinned forever
+    edges = engine.evaluate(store, {}, now=1.0)
+    assert edges == [{"alert": "host_silent", "target": "0/learner",
+                      "state": "resolved", "value": None}]
+    assert engine.firing() == []
+
+
+def test_budget_alert_reads_the_lag_rows_own_budget():
+    rule = AlertRule(name="publish_adopt_budget", why="w", kind="budget")
+    engine = AlertEngine([rule])
+    store = SeriesStore()
+    lag = {"publish_adopt_budget_ms": 50.0,
+           "publish_adopt_ms_by_consumer": {"actor0": {"p99": 80.0},
+                                            "actor1": {"p99": 10.0}}}
+    edges = engine.evaluate(store, _targets(last_rows={"lag": lag}), now=0.0)
+    assert edges == [{"alert": "publish_adopt_budget", "target": "0/learner",
+                      "state": "firing", "value": 80.0}]
+    lag_ok = dict(lag, publish_adopt_ms_by_consumer={"actor0": {"p99": 20.0}})
+    edges = engine.evaluate(store, _targets(last_rows={"lag": lag_ok}),
+                            now=1.0)
+    assert [e["state"] for e in edges] == ["resolved"]
+
+
+def test_role_filter_scopes_threshold_rules():
+    rule = AlertRule(name="learn_steps_floor", why="w", row_kind="learn",
+                     field="step", rate=True, op="lt", limit=50.0,
+                     role="learner")
+    engine = AlertEngine([rule])
+    store = SeriesStore()
+    store.add("1/actor", "learn", "step", 0, now=0.0)
+    store.add("1/actor", "learn", "step", 1, now=10.0)
+    # an actor's crawl never trips the learner SLO
+    assert engine.evaluate(
+        store, _targets(role="actor", target="1/actor"), now=10.0) == []
+
+
+def test_default_rules_gating():
+    names = [r.name for r in default_rules(Config())]
+    assert names == ["host_silent", "publish_adopt_budget"]
+    cfg = Config(obs_net_learn_floor=100.0, obs_net_shed_ceiling=5.0,
+                 obs_net_stale_s=7.0)
+    rules = {r.name: r for r in default_rules(cfg)}
+    assert set(rules) == {"learn_steps_floor", "obs_shed_spike",
+                          "host_silent", "publish_adopt_budget"}
+    assert rules["learn_steps_floor"].limit == 100.0
+    assert rules["obs_shed_spike"].limit == 5.0
+    assert rules["host_silent"].absence_s == 7.0
+
+
+# ----------------------------------------------------------------- obs_top
+def test_obs_top_render_golden():
+    fleetz = {
+        "status": "degraded",
+        "hosts_total": 2,
+        "hosts_stale": 1,
+        "alerts_firing": [{"alert": "host_silent", "target": "1/actor"}],
+        "offenders": ["1/actor: stale_host"],
+        "hosts": {
+            "0/learner": {"status": "ok", "age_s": 0.4, "step": 1200,
+                          "rows": 340, "reasons": []},
+            "1/actor": {"status": "degraded", "age_s": 42.0, "step": 0,
+                        "rows": 12, "reasons": ["stale_host"]},
+        },
+    }
+    rates = {"0/learner": {"steps_s": 98.5, "rows_s": 12.0}}
+    metrics = ('ria_obsnet_rows_total{role="obs_net"} 352\n'
+               'ria_fleet_alerts_firing{role="obs_net"} 1\n')
+    frame = render(fleetz, metrics, rates)
+    expected = (
+        "fleet DEGRADED  hosts=2 stale=1 alerts=1\n"
+        "host/role          status     age_s       step  steps/s"
+        "   rows/s  reasons\n"
+        "0/learner          ok           0.4       1200     98.5"
+        "     12.0  -\n"
+        "1/actor            DEGRADED    42.0          0        -"
+        "        -  stale_host\n"
+        "alerts firing:\n"
+        "  host_silent  @ 1/actor\n"
+        "offenders: 1/actor: stale_host\n"
+        'ria_obsnet_rows_total{role="obs_net"} 352\n'
+        'ria_fleet_alerts_firing{role="obs_net"} 1\n'
+    )
+    assert frame == expected
+
+
+# -------------------------------------------------------------- default off
+def test_obs_net_family_defaults_off():
+    cfg = Config()
+    assert cfg.obs_net is False
+    assert cfg.obs_net_host == ""
+    assert ObsRelay.from_config(cfg) is None
+    assert ObsCollector.from_config(cfg) is None
+    # attach on the off path constructs nothing and adds no observer
+    logger_calls = []
+
+    class _FakeLogger:
+        def add_observer(self, fn):
+            logger_calls.append(fn)
+
+    assert ObsRelay.attach(cfg, _FakeLogger()) is None
+    assert logger_calls == []
