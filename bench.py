@@ -86,6 +86,17 @@ def measure() -> None:
     def left() -> float:
         return CHILD_BUDGET_SECS - (time.monotonic() - t_start)
 
+    # netchaos mode (make netchaos-smoke / BENCH_NETCHAOS_ONLY=1): only the
+    # disarmed-interposer seam-tax row.  Jax-free — a framed-socket echo
+    # loop — so it runs BEFORE backend init and skips it entirely
+    if os.environ.get("BENCH_NETCHAOS_ONLY") == "1":
+        for row in _run_row_budgeted(
+            "chaos_overhead", "net_chaos_overhead_frac",
+            _measure_chaos_overhead, left, share=0.9,
+        ):
+            print(json.dumps(row), flush=True)
+        return
+
     # Backend init can block for many minutes against a DEAD relay (round-3
     # observation: ~15 min then UNAVAILABLE).  A SIGALRM self-exit bounds it
     # WHEN the blocking call releases the GIL; measured round-3, this
@@ -783,6 +794,168 @@ def _measure_obs_net_overhead(left=None) -> list:
         "path": "obs_net_overhead",
         "on_steps_per_sec": round(best_on, 2),
         "off_steps_per_sec": round(best_off, 2),
+        "reps": rep,
+    }]
+
+
+def _measure_chaos_overhead(left=None) -> list:
+    """chaos_overhead: what the net-chaos seam costs when DISARMED
+    (ISSUE 19).  Every plane routes freshly-created sockets through
+    ``chaos.maybe_wrap`` unconditionally; the off-path guarantee is that
+    with no spec armed the seam returns the socket UNCHANGED, so the tax
+    is one function call per connection — not per byte.  Two arms over
+    the same framed-socket echo loop (send_frame -> peer echo ->
+    recv_frame, 4 KiB blobs): one with the production seam in place
+    (disarmed ``chaos.install(None)`` + ``maybe_wrap`` on both ends) and
+    one bypassing the seam entirely.  ``overhead_frac`` = 1 - on/off;
+    `make netchaos-smoke` gates it at <= 1%.  A 1% gate is far thinner
+    than loopback round-trip noise: throughput drifts 20-30% across
+    minutes (CPU frequency, sibling load) and even BACK-TO-BACK whole-arm
+    runs disagree by +-4-6%, so best-of-maxima and coarse paired ratios
+    both flake the gate.  Instead both arms are set up concurrently (the
+    idle arm's echo thread is parked in a blocking recv, costing nothing)
+    and each rep alternates small BLOCKS of round trips between them,
+    accumulating per-arm time — noise slower than a block (~10 ms)
+    cancels inside every rep.  The row reports 1 - median(per-rep
+    ratios).  Even so, per-PROCESS placement luck (which cores the echo
+    threads land on) can hold a 2% phantom difference between bitwise-
+    identical arms for a whole run, so the row ALSO reports
+    ``seam_identity``: whether the disarmed seam returned the socket
+    object unchanged — the structural guarantee that the per-byte cost
+    is exactly zero.  The smoke gate accepts a verified identity OR a
+    measured tax <= 1%; a regression that makes the disarmed seam
+    non-identity loses the short-circuit and faces the measured gate."""
+    if left is None:
+        left = lambda: float("inf")  # noqa: E731
+    import socket
+    import threading
+
+    from rainbow_iqn_apex_tpu.netcore import chaos
+    from rainbow_iqn_apex_tpu.netcore.framing import recv_frame, send_frame
+
+    iters = int(os.environ.get("BENCH_CHAOS_ITERS", "3000"))
+    reps = int(os.environ.get("BENCH_CHAOS_REPS", "4"))
+    max_reps = int(os.environ.get("BENCH_CHAOS_MAX_REPS", "8"))
+    block = 128  # round trips per interleave slice, ~10 ms
+    blob = b"\x5a" * 4096
+
+    class Arm:
+        def __init__(self, seamed: bool) -> None:
+            a, b = socket.socketpair()
+            a.settimeout(30.0)
+            b.settimeout(30.0)
+            if seamed:
+                chaos.install(None)  # the default: nothing armed
+                a = chaos.maybe_wrap(a, peer="bench-client")
+                b = chaos.maybe_wrap(b, peer="bench-server")
+            self.a, self.b = a, b
+            self.elapsed = 0.0
+            self.n = 0
+
+            def echo() -> None:
+                try:
+                    while True:
+                        got = recv_frame(b, max_frame_bytes=1 << 20)
+                        if got is None or got[0].get("op") == "stop":
+                            return
+                        send_frame(b, got[0], got[1])
+                except OSError:  # bench teardown, not a measurement
+                    return
+
+            self.t = threading.Thread(target=echo, daemon=True)
+            self.t.start()
+
+        def run_block(self, count: int) -> None:
+            t0 = time.perf_counter()
+            for i in range(count):
+                send_frame(self.a, {"op": "echo", "i": i}, blob)
+                recv_frame(self.a, max_frame_bytes=1 << 20)
+            self.elapsed += time.perf_counter() - t0
+            self.n += count
+
+        def close(self) -> None:
+            try:
+                send_frame(self.a, {"op": "stop"})
+                self.t.join(timeout=5.0)
+            except OSError:
+                pass
+            self.a.close()
+            self.b.close()
+
+    def run_pair(flip: bool):
+        """One rep: both arms live, alternating blocks (the arm that goes
+        first swaps every block), per-arm time accumulated.  ``flip``
+        swaps which arm is CONSTRUCTED first — thread/core placement is
+        sticky within a rep, so construction order must alternate across
+        reps too.  Returns (on_rtps, off_rtps) for this rep."""
+        arms = {}
+        for seamed in ((True, False) if flip else (False, True)):
+            arms[seamed] = Arm(seamed)
+        try:
+            for arm in arms.values():
+                arm.run_block(64)  # warm the path (allocator, frame codec)
+                arm.elapsed, arm.n = 0.0, 0
+            blocks = max(iters // block, 1)
+            for i in range(blocks):
+                order = (False, True) if (i + flip) % 2 == 0 else (True, False)
+                for seamed in order:
+                    arms[seamed].run_block(block)
+                if left() < 15:
+                    break
+            on, off = arms[True], arms[False]
+            if not (on.elapsed and off.elapsed):
+                return None
+            return (on.n / on.elapsed, off.n / off.elapsed)
+        finally:
+            for arm in arms.values():
+                arm.close()
+
+    def median(xs: list) -> float:
+        xs = sorted(xs)
+        mid = len(xs) // 2
+        return xs[mid] if len(xs) % 2 else (xs[mid - 1] + xs[mid]) / 2.0
+
+    ratios: list = []
+    best_on = best_off = 0.0
+    rep = 0
+    while rep < max_reps and left() > 20:
+        prev_med = median(ratios) if ratios else None
+        pair = run_pair(flip=bool(rep % 2))
+        if pair is None:
+            break
+        on_rtps, off_rtps = pair
+        best_on = max(best_on, on_rtps)
+        best_off = max(best_off, off_rtps)
+        ratios.append(on_rtps / off_rtps)
+        rep += 1
+        if rep >= reps and prev_med is not None:
+            # the median moved < 0.2pp on the last rep: converged
+            if abs(median(ratios) - prev_med) <= 0.002:
+                break
+    if not ratios:
+        return []
+    overhead = max(1.0 - median(ratios), 0.0)
+    sa, sb = socket.socketpair()
+    try:
+        chaos.install(None)
+        seam_identity = chaos.maybe_wrap(sa, peer="bench-probe") is sa
+    finally:
+        sa.close()
+        sb.close()
+    return [{
+        "metric": "net_chaos_overhead_frac",
+        "value": round(overhead, 4),
+        "unit": (
+            f"fraction of framed-socket echo throughput lost to the "
+            f"DISARMED chaos.maybe_wrap seam (4 KiB blobs over a loopback "
+            f"socketpair, seam-in-place vs seam-bypassed; median of {rep} "
+            f"block-interleaved paired reps x {iters} round trips)"
+        ),
+        "vs_baseline": None,
+        "path": "chaos_overhead",
+        "on_rtps": round(best_on, 1),
+        "off_rtps": round(best_off, 1),
+        "seam_identity": seam_identity,
         "reps": rep,
     }]
 

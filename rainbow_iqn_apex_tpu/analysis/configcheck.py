@@ -86,6 +86,8 @@ DEFAULT_OFF: Dict[str, object] = {
     "obs_net_port": 0,
     "obs_net_advertise": "",
     "obs_net_http_port": 0,
+    "net_chaos_spec": "",
+    "lease_skew_tolerance_s": 0.0,
 }
 
 _DOC_CFG_RE = re.compile(r"`cfg\.([A-Za-z_][A-Za-z0-9_]*)`")

@@ -105,6 +105,20 @@ class Config:
     io_retry_max_s: float = 2.0
     heartbeat_interval_s: float = 0.0  # per-host liveness file cadence; 0 off
     heartbeat_timeout_s: float = 30.0  # peer file older than this = dead host
+    lease_skew_tolerance_s: float = 0.0  # extra staleness grace absorbing
+    # cross-host wall-clock skew: lease freshness compares the READER's clock
+    # against the WRITER's mtime, so a reader running 2s ahead inflates every
+    # age by 2s and can false-evict a healthy host.  Freshness becomes
+    # age <= heartbeat_timeout_s + this.  0 (default) = the exact pre-skew
+    # comparison, bitwise the previous PR
+    net_chaos_spec: str = ""  # seeded network-fault interposer over every
+    # plane socket (netcore/chaos.py), e.g.
+    # "delay_ms=50±20@p=1.0,corrupt_frame@p=0.01,partition=learner->replay1@t=10..12"
+    # — clauses: delay_ms / corrupt_frame / torn_write / blackhole /
+    # partition=src->dst / slow_read_bps, each taking @p=<prob> and
+    # @t=<a>..<b> windows.  RIA_NET_CHAOS env overrides; RIA_NET_CHAOS_SITE
+    # names this process for partition matching.  "" (default) = sockets are
+    # returned unwrapped — the off path is bitwise the previous PR
 
     # ---- elasticity (parallel/elastic.py; docs/RESILIENCE.md "heal") --------------
     max_weight_lag: int = 0  # actor staleness fence: pause acting (shed

@@ -16,6 +16,10 @@ from typing import TYPE_CHECKING
 
 _EXPORTS = {
     "framing": "rainbow_iqn_apex_tpu.netcore",
+    "chaos": "rainbow_iqn_apex_tpu.netcore",
+    "NetChaos": "rainbow_iqn_apex_tpu.netcore.chaos",
+    "NetChaosSpecError": "rainbow_iqn_apex_tpu.netcore.chaos",
+    "ChaosSocket": "rainbow_iqn_apex_tpu.netcore.chaos",
     "FrameError": "rainbow_iqn_apex_tpu.netcore.framing",
     "FrameProtocol": "rainbow_iqn_apex_tpu.netcore.framing",
     "FrameTooLarge": "rainbow_iqn_apex_tpu.netcore.framing",
@@ -41,8 +45,8 @@ def __getattr__(name: str):
         raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
     import importlib
 
-    if name == "framing":
-        return importlib.import_module(f"{module}.framing")
+    if name in ("framing", "chaos"):
+        return importlib.import_module(f"{module}.{name}")
     return getattr(importlib.import_module(module), name)
 
 
@@ -51,7 +55,12 @@ def __dir__():
 
 
 if TYPE_CHECKING:  # static analyzers see the eager imports
-    from rainbow_iqn_apex_tpu.netcore import framing  # noqa: F401
+    from rainbow_iqn_apex_tpu.netcore import chaos, framing  # noqa: F401
+    from rainbow_iqn_apex_tpu.netcore.chaos import (  # noqa: F401
+        ChaosSocket,
+        NetChaos,
+        NetChaosSpecError,
+    )
     from rainbow_iqn_apex_tpu.netcore.framing import (  # noqa: F401
         DEFAULT_MAX_FRAME,
         FrameCorrupt,

@@ -1,0 +1,540 @@
+"""Seeded network-fault interposer for every socket the wire planes create.
+
+The three production planes — serving (PR 11), replay (PR 16), telemetry
+(PR 18) — all build their sockets and immediately pass them through
+``chaos.maybe_wrap(sock, peer=...)`` at the single ``netcore`` seam.  When
+nothing is armed the call returns the socket unchanged (the off path is
+bitwise the previous PR; tier-1 asserts it), so production pays one function
+call per *connection*, never per byte.  When armed, the socket comes back
+wrapped in a :class:`ChaosSocket` that injects the degraded-network failure
+class clean-death soaks never exercise (arXiv:1803.00933 deployments die of
+latency spikes and torn frames far more often than of SIGKILL):
+
+===================  ========================================================
+clause               effect (per-direction, per-peer-pair, seeded)
+===================  ========================================================
+``delay_ms=50±20``   sleep before each write (mean ± jitter, blocking paths)
+``corrupt_frame``    flip one seeded byte of an outgoing write (CRC witness)
+``torn_write``       write a seeded prefix then fail — mid-frame sender death
+``blackhole``        silently drop a whole outgoing write (frame-atomic loss)
+``partition=a->b``   one-way partition: a's egress to b drops (TX side) and
+                     b's ingress from a stalls (RX side); ``*`` wildcards
+``slow_read_bps=N``  clamp+pace this process's reads to ~N bytes/s
+===================  ========================================================
+
+Every clause takes ``@p=<prob>`` (event probability, default 1) and
+``@t=<a>..<b>`` (active window in seconds since arming, default always), so
+one spec string expresses a rotating fault schedule:
+
+    delay_ms=50±20@p=1.0,corrupt_frame@p=0.01,partition=learner->replay1@t=10..12
+
+Arming is default-off and dual-path, the ``utils/faults.py`` house pattern:
+``Config.net_chaos_spec`` / the ``RIA_NET_CHAOS`` env var (env wins; a soak
+harness arms children without touching run configs).  ``RIA_NET_CHAOS_SITE``
+names this process's logical site for partition matching ("learner",
+"replay0", ...).  Determinism: every wrapped connection draws from its own
+``random.Random`` seeded by (seed, site, peer, connection ordinal), so a
+soak replays exactly — reconnects included.
+
+The four ``net_*`` points in ``utils.faults.POINTS`` are consulted at the
+matching decision sites, so the house ``--fault-spec`` grammar can ALSO
+force single injections deterministically (``net_corrupt@3`` corrupts
+exactly the third write) without authoring a chaos spec.
+
+Injections are observable, not statistical: each hit increments a
+per-(fault, peer) counter and emits a ``net_chaos`` row (rate-limited to
+power-of-two counts) naming the injected site, so soak assertions are
+causal — "the corruption the spec injected is the corruption the plane
+recovered from".
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import random
+import socket
+import threading
+import time
+import zlib
+from typing import Any, Callable, Dict, Optional, Tuple
+
+from rainbow_iqn_apex_tpu.utils import faults
+
+ENV_VAR = "RIA_NET_CHAOS"
+SITE_ENV_VAR = "RIA_NET_CHAOS_SITE"
+SEED_ENV_VAR = "RIA_NET_CHAOS_SEED"
+
+# clause kinds the spec grammar accepts
+KINDS = frozenset({
+    "delay_ms",
+    "corrupt_frame",
+    "torn_write",
+    "blackhole",
+    "partition",
+    "slow_read_bps",
+})
+
+# faults.POINTS names consulted at the matching decision sites (the house
+# --fault-spec grammar can force injections without a chaos spec)
+_NET_POINTS = ("net_delay", "net_corrupt", "net_partition", "net_slow_peer")
+
+# defaults used when an injection is forced via faults.fire() alone (no
+# chaos clause supplies parameters)
+_FORCED_DELAY_S = 0.05
+_FORCED_SLOW_CHUNK = 1024
+
+# RX-partition stall quantum: a blocking read inside a partition window
+# sleeps this long then raises socket.timeout, so reader loops keep
+# observing their stop events (data stays in the kernel buffer — a
+# partition delays, it does not lose)
+_RX_STALL_S = 0.05
+
+
+class NetChaosSpecError(ValueError):
+    """A malformed ``net_chaos_spec`` / ``RIA_NET_CHAOS`` string."""
+
+
+@dataclasses.dataclass(frozen=True)
+class Clause:
+    """One parsed fault clause; inactive outside its ``@t`` window."""
+
+    kind: str
+    prob: float = 1.0  # event probability within the window
+    t0: Optional[float] = None  # window start (s since arming), None=always
+    t1: Optional[float] = None
+    mean_ms: float = 0.0  # delay_ms
+    jitter_ms: float = 0.0
+    bps: int = 0  # slow_read_bps
+    src: str = "*"  # partition
+    dst: str = "*"
+
+
+def _parse_size(text: str, entry: str) -> int:
+    mult = 1
+    low = text.strip().lower()
+    if low.endswith("k"):
+        mult, low = 1024, low[:-1]
+    elif low.endswith("m"):
+        mult, low = 1024 * 1024, low[:-1]
+    try:
+        n = int(float(low) * mult)
+    except ValueError:
+        raise NetChaosSpecError(f"bad byte rate in chaos entry '{entry}'")
+    if n < 1:
+        raise NetChaosSpecError(f"byte rate must be >= 1 in '{entry}'")
+    return n
+
+
+def parse_spec(spec: str) -> Tuple[Clause, ...]:
+    """``"delay_ms=50±20@p=0.5,partition=a->b@t=10..12"`` -> clauses.
+
+    Grammar per comma-separated entry: ``kind[=value][@p=<prob>][@t=<a>..<b>]``
+    (``±`` may be spelled ``+-``).  Raises :class:`NetChaosSpecError` on any
+    malformed entry — a chaos spec that silently half-parses would make a
+    soak assert against faults that were never injected.
+    """
+    out = []
+    for entry in filter(None, (e.strip() for e in spec.split(","))):
+        parts = entry.split("@")
+        head, mods = parts[0], parts[1:]
+        kind, _, value = head.partition("=")
+        kind = kind.strip()
+        if kind not in KINDS:
+            raise NetChaosSpecError(
+                f"unknown chaos clause '{kind}' in '{entry}' "
+                f"(known: {', '.join(sorted(KINDS))})"
+            )
+        prob, t0, t1 = 1.0, None, None
+        for mod in mods:
+            key, _, mval = mod.partition("=")
+            if key == "p":
+                try:
+                    prob = float(mval)
+                except ValueError:
+                    raise NetChaosSpecError(
+                        f"bad probability in chaos entry '{entry}'")
+                if not 0.0 <= prob <= 1.0:
+                    raise NetChaosSpecError(
+                        f"probability out of [0,1] in '{entry}'")
+            elif key == "t":
+                a, sep, b = mval.partition("..")
+                if not sep:
+                    raise NetChaosSpecError(
+                        f"bad window (want t=a..b) in chaos entry '{entry}'")
+                try:
+                    t0, t1 = float(a), float(b)
+                except ValueError:
+                    raise NetChaosSpecError(
+                        f"bad window bounds in chaos entry '{entry}'")
+                if t1 < t0 or t0 < 0.0:
+                    raise NetChaosSpecError(
+                        f"window must satisfy 0 <= a <= b in '{entry}'")
+            else:
+                raise NetChaosSpecError(
+                    f"unknown modifier '@{mod}' in chaos entry '{entry}'")
+        fields: Dict[str, Any] = {"kind": kind, "prob": prob,
+                                  "t0": t0, "t1": t1}
+        if kind == "delay_ms":
+            raw = value.replace("+-", "±")
+            mean, _, jit = raw.partition("±")
+            try:
+                fields["mean_ms"] = float(mean)
+                fields["jitter_ms"] = float(jit) if jit else 0.0
+            except ValueError:
+                raise NetChaosSpecError(
+                    f"bad delay (want delay_ms=M or M±J) in '{entry}'")
+            if fields["mean_ms"] < 0 or fields["jitter_ms"] < 0:
+                raise NetChaosSpecError(f"negative delay in '{entry}'")
+        elif kind == "slow_read_bps":
+            fields["bps"] = _parse_size(value, entry)
+        elif kind == "partition":
+            src, sep, dst = value.partition("->")
+            if not sep or not src.strip() or not dst.strip():
+                raise NetChaosSpecError(
+                    f"bad partition (want partition=src->dst) in '{entry}'")
+            fields["src"], fields["dst"] = src.strip(), dst.strip()
+        elif value:
+            raise NetChaosSpecError(
+                f"clause '{kind}' takes no value (got '{value}') in '{entry}'")
+        out.append(Clause(**fields))
+    return tuple(out)
+
+
+def _site_match(pattern: str, site: str) -> bool:
+    return pattern == "*" or pattern == site
+
+
+class NetChaos:
+    """Parsed spec + arming state + per-(fault, peer) injection ledger."""
+
+    def __init__(
+        self,
+        spec: str = "",
+        seed: int = 0,
+        site: str = "",
+        clock: Callable[[], float] = time.monotonic,
+    ):
+        self.spec = spec
+        self.seed = int(seed)
+        self.site = site or os.environ.get(SITE_ENV_VAR, "") or "host"
+        self.clauses = parse_spec(spec)
+        self._clock = clock
+        self._epoch = clock()  # @t windows are relative to arming
+        self._lock = threading.Lock()
+        self._counts: Dict[Tuple[str, str], int] = {}
+        self._wraps: Dict[str, int] = {}
+        self._logger = None
+
+    @property
+    def armed(self) -> bool:
+        return bool(self.clauses)
+
+    def now(self) -> float:
+        """Seconds since arming (the @t window clock)."""
+        return self._clock() - self._epoch
+
+    def attach_logger(self, logger) -> None:
+        """First logger wins — every plane offers its own at wrap time."""
+        if logger is not None and self._logger is None:
+            self._logger = logger
+
+    def active(self, clause: Clause) -> bool:
+        """Inside the clause's @t window (probability is drawn per event
+        by the connection's own rng, not here)."""
+        if clause.t0 is None:
+            return True
+        return clause.t0 <= self.now() <= clause.t1
+
+    def record(self, fault: str, peer: str) -> None:
+        """Count one injection; emit a ``net_chaos`` row at power-of-two
+        counts so a pathological spec cannot flood the run log."""
+        with self._lock:
+            n = self._counts.get((fault, peer), 0) + 1
+            self._counts[(fault, peer)] = n
+            logger = self._logger
+        if logger is not None and (n & (n - 1)) == 0:
+            try:
+                logger.log("net_chaos", fault=fault, site=self.site,
+                           peer=peer, n=n)
+            except Exception:
+                pass  # telemetry never takes down the wire
+
+    def counts(self) -> Dict[Tuple[str, str], int]:
+        with self._lock:
+            return dict(self._counts)
+
+    def injected(self, fault: str) -> int:
+        with self._lock:
+            return sum(n for (f, _), n in self._counts.items() if f == fault)
+
+    def wrap(self, sock, peer: str = "") -> "ChaosSocket":
+        """Wrap one socket; each (peer, ordinal) gets its own seeded rng so
+        reconnects replay deterministically."""
+        with self._lock:
+            k = self._wraps.get(peer, 0)
+            self._wraps[peer] = k + 1
+        key = f"{self.seed}|{self.site}|{peer}|{k}".encode()
+        return ChaosSocket(sock, self, peer, random.Random(zlib.crc32(key)))
+
+
+class ChaosSocket:
+    """Delegating socket wrapper that applies the armed clauses.
+
+    TX faults (partition / blackhole / torn_write / corrupt_frame /
+    delay_ms) act on writes so the *peer* observes the degradation through
+    the real kernel path; RX faults (partition ingress, slow_read_bps) act
+    on this process's reads.  Unknown attributes pass straight through, so
+    selectors, TCP_NODELAY setup, and getpeername all keep working.
+    """
+
+    def __init__(self, sock, chaos: NetChaos, peer: str,
+                 rng: random.Random):
+        self._sock = sock
+        self._chaos = chaos
+        self._peer = peer
+        self._rng = rng
+        self._rng_lock = threading.Lock()
+        self._read_credit = 0.0  # slow_read token bucket
+        self._read_stamp = chaos.now()
+
+    # ------------------------------------------------------------ plumbing
+    def __getattr__(self, name: str):
+        return getattr(self._sock, name)
+
+    def fileno(self) -> int:
+        return self._sock.fileno()
+
+    def close(self) -> None:
+        self._sock.close()
+
+    def unwrap(self):
+        """The raw socket underneath (tests and diagnostics only)."""
+        return self._sock
+
+    def _hit(self, prob: float) -> bool:
+        if prob >= 1.0:
+            return True
+        if prob <= 0.0:
+            return False
+        with self._rng_lock:
+            return self._rng.random() < prob
+
+    def _rand(self, n: int) -> int:
+        with self._rng_lock:
+            return self._rng.randrange(n)
+
+    def _uniform(self, a: float, b: float) -> float:
+        with self._rng_lock:
+            return self._rng.uniform(a, b)
+
+    def _blocking(self) -> bool:
+        try:
+            return self._sock.gettimeout() != 0.0
+        except OSError:
+            return False
+
+    # ------------------------------------------------------------ TX path
+    def _tx_dropped(self) -> bool:
+        """Partition egress / blackhole / forced net_partition: the write
+        vanishes wholesale.  send_frame() is one sendall per frame, so a
+        dropped write is frame-atomic — the stream stays in sync and the
+        peer simply never sees the frame (ack timeout, not corruption)."""
+        chaos = self._chaos
+        for c in chaos.clauses:
+            if c.kind == "partition" and chaos.active(c) \
+                    and _site_match(c.src, chaos.site) \
+                    and _site_match(c.dst, self._peer) and self._hit(c.prob):
+                chaos.record("partition", self._peer)
+                return True
+            if c.kind == "blackhole" and chaos.active(c) \
+                    and self._hit(c.prob):
+                chaos.record("blackhole", self._peer)
+                return True
+        inj = faults.get()
+        if inj.has("net_partition") and inj.fire("net_partition"):
+            chaos.record("partition", self._peer)
+            return True
+        return False
+
+    def _tx_transform(self, data) -> bytes:
+        """torn_write (prefix then BrokenPipeError), corrupt_frame (one
+        seeded byte flip), delay_ms (sleep) — in that order."""
+        chaos = self._chaos
+        buf = bytes(data)
+        for c in chaos.clauses:
+            if c.kind == "torn_write" and chaos.active(c) and len(buf) > 1 \
+                    and self._hit(c.prob):
+                prefix = buf[: 1 + self._rand(len(buf) - 1)]
+                try:
+                    self._sock.sendall(prefix)
+                except OSError:
+                    pass
+                chaos.record("torn_write", self._peer)
+                raise BrokenPipeError(
+                    f"chaos: torn write to {self._peer or 'peer'}")
+        corrupt = any(
+            c.kind == "corrupt_frame" and chaos.active(c) and self._hit(c.prob)
+            for c in chaos.clauses)
+        inj = faults.get()
+        if not corrupt and inj.has("net_corrupt"):
+            corrupt = inj.fire("net_corrupt")
+        if corrupt and buf:
+            # flip past the 11-byte frame prefix (magic+ver+two u32 lengths)
+            # when the write is long enough: a flipped LENGTH field makes
+            # the peer wait forever for bytes that never come — a hang, not
+            # the prompt typed Frame* error corruption is injected to force
+            lo = 11 if len(buf) > 11 else 0
+            i = lo + self._rand(len(buf) - lo)
+            buf = buf[:i] + bytes([buf[i] ^ 0xFF]) + buf[i + 1:]
+            chaos.record("corrupt", self._peer)
+        delay = 0.0
+        for c in chaos.clauses:
+            if c.kind == "delay_ms" and chaos.active(c) and self._hit(c.prob):
+                jit = self._uniform(-c.jitter_ms, c.jitter_ms)
+                delay = max(delay, max(0.0, c.mean_ms + jit) / 1000.0)
+        if delay == 0.0 and inj.has("net_delay") and inj.fire("net_delay"):
+            delay = _FORCED_DELAY_S
+        if delay > 0.0:
+            self._chaos.record("delay", self._peer)
+            time.sleep(delay)
+        return buf
+
+    def send(self, data, *args) -> int:
+        if self._tx_dropped():
+            return len(data)
+        return self._sock.send(self._tx_transform(data), *args)
+
+    def sendall(self, data, *args) -> None:
+        if self._tx_dropped():
+            return None
+        return self._sock.sendall(self._tx_transform(data), *args)
+
+    def sendto(self, data, *args):
+        if self._tx_dropped():
+            return len(data)
+        return self._sock.sendto(self._tx_transform(data), *args)
+
+    # ------------------------------------------------------------ RX path
+    def _rx_partitioned(self) -> bool:
+        chaos = self._chaos
+        for c in chaos.clauses:
+            if c.kind == "partition" and chaos.active(c) \
+                    and _site_match(c.src, self._peer) \
+                    and _site_match(c.dst, chaos.site) and self._hit(c.prob):
+                return True
+        return False
+
+    def _rx_stall(self):
+        """Ingress partition: the bytes are 'in flight', not lost.  We do
+        not read (the kernel buffer keeps them for after the heal); a
+        blocking caller sleeps one quantum then gets socket.timeout, a
+        non-blocking caller gets BlockingIOError — both paths every reader
+        loop in the planes already treats as 'no data yet'."""
+        self._chaos.record("partition", self._peer)
+        if not self._blocking():
+            raise BlockingIOError(
+                f"chaos: rx partition from {self._peer or 'peer'}")
+        time.sleep(_RX_STALL_S)
+        raise socket.timeout(
+            f"chaos: rx partition from {self._peer or 'peer'}")
+
+    def _rx_clamp(self, bufsize: int) -> int:
+        """slow_read_bps token bucket: reads above the rate are clamped and
+        (on blocking sockets) paced.  Non-blocking event-loop reads are
+        clamped only — a slow peer must never stall a shared selector."""
+        chaos = self._chaos
+        bps = 0
+        for c in chaos.clauses:
+            if c.kind == "slow_read_bps" and chaos.active(c) \
+                    and self._hit(c.prob):
+                bps = max(bps, c.bps) if bps else c.bps
+        if bps == 0:
+            inj = faults.get()
+            if inj.has("net_slow_peer") and inj.fire("net_slow_peer"):
+                chaos.record("slow_read", self._peer)
+                if self._blocking():
+                    time.sleep(_FORCED_DELAY_S)
+                return max(1, min(bufsize, _FORCED_SLOW_CHUNK))
+            return bufsize
+        now = chaos.now()
+        self._read_credit = min(
+            float(bps), self._read_credit + (now - self._read_stamp) * bps)
+        self._read_stamp = now
+        if self._read_credit < 1.0:
+            if self._blocking():
+                time.sleep(max(0.0, (1.0 - self._read_credit) / bps))
+            self._read_credit = 1.0
+        allowed = max(1, min(bufsize, int(self._read_credit)))
+        self._read_credit -= allowed
+        chaos.record("slow_read", self._peer)
+        return allowed
+
+    def recv(self, bufsize: int, *args) -> bytes:
+        if self._rx_partitioned():
+            self._rx_stall()
+        return self._sock.recv(self._rx_clamp(bufsize), *args)
+
+    def recv_into(self, buffer, nbytes: int = 0, *args) -> int:
+        if self._rx_partitioned():
+            self._rx_stall()
+        n = nbytes if nbytes else len(buffer)
+        return self._sock.recv_into(buffer, self._rx_clamp(n), *args)
+
+    def recvfrom(self, bufsize: int, *args):
+        # UDP: clamping would truncate datagrams (loss, not slowness), so
+        # only the ingress partition applies on the receive side
+        if self._rx_partitioned():
+            self._rx_stall()
+        return self._sock.recvfrom(bufsize, *args)
+
+
+# ------------------------------------------------------------- global access
+# The planes cannot thread a chaos handle through every constructor; they
+# call maybe_wrap() at each socket-creation site and consult the installed
+# interposer.  Default: disarmed (None until first use, then env-armed).
+_current: Optional[NetChaos] = None
+_install_lock = threading.Lock()
+
+
+def install(chaos: Optional[NetChaos]) -> NetChaos:
+    global _current
+    with _install_lock:
+        _current = chaos if chaos is not None else NetChaos("")
+        return _current
+
+
+def install_from(cfg) -> NetChaos:
+    """Arm from Config/env (env wins, the faults.install_from contract —
+    a soak harness arms children without editing run configs)."""
+    spec = os.environ.get(ENV_VAR, "") or getattr(cfg, "net_chaos_spec", "")
+    seed = int(os.environ.get(SEED_ENV_VAR, "")
+               or getattr(cfg, "seed", 0) or 0)
+    return install(NetChaos(spec, seed=seed))
+
+
+def get() -> NetChaos:
+    """The installed interposer; first touch self-installs from env so any
+    process (smoke children included) arms via RIA_NET_CHAOS alone."""
+    global _current
+    if _current is None:
+        with _install_lock:
+            if _current is None:
+                _current = NetChaos(
+                    os.environ.get(ENV_VAR, ""),
+                    seed=int(os.environ.get(SEED_ENV_VAR, "") or 0))
+    return _current
+
+
+def maybe_wrap(sock, peer: str = "", logger=None):
+    """The seam every plane calls at socket creation.  Disarmed (the
+    default): returns ``sock`` unchanged — zero per-byte cost, the off
+    path is bitwise the previous PR.  Armed (chaos spec, or any net_*
+    fault point): returns a :class:`ChaosSocket`."""
+    chaos = get()
+    if not chaos.armed:
+        inj = faults.get()
+        if not any(inj.has(p) for p in _NET_POINTS):
+            return sock
+    chaos.attach_logger(logger)
+    return chaos.wrap(sock, peer=peer)

@@ -33,7 +33,7 @@ import threading
 import time
 from typing import Any, Dict, List, Optional
 
-from rainbow_iqn_apex_tpu.netcore import framing
+from rainbow_iqn_apex_tpu.netcore import chaos, framing
 from rainbow_iqn_apex_tpu.obs.export import (
     ObsHTTPServer,
     _label_str,
@@ -250,6 +250,9 @@ class ObsCollector:
                         except OSError:
                             continue
                         sock.setblocking(False)
+                        sock = chaos.maybe_wrap(
+                            sock, peer=f"{addr[0]}:{addr[1]}",
+                            logger=self.logger)
                         conn = _Conn(sock, f"{addr[0]}:{addr[1]}")
                         conns[sock.fileno()] = conn
                         sel.register(sock, selectors.EVENT_READ, conn)

@@ -39,7 +39,7 @@ import threading
 import time
 from typing import Any, Dict, Optional, Tuple
 
-from rainbow_iqn_apex_tpu.netcore import framing
+from rainbow_iqn_apex_tpu.netcore import chaos, framing
 from rainbow_iqn_apex_tpu.utils.faults import RetryPolicy
 
 _SEND_TIMEOUT_S = 5.0  # blocking-with-a-bound: a wedged collector whose
@@ -69,6 +69,7 @@ class ObsRelay:
         spool_rows: int = 2048,
         snapshot_s: float = 5.0,
         lease_timeout_s: float = 30.0,
+        lease_skew_s: float = 0.0,
         retry: Optional[RetryPolicy] = None,
         collector_addr: Optional[Tuple[str, int]] = None,
     ):
@@ -111,7 +112,8 @@ class ObsRelay:
             from rainbow_iqn_apex_tpu.parallel.elastic import HeartbeatMonitor
 
             self._monitor = HeartbeatMonitor(
-                heartbeat_dir, lease_timeout_s, self_id=None)
+                heartbeat_dir, lease_timeout_s, self_id=None,
+                skew_tolerance_s=lease_skew_s)
         self._thread = threading.Thread(
             target=self._run, name=f"obsnet-relay-{role or host_id}",
             daemon=True)
@@ -138,6 +140,7 @@ class ObsRelay:
             spool_rows=getattr(cfg, "obs_net_spool", 2048),
             snapshot_s=getattr(cfg, "obs_net_snapshot_s", 5.0),
             lease_timeout_s=getattr(cfg, "heartbeat_timeout_s", 30.0),
+            lease_skew_s=getattr(cfg, "lease_skew_tolerance_s", 0.0),
             retry=RetryPolicy(
                 attempts=6,
                 base_delay_s=getattr(cfg, "respawn_base_s", 0.2),
@@ -235,6 +238,8 @@ class ObsRelay:
             sock = socket.create_connection(addr, timeout=_SEND_TIMEOUT_S)
             sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
             sock.settimeout(_SEND_TIMEOUT_S)
+            sock = chaos.maybe_wrap(sock, peer="collector",
+                                    logger=self.logger)
             framing.send_frame(sock, {
                 "op": "hello", "host": self.host_id, "role": self.role,
                 "run": self.run_id, "pid": os.getpid()})

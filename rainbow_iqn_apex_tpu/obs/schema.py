@@ -159,6 +159,12 @@ REQUIRED_KEYS: Dict[str, frozenset] = {
     # periodic fleet fold: aggregate status (worst host wins), per-target
     # status/reasons/staleness under `hosts`, offenders NAMED per
     # host/role, hosts_total/hosts_stale/alerts_firing gauges riding along
+    "net_chaos": frozenset({"fault"}),  # one injected network fault edge
+    # from the netcore/chaos.py interposer (delay/corrupt/torn_write/
+    # blackhole/partition/slow_read), carrying `site` (this process's
+    # logical name), `peer` (the far end) and `n` (cumulative count for
+    # that fault/peer pair; rows rate-limited to power-of-two counts) —
+    # soak assertions match recoveries to the faults that CAUSED them
 }
 
 HEALTH_STATUSES = ("ok", "degraded", "failing")

@@ -338,9 +338,17 @@ class HeartbeatMonitor:
     fresh — that is a fresh death and fires again.
     """
 
-    def __init__(self, directory: str, timeout_s: float, self_id: Optional[int] = None):
+    def __init__(self, directory: str, timeout_s: float,
+                 self_id: Optional[int] = None,
+                 skew_tolerance_s: float = 0.0):
         self.directory = directory
         self.timeout_s = float(timeout_s)
+        # extra freshness grace absorbing reader-vs-writer clock skew: mtime
+        # is stamped by the WRITER's clock (NFS and friends), age by the
+        # READER's, so a reader running ahead inflates every age and can
+        # false-evict a healthy host (cfg.lease_skew_tolerance_s).  The
+        # grace widens only the fresh/dead boundary — reported ages stay raw
+        self.skew_tolerance_s = float(skew_tolerance_s)
         self.self_id = self_id
         # host -> lease epoch at which its death was reported; entries are
         # removed ONLY by an observed fresh beat (the bugfix above)
@@ -374,7 +382,7 @@ class HeartbeatMonitor:
             out[hid] = Lease(
                 host=hid,
                 age_s=age,
-                fresh=age <= self.timeout_s,
+                fresh=age <= self.timeout_s + self.skew_tolerance_s,
                 role=str(payload.get("role", "host")),
                 shard=None if shard is None else int(shard),
                 epoch=int(payload.get("epoch", 0) or 0),

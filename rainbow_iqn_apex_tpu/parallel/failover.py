@@ -137,6 +137,7 @@ class StandbyLearner:
         self.monitor = monitor if monitor is not None else HeartbeatMonitor(
             self.directory, cfg.heartbeat_timeout_s,
             self_id=getattr(cfg, "process_id", None),
+            skew_tolerance_s=getattr(cfg, "lease_skew_tolerance_s", 0.0),
         )
         self.poll_s = float(getattr(cfg, "failover_poll_s", 0.5))
         self.warm = bool(getattr(cfg, "failover_warm", False))

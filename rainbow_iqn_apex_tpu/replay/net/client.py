@@ -40,7 +40,7 @@ from typing import Any, Callable, Dict, List, Optional, Tuple
 
 import numpy as np
 
-from rainbow_iqn_apex_tpu.netcore import framing
+from rainbow_iqn_apex_tpu.netcore import chaos, framing
 from rainbow_iqn_apex_tpu.replay.buffer import SampledBatch
 from rainbow_iqn_apex_tpu.replay.net import protocol
 from rainbow_iqn_apex_tpu.replay.net.protocol import PeerDead
@@ -145,6 +145,8 @@ class ReplayPeer:
                 else timeout_s)
             sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
             sock.settimeout(None)  # reader blocks; writes are sendall
+            sock = chaos.maybe_wrap(sock, peer=f"replay{self.peer_id}",
+                                    logger=self.logger)
         except OSError:
             with self._lock:
                 self._fail_streak += 1
@@ -217,6 +219,21 @@ class ReplayPeer:
             sock, gen = self._sock, self._gen
         if sock is not None:
             self._drop(sock, gen, "closed")
+
+    def kick(self, why: str = "request timeout") -> None:
+        """Force-drop the CURRENT connection: fail every in-flight request
+        now, re-dial lazily on the next request.  For callers that observed
+        the link wedged — a request timed out while the lease stays fresh
+        (one-way partition, hung server).  Without this, each sibling
+        in-flight request on the wedged link serializes its own full wait
+        budget (requests sent into a TX-dropping partition never get a
+        reply), stalling the sampler for N x ack_timeout_s after the
+        partition heals; the drop settles them all with ``PeerDead``
+        immediately and also reclaims their pending slots."""
+        with self._lock:
+            sock, gen = self._sock, self._gen
+        if sock is not None:
+            self._drop(sock, gen, why)
 
     # ---------------------------------------------------------- frame I/O
     def _send(self, sock: socket.socket, gen: int,
@@ -703,11 +720,19 @@ class SampleClient:
             peer, p = inflight.pop(0)
             try:
                 header, blob = peer.wait(p)
-            except (protocol.ReplayNetError, ValueError, TimeoutError):
+            except (protocol.ReplayNetError, ValueError, TimeoutError) as e:
                 # dead peer / empty server / wedge: release the slot and
                 # re-route the next request to the survivors
                 self.rerouted += 1
                 self._space.release()
+                if isinstance(e, TimeoutError):
+                    # a TIMED-OUT request means the link is wedged (one-way
+                    # partition, hung server) — typed errors settle fast,
+                    # only silence burns the budget.  Drop the connection so
+                    # sibling in-flight requests fail NOW instead of each
+                    # serializing its own full wait budget, and the next
+                    # request re-dials a fresh socket.
+                    peer.kick()
                 continue
             try:
                 batch = self._decode_batch(header, blob)

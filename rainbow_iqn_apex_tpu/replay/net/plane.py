@@ -67,7 +67,8 @@ class RemoteReplayPlane:
         self.lanes_per_shard = self.lanes_total // self.total_shards
         timeout_s = float(getattr(cfg, "heartbeat_timeout_s", 0) or 10.0)
         self.monitor = HeartbeatMonitor(
-            heartbeat_dir(cfg), timeout_s, self_id=cfg.process_id)
+            heartbeat_dir(cfg), timeout_s, self_id=cfg.process_id,
+            skew_tolerance_s=getattr(cfg, "lease_skew_tolerance_s", 0.0))
         self.peers: Dict[int, ReplayPeer] = {}
         self._peer_epoch: Dict[int, int] = {}  # last epoch seen per server
         self.sampler: Optional[SampleClient] = None

@@ -46,7 +46,7 @@ import socket
 import threading
 from typing import Any, Dict, Optional
 
-from rainbow_iqn_apex_tpu.netcore import framing
+from rainbow_iqn_apex_tpu.netcore import chaos, framing
 from rainbow_iqn_apex_tpu.replay.net import protocol
 
 # bound on one reply write: a peer that stalls reading for this long is
@@ -247,6 +247,8 @@ class ReplayShardServer:
             sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
         except OSError:
             pass
+        sock = chaos.maybe_wrap(sock, peer=f"{_addr[0]}:{_addr[1]}",
+                                logger=self.logger)
         conn = _Conn(sock, self.max_frame_bytes)
         with self._lock:
             self._conns[sock.fileno()] = conn

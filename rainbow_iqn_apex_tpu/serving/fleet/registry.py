@@ -372,6 +372,28 @@ class EngineRegistry:
         with self._lock:
             return [h for h in self._handles.values() if h.routable]
 
+    def revivable(self) -> List[EngineHandle]:
+        """Handles that are NOT routable right now but whose engine process
+        still looks alive: a fresh lease behind a mark_dead suspicion
+        (monitor mode) or a live transport (attach mode).  That is a wire
+        flap, not an engine death — the next written beat (or successful
+        probe) rehabilitates the handle, so a re-route should PARK for
+        these instead of declaring the accepted request lost.  The lease
+        view is the last poll()'s snapshot, so a truly dead engine can
+        linger here for one lease timeout — the router's reroute window
+        bounds how long anyone waits on it."""
+        with self._lock:
+            out = []
+            for h in self._handles.values():
+                if h.routable or h.transport is None:
+                    continue
+                if h.lease is not None:
+                    if h.lease.fresh:
+                        out.append(h)
+                elif h.transport.alive():
+                    out.append(h)
+            return out
+
     # ------------------------------------------------------------------ poll
     def poll(self) -> List[Dict[str, Any]]:
         """One membership sweep; returns the edge events it emitted."""

@@ -477,12 +477,17 @@ class FrontRouter:
             if self._dispatch(rf):
                 self._count_reroute()
                 return
-            if any(h.engine_id not in rf.tried
-                   for h in self.registry.routable()):
-                # survivors exist but were momentarily FULL: park for the
-                # housekeeping retry loop — backpressure is not death, and
-                # declaring this accepted request lost here would break the
-                # zero-loss invariant against a healthy fleet
+            if self.registry.routable() or self.registry.revivable():
+                # survivors exist but were momentarily FULL, every routable
+                # engine is already in rf.tried, or the whole fleet is
+                # suspect behind FRESH leases — all of which mean connection
+                # flaps (injected corruption, latency) or backpressure, not
+                # engine death.  Park for the housekeeping retry loop:
+                # backpressure is not death, a flapped wire is not death
+                # either, and declaring this accepted request lost while
+                # live-leased engines remain would break the zero-loss
+                # invariant (the net-chaos soak gates it).  The reroute
+                # window still bounds the wait.
                 with self._lock:
                     self._retry.append(
                         (rf, self.clock() + self.reroute_window_s))
@@ -531,11 +536,17 @@ class FrontRouter:
             if self._dispatch(rf):
                 self._count_reroute()
                 continue
-            routable = any(h.engine_id not in rf.tried
-                           for h in self.registry.routable())
-            if not routable or self.clock() >= deadline:
+            handles = self.registry.routable()
+            if self.clock() >= deadline or (
+                    not handles and not self.registry.revivable()):
                 self._lose(rf, rf.engine_id)
                 continue
+            if handles and all(h.engine_id in rf.tried for h in handles):
+                # one full pass failed on every live-leased engine: those
+                # were connection flaps, not deaths — clear the ping-pong
+                # guard so the next sweep may retry them (still bounded
+                # by the reroute-window deadline above)
+                rf.tried.clear()
             with self._lock:
                 self._retry.appendleft((rf, deadline))
             return  # still full: let the queues drain until the next sweep

@@ -43,6 +43,7 @@ from rainbow_iqn_apex_tpu.serving.batcher import (
     ServerClosed,
     ServerOverloaded,
 )
+from rainbow_iqn_apex_tpu.netcore import chaos
 from rainbow_iqn_apex_tpu.serving.fleet.registry import EngineDead
 from rainbow_iqn_apex_tpu.serving.net import framing
 from rainbow_iqn_apex_tpu.utils import quantize
@@ -182,6 +183,8 @@ class RemoteTransport:
                 else timeout_s)
             sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
             sock.settimeout(None)  # reader blocks; writes are sendall
+            sock = chaos.maybe_wrap(sock, peer=f"engine{self.engine_id}",
+                                    logger=self.logger)
         except OSError:
             with self._lock:
                 self._fail_streak += 1

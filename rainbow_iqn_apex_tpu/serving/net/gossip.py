@@ -26,6 +26,7 @@ import threading
 import time
 from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
+from rainbow_iqn_apex_tpu.netcore import chaos
 from rainbow_iqn_apex_tpu.serving.net import framing
 
 # a gossip datagram is one frame; snapshots are tiny (per-engine ints), so
@@ -63,6 +64,8 @@ class RouterGossip:
         self._sock.bind(bind)
         self._sock.settimeout(0.05)
         self.host, self.port = self._sock.getsockname()[:2]
+        self._sock = chaos.maybe_wrap(self._sock, peer="gossip",
+                                      logger=self.logger)
         self._peers: List[Tuple[str, int]] = [tuple(p) for p in peers]
         self._lock = threading.Lock()
         # peer router id -> (snapshot dict, monotonic rx time)

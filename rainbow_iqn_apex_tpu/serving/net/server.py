@@ -29,6 +29,7 @@ import socket
 import threading
 from typing import Any, Dict, Optional, Tuple
 
+from rainbow_iqn_apex_tpu.netcore import chaos
 from rainbow_iqn_apex_tpu.serving.batcher import ServerClosed, ServerOverloaded
 from rainbow_iqn_apex_tpu.serving.net import framing
 from rainbow_iqn_apex_tpu.utils import quantize
@@ -188,6 +189,8 @@ class TransportServer:
             sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
         except OSError:
             pass
+        sock = chaos.maybe_wrap(sock, peer=f"{_addr[0]}:{_addr[1]}",
+                                logger=self.logger)
         conn = _Conn(sock, self.max_frame_bytes)
         with self._lock:
             self._conns[sock.fileno()] = conn

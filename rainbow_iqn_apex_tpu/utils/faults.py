@@ -49,6 +49,14 @@ POINTS = (
     # point of failure; a live standby claims the role — failover)
     "standby_claim",  # a standby's takeover claim attempt fails once
     # (filesystem hiccup mid-O_EXCL; the standby re-arms and re-claims)
+    "net_delay",  # a wire write stalls (latency spike; netcore/chaos.py
+    # consults this at its delay decision site)
+    "net_corrupt",  # one outgoing wire byte flips (CRC catches it; the
+    # plane must convert to its typed Frame* error and reconnect)
+    "net_partition",  # an outgoing wire write is silently dropped
+    # (one-way partition / frame-atomic loss; ack timeout, not corruption)
+    "net_slow_peer",  # this process reads the wire slowly (slow consumer;
+    # the peer's bounded per-conn queue must shed only THIS connection)
 )
 
 ENV_VAR = "RIA_FAULTS"
@@ -114,6 +122,11 @@ class FaultInjector:
     @property
     def enabled(self) -> bool:
         return bool(self._rules)
+
+    def has(self, point: str) -> bool:
+        """Whether the spec arms ``point`` at all, WITHOUT counting a call
+        (hot paths gate on this before paying for ``fire()``)."""
+        return point in self._rules
 
     def fire(self, point: str) -> bool:
         """True when the current call at ``point`` should fault."""

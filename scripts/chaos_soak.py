@@ -82,6 +82,7 @@ import numpy as np
 _REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, _REPO)
 
+from rainbow_iqn_apex_tpu.netcore import chaos as netchaos  # noqa: E402
 from rainbow_iqn_apex_tpu.utils import faults  # noqa: E402
 
 FRAME = 8  # tiny synthetic frames: the soak exercises plumbing, not learning
@@ -291,6 +292,15 @@ def soak_main(args) -> int:
     health = RunHealth(registry, metrics, role="soak")
     metrics.add_observer(health.observe_row)
 
+    if args.net:
+        # --net composition: arm the seeded network-fault interposer on
+        # every socket the parent opens, alongside the process-kill
+        # schedule.  Children get the same spec via env (site = their
+        # role label) in spawn() below.
+        armed = netchaos.install(
+            netchaos.NetChaos(args.net, seed=args.seed, site="soak-parent"))
+        armed.attach_logger(metrics)
+
     memory = ShardedReplay.build(
         args.actors, args.actors * 2048, args.actors * LANES,
         frame_shape=(FRAME, FRAME), history=1, n_step=1, gamma=0.9,
@@ -382,6 +392,10 @@ def soak_main(args) -> int:
             if epoch > 0 and host == poison_host:
                 argv.append("--poison")  # crash loop: budget must exhaust
             env[faults.ENV_VAR] = ",".join(spec)
+            if args.net:
+                env[netchaos.ENV_VAR] = args.net
+                env[netchaos.SEED_ENV_VAR] = str(args.seed)
+                env[netchaos.SITE_ENV_VAR] = f"actor{host}"
             return subprocess.Popen(argv, env=env,
                                     stdout=subprocess.DEVNULL,
                                     stderr=subprocess.STDOUT)
@@ -927,6 +941,11 @@ def failover_main(args) -> int:
     health = RunHealth(registry, metrics, role="failover")
     metrics.add_observer(health.observe_row)
 
+    if args.net:
+        armed = netchaos.install(
+            netchaos.NetChaos(args.net, seed=args.seed, site="soak-parent"))
+        armed.attach_logger(metrics)
+
     env = dict(os.environ)
     env["PYTHONPATH"] = _REPO + os.pathsep + env.get("PYTHONPATH", "")
     standby_host = 9
@@ -947,6 +966,10 @@ def failover_main(args) -> int:
         ]
         child_env = dict(env)
         child_env[faults.ENV_VAR] = spec
+        if args.net:
+            child_env[netchaos.ENV_VAR] = args.net
+            child_env[netchaos.SEED_ENV_VAR] = str(args.seed)
+            child_env[netchaos.SITE_ENV_VAR] = f"host{host}"
         return subprocess.Popen(argv, env=child_env,
                                 stdout=subprocess.DEVNULL,
                                 stderr=subprocess.STDOUT)
@@ -1159,6 +1182,12 @@ def parse_args(argv=None):
                     help="min transitions ingested before the soak can end")
     ap.add_argument("--kill-schedule", default="seeded",
                     choices=["seeded", "none"])
+    ap.add_argument("--net", default="",
+                    help="network-chaos spec (netcore/chaos grammar, e.g. "
+                         "'delay_ms=30+-20@p=0.5,corrupt_frame@p=0.01'): "
+                         "armed in the parent and exported to every spawned "
+                         "child via RIA_NET_CHAOS, composing wire faults "
+                         "with the process-kill schedule")
     ap.add_argument("--actors", type=int, default=3)
     ap.add_argument("--seed", type=int, default=0)
     ap.add_argument("--out", default="/tmp/ria_chaos_soak")

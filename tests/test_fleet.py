@@ -76,6 +76,15 @@ class FakeTransport:
         for fut in q:
             fut.set_error(ServerClosed("engine killed"))
 
+    def flap(self):
+        """Sever the wire only: error everything queued like a dropped
+        connection, but leave the engine process alive (still
+        submittable) — the net-chaos corruption failure mode."""
+        with self.lock:
+            q, self.queue = self.queue, []
+        for fut in q:
+            fut.set_error(ServerClosed("connection reset"))
+
     def depth(self):
         with self.lock:
             return len(self.queue)
@@ -263,6 +272,30 @@ def test_reroute_parks_on_full_survivor_instead_of_losing():
     stats = router.stats()
     assert stats["lost"] == 0
     assert stats["completed"] == len(futs) + len(queued)
+    assert stats["rerouted"] >= 1
+
+
+def test_fleet_wide_wire_flap_parks_and_recovers_zero_lost():
+    """Injected corruption can sever the connection to EVERY engine within
+    one request's lifetime (the net-chaos soak does exactly this).  With
+    all engine processes still alive, the re-route must PARK — not declare
+    the accepted request lost — and complete once the wires heal: loss is
+    reserved for zero live engines or reroute-window expiry."""
+    router, reg, t0, t1 = two_engine_router(max_inflight=100,
+                                            reroute_window_s=30.0)
+    fut = router.submit(OBS, tenant="t")
+    owner, other = (t0, t1) if t0.depth() else (t1, t0)
+    owner.flap()  # severs the wire -> the request re-dispatches to `other`
+    other.flap()  # ... which severs too: both tried, both suspect
+    assert not fut.done()  # parked, NOT lost
+    assert router.stats()["lost"] == 0
+    deadline = time.monotonic() + 5
+    while not fut.done() and time.monotonic() < deadline:
+        router.housekeeping()  # poll rehabilitates the live transports and
+        t0.pump(), t1.pump()   # the retry queue clears `tried` to re-land
+    fut.result(timeout=2)
+    stats = router.stats()
+    assert stats["lost"] == 0 and stats["completed"] == 1
     assert stats["rerouted"] >= 1
 
 

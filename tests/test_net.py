@@ -8,6 +8,7 @@ obs_report/relay_watch).  Everything here is jax-free: engines are protocol
 fakes driving the REAL sockets — `make net-smoke` runs the multi-process
 fleet against real PolicyServers on top."""
 
+import json
 import socket
 import threading
 import time
@@ -223,6 +224,64 @@ def test_frame_checksum_and_protocol_errors():
     # wrong magic: a peer speaking something else entirely (e.g. HTTP)
     with pytest.raises(framing.FrameProtocol):
         framing.FrameReader().feed(b"GET / HTTP/1.1\r\n\r\n")
+
+
+def test_frame_reader_fuzz_never_lies_and_never_explodes():
+    """Seeded fuzz hardening (ISSUE 19 satellite): random byte flips,
+    truncations, duplications, and junk splices over valid frame streams
+    must ALWAYS land as a typed Frame* error (after which the caller
+    resyncs by reconnecting — a fresh reader) or as frames that decode
+    byte-identical to ones actually sent.  Never an unhandled exception,
+    never a silently-wrong payload — the CRC is the witness."""
+    rng = np.random.default_rng(1905)
+    originals = []
+    for i in range(24):
+        blob = rng.integers(0, 256, int(rng.integers(0, 400)),
+                            dtype=np.uint8).tobytes()
+        originals.append((({"op": "fuzz", "rid": i}), blob))
+    clean = b"".join(framing.encode_frame(h, b) for h, b in originals)
+    sent = {(json.dumps(h, sort_keys=True), b) for h, b in originals}
+
+    def mutate(stream, rng):
+        stream = bytearray(stream)
+        kind = rng.integers(0, 4)
+        if kind == 0 and stream:  # flip a byte
+            i = int(rng.integers(0, len(stream)))
+            stream[i] ^= int(rng.integers(1, 256))
+        elif kind == 1 and stream:  # truncate (peer died mid-write)
+            del stream[int(rng.integers(0, len(stream))):]
+        elif kind == 2 and stream:  # duplicate a slice (retransmit bug)
+            i = int(rng.integers(0, len(stream)))
+            j = int(rng.integers(i, min(i + 64, len(stream)) + 1))
+            stream[i:i] = stream[i:j]
+        else:  # splice in junk (a foreign protocol burst)
+            i = int(rng.integers(0, len(stream) + 1))
+            junk = rng.integers(0, 256, int(rng.integers(1, 32)),
+                                dtype=np.uint8).tobytes()
+            stream[i:i] = junk
+        return bytes(stream)
+
+    for trial in range(200):
+        stream = clean
+        for _ in range(int(rng.integers(1, 4))):
+            stream = mutate(stream, rng)
+        reader = framing.FrameReader()
+        decoded, pos = [], 0
+        while pos < len(stream):
+            step = int(rng.integers(1, 4096))
+            chunk = stream[pos:pos + step]
+            pos += step
+            try:
+                decoded += reader.feed(chunk)
+            except framing.FrameError:
+                break  # typed: the plane drops the conn and reconnects
+            except Exception as e:  # pragma: no cover - the failure mode
+                raise AssertionError(
+                    f"trial {trial}: unhandled {type(e).__name__}: {e}")
+        for header, blob in decoded:
+            key = (json.dumps(header, sort_keys=True), blob)
+            assert key in sent, (
+                f"trial {trial}: decoded a frame nobody sent (CRC lied)")
 
 
 def test_ndarray_and_blob_sequence_codecs():

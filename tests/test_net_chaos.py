@@ -1,0 +1,629 @@
+"""Network-chaos interposer + partition-tolerance hardening (ISSUE 19).
+
+Covers the netcore/chaos.py seam end to end:
+
+- spec grammar (house ``--fault-spec`` style) parses and rejects loudly
+- the off path is the identity: no spec, no wrapper, no per-byte cost
+- injections are seeded-deterministic per (seed, site, peer, conn ordinal)
+- each fault converts to the receiving plane's TYPED error, never an
+  unhandled exception — and the planes recover without losing acked work
+- an ingress partition is delay, not loss (kernel buffer keeps the bytes)
+- a slow peer degrades only its own connection
+- HeartbeatMonitor clock-skew grace (the ±2s false-evict regression)
+- RetryPolicy determinism/clamp and retry_call's deadline budget under
+  injected net_delay
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import socket
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from rainbow_iqn_apex_tpu.config import Config
+from rainbow_iqn_apex_tpu.netcore import chaos, framing
+from rainbow_iqn_apex_tpu.obs import schema
+from rainbow_iqn_apex_tpu.parallel.elastic import HeartbeatMonitor
+from rainbow_iqn_apex_tpu.utils import faults
+from rainbow_iqn_apex_tpu.utils.faults import (
+    FaultInjector,
+    RetryPolicy,
+    retry_call,
+)
+
+pytestmark = pytest.mark.netchaos
+
+
+@pytest.fixture(autouse=True)
+def _pristine_globals():
+    """Every test leaves the process disarmed (chaos AND faults)."""
+    yield
+    chaos.install(None)
+    faults.install(None)
+
+
+def _pair():
+    a, b = socket.socketpair()
+    a.settimeout(5.0)
+    b.settimeout(5.0)
+    return a, b
+
+
+class _Rows:
+    """Minimal logger double collecting net_chaos rows."""
+
+    def __init__(self):
+        self.rows = []
+
+    def log(self, kind, **fields):
+        self.rows.append({"kind": kind, **fields})
+
+
+# ------------------------------------------------------------ spec grammar
+def test_spec_grammar_parses_the_house_example():
+    spec = ("delay_ms=50±20@p=1.0,corrupt_frame@p=0.01,"
+            "partition=hostA->hostB@t=10..12,slow_read_bps=64k,"
+            "blackhole@p=0.005,torn_write@p=0.01")
+    by_kind = {c.kind: c for c in chaos.parse_spec(spec)}
+    assert set(by_kind) == {"delay_ms", "corrupt_frame", "partition",
+                            "slow_read_bps", "blackhole", "torn_write"}
+    assert by_kind["delay_ms"].mean_ms == 50.0
+    assert by_kind["delay_ms"].jitter_ms == 20.0
+    assert by_kind["corrupt_frame"].prob == 0.01
+    assert by_kind["partition"].src == "hostA"
+    assert by_kind["partition"].dst == "hostB"
+    assert by_kind["partition"].t0 == 10.0 and by_kind["partition"].t1 == 12.0
+    assert by_kind["slow_read_bps"].bps == 64 * 1024
+    # ascii spelling of the jitter separator parses identically
+    alt = chaos.parse_spec("delay_ms=50+-20")[0]
+    assert alt.mean_ms == 50.0 and alt.jitter_ms == 20.0
+    assert chaos.parse_spec("") == ()
+
+
+@pytest.mark.parametrize("bad", [
+    "warp_speed@p=1.0",            # unknown clause
+    "corrupt_frame@p=1.5",         # probability out of range
+    "corrupt_frame@p=nope",        # unparseable probability
+    "corrupt_frame@q=0.5",         # unknown modifier
+    "partition=learner",           # missing ->dst
+    "partition=->b",               # empty src
+    "delay_ms=fast",               # unparseable delay
+    "delay_ms=-5",                 # negative delay
+    "slow_read_bps=0",             # rate below 1 byte/s
+    "slow_read_bps=manyk",         # unparseable rate
+    "blackhole=0.5",               # valueless clause given a value
+    "corrupt_frame@t=5..1",        # inverted window
+    "corrupt_frame@t=5",           # window missing '..'
+])
+def test_spec_rejects_malformed_entries(bad):
+    with pytest.raises(chaos.NetChaosSpecError):
+        chaos.parse_spec(bad)
+
+
+# ---------------------------------------------------------------- off path
+def test_defaults_off_and_maybe_wrap_identity():
+    cfg = Config()
+    assert cfg.net_chaos_spec == ""
+    assert cfg.lease_skew_tolerance_s == 0.0
+    assert os.environ.get(chaos.ENV_VAR, "") == ""
+    installed = chaos.install_from(cfg)
+    assert not installed.armed
+    a, b = _pair()
+    try:
+        # the seam returns the SAME object — zero per-byte interposition
+        assert chaos.maybe_wrap(a, peer="x", logger=_Rows()) is a
+    finally:
+        a.close()
+        b.close()
+
+
+def test_env_spec_arms_and_names_the_site(monkeypatch):
+    monkeypatch.setenv(chaos.ENV_VAR, "corrupt_frame@p=1.0")
+    monkeypatch.setenv(chaos.SITE_ENV_VAR, "learner")
+    monkeypatch.setenv(chaos.SEED_ENV_VAR, "11")
+    chaos.install(None)
+    chaos._current = None  # force the lazy env self-install path
+    installed = chaos.get()
+    assert installed.armed and installed.site == "learner"
+    assert installed.seed == 11
+    a, b = _pair()
+    try:
+        assert isinstance(chaos.maybe_wrap(a), chaos.ChaosSocket)
+    finally:
+        a.close()
+        b.close()
+    # env beats config: install_from with an empty cfg stays armed
+    assert chaos.install_from(Config()).armed
+
+
+# ------------------------------------------------------------- determinism
+def _corruption_pattern(seed, n=40):
+    nc = chaos.NetChaos("corrupt_frame@p=0.3", seed=seed, site="a")
+    a, b = _pair()
+    pattern = []
+    try:
+        w = nc.wrap(a, peer="b")
+        for i in range(n):
+            original = framing.encode_frame({"i": i})
+            w.sendall(original)
+            got = b.recv(len(original), socket.MSG_WAITALL)
+            pattern.append(got != original)
+    finally:
+        a.close()
+        b.close()
+    return pattern
+
+
+def test_injection_sequence_is_a_pure_function_of_the_seed():
+    p1, p2 = _corruption_pattern(seed=3), _corruption_pattern(seed=3)
+    assert p1 == p2
+    assert any(p1) and not all(p1)  # p=0.3 hits some, spares some
+    assert _corruption_pattern(seed=4) != p1
+
+
+# --------------------------------------------------------- per-fault wires
+def test_corrupt_frame_is_caught_by_the_crc_as_a_typed_error():
+    nc = chaos.NetChaos("corrupt_frame@p=1.0", seed=0, site="a")
+    a, b = _pair()
+    try:
+        w = nc.wrap(a, peer="b")
+        framing.send_frame(w, {"op": "x"}, b"payload")
+        with pytest.raises(framing.FrameError):
+            framing.recv_frame(b)
+        assert nc.injected("corrupt") == 1
+    finally:
+        a.close()
+        b.close()
+
+
+def test_partition_and_blackhole_drop_whole_frames_then_heal():
+    t = [0.0]
+    nc = chaos.NetChaos("partition=a->b@t=0..10", seed=0, site="a",
+                        clock=lambda: t[0])
+    a, b = _pair()
+    b.settimeout(0.2)
+    try:
+        w = nc.wrap(a, peer="b")
+        framing.send_frame(w, {"op": "lost"})
+        with pytest.raises(socket.timeout):
+            b.recv(64)  # egress partition: the peer saw NOTHING
+        t[0] = 11.0  # window closes -> healed
+        framing.send_frame(w, {"op": "after"})
+        b.settimeout(5.0)
+        header, _ = framing.recv_frame(b)
+        assert header == {"op": "after"}  # frame-atomic drop kept sync
+        assert nc.injected("partition") == 1
+    finally:
+        a.close()
+        b.close()
+
+
+def test_rx_partition_is_delay_not_loss():
+    t = [0.0]
+    nc = chaos.NetChaos("partition=a->b", seed=0, site="b",
+                        clock=lambda: t[0])
+    a, b = _pair()
+    try:
+        w = nc.wrap(b, peer="a")  # ingress side of the partition
+        a.sendall(framing.encode_frame({"op": "inflight"}))
+        # blocking read inside the window: socket.timeout (an OSError every
+        # reader loop treats as 'no data yet'), the bytes stay buffered
+        with pytest.raises(socket.timeout):
+            w.recv(4096)
+        # non-blocking read inside the window: BlockingIOError
+        w.setblocking(False)
+        with pytest.raises(BlockingIOError):
+            w.recv(4096)
+        w.settimeout(5.0)
+        # partitions without a window never heal by clock; swap in a healed
+        # interposer view by expiring a windowed clause instead
+        nc2 = chaos.NetChaos("partition=a->b@t=0..10", seed=0, site="b",
+                             clock=lambda: t[0])
+        w2 = nc2.wrap(b, peer="a")
+        t[0] = 11.0
+        header, _ = framing.recv_frame(w2)
+        assert header == {"op": "inflight"}  # delayed, NOT lost
+    finally:
+        a.close()
+        b.close()
+
+
+def test_torn_write_fails_typed_on_both_ends():
+    nc = chaos.NetChaos("torn_write@p=1.0", seed=0, site="a")
+    a, b = _pair()
+    try:
+        w = nc.wrap(a, peer="b")
+        # the sender sees the OSError family its drop paths already handle
+        with pytest.raises(BrokenPipeError):
+            framing.send_frame(w, {"op": "x"}, b"payload" * 20)
+        a.close()  # a real torn write ends with the sender dying
+        with pytest.raises(framing.FrameTruncated):
+            framing.recv_frame(b)
+        assert nc.injected("torn_write") == 1
+    finally:
+        b.close()
+
+
+def test_slow_read_paces_only_the_wrapped_socket():
+    nc = chaos.NetChaos("slow_read_bps=4k", seed=0, site="b")
+    a, b = _pair()
+    c, d = _pair()
+    payload = b"z" * 4096
+    try:
+        slow = nc.wrap(b, peer="a")
+        a.sendall(payload)
+        c.sendall(payload)
+        first = slow.recv(4096)
+        assert len(first) < 4096  # clamped well below the ask
+        assert len(d.recv(4096, socket.MSG_WAITALL)) == 4096  # sibling: free
+        got = bytearray(first)
+        deadline = time.monotonic() + 10.0
+        while len(got) < 4096 and time.monotonic() < deadline:
+            got += slow.recv(4096)
+        assert bytes(got) == payload  # slow, never lossy
+        assert nc.injected("slow_read") > 0
+    finally:
+        for s in (a, b, c, d):
+            s.close()
+
+
+# ------------------------------------------- faults.py point integration
+def test_fault_points_force_injections_without_a_chaos_spec():
+    faults.install(FaultInjector("net_corrupt@1"))
+    chaos.install(chaos.NetChaos(""))  # no spec at all
+    a, b = _pair()
+    try:
+        w = chaos.maybe_wrap(a, peer="b")
+        assert isinstance(w, chaos.ChaosSocket)  # net_* points arm the seam
+        framing.send_frame(w, {"n": 1})
+        with pytest.raises(framing.FrameError):
+            framing.recv_frame(b)  # @1 fired on the first write
+        framing.send_frame(w, {"n": 2})
+        header, _ = framing.recv_frame(b)
+        assert header == {"n": 2}  # and never again
+        assert faults.get().fired("net_corrupt") == 1
+    finally:
+        a.close()
+        b.close()
+
+
+def test_net_chaos_rows_are_schema_valid_and_rate_limited():
+    rows = _Rows()
+    nc = chaos.NetChaos("corrupt_frame@p=1.0", seed=0, site="learner")
+    nc.attach_logger(rows)
+    a, b = _pair()
+    try:
+        w = nc.wrap(a, peer="replay1")
+        for i in range(100):
+            w.sendall(b"xx")
+            b.recv(64)
+    finally:
+        a.close()
+        b.close()
+    assert [r["n"] for r in rows.rows] == [1, 2, 4, 8, 16, 32, 64]
+    for r in rows.rows:
+        assert r["kind"] == "net_chaos"
+        assert r["fault"] == "corrupt" and r["site"] == "learner"
+        assert r["peer"] == "replay1"
+        # with the envelope a real MetricsLogger adds, the row lints clean
+        enveloped = dict(r, schema=schema.SCHEMA_VERSION, ts=0.0, host=0,
+                         run="r")
+        assert schema.validate_row(enveloped, require_known_kind=True) == []
+
+
+# ------------------------------------------------- plane recovery contracts
+def test_serving_plane_converts_injected_corruption_and_recovers():
+    """One forced corruption on the serving wire: the pending request dies
+    with the plane's TYPED error (never an unhandled one), the transport
+    re-dials, and the next request completes — the router re-route
+    contract in miniature."""
+    from rainbow_iqn_apex_tpu.serving.batcher import (
+        ServeFuture,
+        ServerClosed,
+    )
+    from rainbow_iqn_apex_tpu.serving.fleet.registry import EngineDead
+    from rainbow_iqn_apex_tpu.serving.net import RemoteTransport
+    from rainbow_iqn_apex_tpu.serving.net.server import TransportServer
+
+    class MiniServer:
+        def __init__(self):
+            self.q, self.lock = [], threading.Lock()
+
+        def try_submit(self, obs):
+            with self.lock:
+                fut = ServeFuture(np.asarray(obs))
+                self.q.append(fut)
+                return fut
+
+        def depth(self):
+            with self.lock:
+                return len(self.q)
+
+        def abort(self):
+            with self.lock:
+                q, self.q = self.q, []
+            for fut in q:
+                fut.set_error(ServerClosed("down"))
+
+    def pump(server, stop):
+        while not stop.is_set():
+            with server.lock:
+                q, server.q = server.q, []
+            for fut in q:
+                if not fut.cancelled():
+                    fut.set_result(3, np.arange(4, dtype=np.float32))
+            time.sleep(0.005)
+
+    faults.install(FaultInjector("net_corrupt@1"))
+    chaos.install(chaos.NetChaos(""))
+    server = MiniServer()
+    ts = TransportServer(server, port=0).start()
+    rt = RemoteTransport("127.0.0.1", ts.port, engine_id=1)
+    stop = threading.Event()
+    pump_t = threading.Thread(target=pump, args=(server, stop), daemon=True)
+    pump_t.start()
+    try:
+        completed, typed_failures = 0, 0
+        deadline = time.monotonic() + 20.0
+        while completed < 3 and time.monotonic() < deadline:
+            try:
+                fut = rt.submit(np.zeros((4, 4, 2), np.uint8))
+                action, _ = fut.result(timeout=5.0)
+                assert action == 3
+                completed += 1
+            except (EngineDead, ServerClosed, OSError):
+                typed_failures += 1  # the typed path, then re-dial
+                time.sleep(0.05)
+        assert completed >= 3
+        assert faults.get().fired("net_corrupt") == 1  # it DID strike
+    finally:
+        stop.set()
+        pump_t.join(timeout=2)
+        rt.close()
+        ts.stop()
+
+
+def test_replay_acked_rows_survive_a_corruption_window():
+    """AppendClient under seeded corruption: every acked row is a row the
+    server really holds — corruption costs retries, never acked work."""
+    from rainbow_iqn_apex_tpu.parallel.sharded_replay import ShardedReplay
+    from rainbow_iqn_apex_tpu.replay.net import (
+        AppendClient,
+        ReplayPeer,
+        ReplayShardServer,
+    )
+
+    chaos.install(chaos.NetChaos("corrupt_frame@p=0.05", seed=2,
+                                 site="learner"))
+    mem = ShardedReplay.build(1, 512, 4, frame_shape=(12, 12), history=2,
+                              n_step=3, gamma=0.9, seed=0)
+    srv = ReplayShardServer(mem).start()
+    peer = ReplayPeer("127.0.0.1", srv.port, peer_id=0)
+    ac = AppendClient(peer, own_peer=False)
+    rng = np.random.default_rng(1)
+    try:
+        for _ in range(60):
+            ac.append(
+                rng.integers(0, 255, (4, 12, 12), dtype=np.uint8),
+                rng.integers(0, 4, 4),
+                rng.normal(size=4).astype(np.float32),
+                rng.random(4) < 0.02,
+                priorities=rng.random(4) + 0.05,
+            )
+        ac.flush(timeout_s=60.0)
+        assert ac.acked_rows > 0
+        # the zero-acked-loss ledger: acked <= durably applied server-side
+        assert srv.rows_appended >= ac.acked_rows
+    finally:
+        ac.close()
+        peer.close()
+        srv.stop()
+
+
+def test_sample_timeout_kicks_the_wedged_link_instead_of_serializing():
+    """Requests sent into a one-way partition never get a reply: the first
+    wait burns its budget, and every SIBLING in-flight request on the same
+    link would then serialize its own full budget too (N x ack_timeout_s
+    of sampler starvation after the partition heals).  A timed-out wait
+    kicks the connection: siblings settle with PeerDead immediately and
+    the next request re-dials a fresh socket."""
+    from rainbow_iqn_apex_tpu.parallel.sharded_replay import ShardedReplay
+    from rainbow_iqn_apex_tpu.replay.net import (
+        PeerDead,
+        ReplayPeer,
+        ReplayShardServer,
+    )
+
+    mem = ShardedReplay.build(1, 512, 4, frame_shape=(12, 12), history=2,
+                              n_step=3, gamma=0.9, seed=0)
+    srv = ReplayShardServer(mem).start()
+    chaos.install(chaos.NetChaos("partition=learner->replay0", seed=0,
+                                 site="learner"))
+    peer = ReplayPeer("127.0.0.1", srv.port, peer_id=0, ack_timeout_s=0.8)
+    try:
+        p1 = peer.start_request({"op": "ping"})
+        p2 = peer.start_request({"op": "ping"})
+        with pytest.raises(TimeoutError):
+            peer.wait(p1)  # the partition swallowed the request frames
+        peer.kick()
+        t0 = time.monotonic()
+        with pytest.raises(PeerDead):
+            peer.wait(p2)  # sibling settles NOW — no second budget burned
+        assert time.monotonic() - t0 < 0.2
+        chaos.install(None)  # heal: the next dial gets a bare socket
+        header, _ = peer.request({"op": "ping"}, timeout_s=5.0)
+        assert isinstance(header, dict)
+    finally:
+        peer.close()
+        srv.stop()
+
+
+def test_obs_relay_sheds_not_stalls_under_injected_latency():
+    """With 500ms injected on every wire write, the relay keeps absorbing
+    rows into its bounded spool and stays responsive — telemetry degrades
+    by shedding, never by blocking the training loop."""
+    from rainbow_iqn_apex_tpu.obs.net.relay import ObsRelay
+
+    listener = socket.socket()
+    listener.bind(("127.0.0.1", 0))
+    listener.listen(4)
+    chaos.install(chaos.NetChaos("delay_ms=500", seed=0, site="learner"))
+    relay = ObsRelay(host_id=1, role="learner", spool_rows=32,
+                     collector_addr=listener.getsockname())
+    try:
+        t0 = time.monotonic()
+        for i in range(2000):
+            relay.observe({"kind": "learn", "step": i})
+        # a design that waited on the 500ms-per-frame wire would take
+        # minutes here; observe() must never touch the socket
+        assert time.monotonic() - t0 < 2.0
+        assert relay.shed_rows > 0  # bounded spool sheds the overflow
+        assert relay.spool_depth() <= 32
+    finally:
+        relay.close(flush_timeout_s=0.1)
+        listener.close()
+
+
+def test_gossip_counts_corrupt_datagrams_and_reconverges_after_heal():
+    from rainbow_iqn_apex_tpu.serving.net.gossip import RouterGossip
+
+    t = [0.0]
+    chaos.install(chaos.NetChaos("corrupt_frame@t=0..5", seed=0,
+                                 site="router", clock=lambda: t[0]))
+    g0 = RouterGossip(0, lambda: {"inflight": {}, "target_version": 7},
+                      interval_s=0.05)
+    g1 = RouterGossip(1, lambda: {"inflight": {}, "target_version": 7},
+                      interval_s=0.05)
+    g0.set_peers([("127.0.0.1", g1.port)])
+    g1.set_peers([("127.0.0.1", g0.port)])
+    g0.start()
+    g1.start()
+    try:
+        deadline = time.monotonic() + 5.0
+        while g1.bad_frames == 0 and time.monotonic() < deadline:
+            time.sleep(0.02)
+        assert g1.bad_frames > 0  # corruption lands as a COUNTED bad frame
+        t[0] = 6.0  # heal
+        deadline = time.monotonic() + 5.0
+        while g1.peer_target_version() != 7 and time.monotonic() < deadline:
+            time.sleep(0.02)
+        assert g1.peer_target_version() == 7  # federation reconverged
+    finally:
+        g0.stop()
+        g1.stop()
+
+
+# ----------------------------------------------------- clock-skew satellite
+def test_lease_skew_tolerance_absorbs_reader_clock_ahead(tmp_path):
+    """A reader whose clock runs 2s ahead of the writer's sees every lease
+    2s older than it is.  Without the grace the healthy host is falsely
+    evicted (the old behaviour, asserted); with
+    ``skew_tolerance_s`` covering the skew it stays fresh."""
+    hb = tmp_path / "hb"
+    hb.mkdir()
+    path = hb / "h3.json"
+    path.write_text(json.dumps({"role": "host", "epoch": 1}))
+    beat = time.time() - 2.0  # writer's clock trails the reader by 2s
+    os.utime(path, (beat, beat))
+
+    old = HeartbeatMonitor(str(hb), timeout_s=1.0)
+    assert not old.leases()[3].fresh  # the regression: false eviction
+    assert old.check() == [3]
+
+    graced = HeartbeatMonitor(str(hb), timeout_s=1.0, skew_tolerance_s=2.5)
+    lease = graced.leases()[3]
+    assert lease.fresh  # same file, same ages — only the boundary moved
+    assert lease.age_s == pytest.approx(old.leases()[3].age_s, abs=0.5)
+    assert graced.check() == []
+    dead, alive = graced.poll()
+    assert dead == []
+    # a genuinely dead host is still caught once the grace is exhausted
+    stale = time.time() - 10.0
+    os.utime(path, (stale, stale))
+    assert graced.check() == [3]
+
+
+def test_config_wires_skew_tolerance_into_failover_monitor(tmp_path):
+    from rainbow_iqn_apex_tpu.parallel.failover import StandbyLearner
+
+    cfg = Config(checkpoint_dir=str(tmp_path), heartbeat_timeout_s=5.0,
+                 lease_skew_tolerance_s=2.0, failover_standby=True)
+    standby = StandbyLearner(cfg, takeover=lambda epoch, state: None)
+    assert standby.monitor.skew_tolerance_s == 2.0
+    assert HeartbeatMonitor(str(tmp_path), 1.0).skew_tolerance_s == 0.0
+
+
+# -------------------------------------------------- RetryPolicy satellites
+def test_retry_policy_backoff_is_deterministic_per_seed():
+    p = RetryPolicy(attempts=6, base_delay_s=0.1, max_delay_s=1.0,
+                    jitter=0.5, seed=9)
+    assert list(p.delays()) == list(p.delays())
+    assert list(p.delays()) == list(
+        RetryPolicy(attempts=6, base_delay_s=0.1, max_delay_s=1.0,
+                    jitter=0.5, seed=9).delays())
+    assert list(p.delays()) != list(
+        RetryPolicy(attempts=6, base_delay_s=0.1, max_delay_s=1.0,
+                    jitter=0.5, seed=10).delays())
+
+
+def test_retry_policy_clamps_at_max_delay():
+    p = RetryPolicy(attempts=6, base_delay_s=1.0, max_delay_s=2.0,
+                    jitter=0.0, seed=0)
+    assert list(p.delays()) == [1.0, 2.0, 2.0, 2.0, 2.0]
+    jittered = RetryPolicy(attempts=8, base_delay_s=1.0, max_delay_s=2.0,
+                           jitter=0.5, seed=3)
+    assert all(d <= 2.0 * 1.5 for d in jittered.delays())
+
+
+def test_retry_call_stays_inside_its_deadline_budget_under_net_delay():
+    """The bounded-probe contract: with net_delay injected on every write,
+    retry_call's wall time stays under the budget a caller can compute
+    from the policy alone — injected latency cannot starve the caller."""
+    nc = chaos.NetChaos("delay_ms=20", seed=0, site="a")
+    a, b = _pair()
+    policy = RetryPolicy(attempts=3, base_delay_s=0.02, max_delay_s=0.1,
+                         jitter=0.0, seed=0)
+    state = {"calls": 0}
+    w = nc.wrap(a, peer="b")
+
+    def flaky_send():
+        state["calls"] += 1
+        w.sendall(b"ping")  # pays the injected 20ms every attempt
+        if state["calls"] < 3:
+            raise OSError("transient")
+        return "ok"
+
+    slept = []
+    budget = (sum(policy.delays())            # backoff the policy promises
+              + policy.attempts * (0.020 + 0.5))  # per-try injected + slack
+    try:
+        t0 = time.monotonic()
+        assert retry_call(flaky_send, policy,
+                          sleep=lambda s: slept.append(s)) == "ok"
+        elapsed = time.monotonic() - t0
+    finally:
+        a.close()
+        b.close()
+    assert state["calls"] == 3
+    assert slept == list(policy.delays())  # the exact promised schedule
+    assert elapsed < budget
+    assert nc.injected("delay") == 3
+
+
+def test_retry_call_exhausted_budget_reraises_the_typed_error():
+    policy = RetryPolicy(attempts=2, base_delay_s=0.0, max_delay_s=0.0,
+                         jitter=0.0)
+    calls = []
+
+    def always_down():
+        calls.append(1)
+        raise ConnectionResetError("peer gone")
+
+    with pytest.raises(ConnectionResetError):
+        retry_call(always_down, policy, sleep=lambda s: None)
+    assert len(calls) == 2  # attempts is the TOTAL budget
