@@ -232,7 +232,15 @@ perf-smoke:
 	           u['speedup_vs_k1'], u['eval_k'], u['eval_k1'], \
 	           u['eval_parity'])); \
 	  assert u['speedup_vs_k1'] >= 2.0, 'replay reuse under 2x at K=4'; \
-	  assert u['eval_parity'] is True, 'replay reuse eval parity not shown'"
+	  assert u['eval_parity'] is True, 'replay reuse eval parity not shown'; \
+	  n = [x for x in rows if x.get('path') == 'replay_net_path'][-1]; \
+	  assert n.get('status') is None, 'replay_net_path row: %s' % n['status']; \
+	  print('replay_net_path: wire %.1f batches/s vs host %.1f ' \
+	        '(ratio %.3f, shm=%s)' \
+	        % (n['value'], n['host_batches_per_sec'], \
+	           n['ratio_vs_host'], n.get('shm'))); \
+	  assert n['ratio_vs_host'] >= 0.5, 'wire replay path under 0.5x of ' \
+	        'in-process (shm fast path lost?)'"
 	$(PY) scripts/bench_diff.py /tmp/ria_perf_smoke.jsonl
 
 # trace smoke (docs/OBSERVABILITY.md "tracing"): a tiny TRACED apex run

@@ -59,7 +59,7 @@ HOT_PATH: Dict[str, Sequence[str]] = {
     # sit under sanctioned() exactly like the frontier's gathers
     "rainbow_iqn_apex_tpu/replay/net/client.py": (
         "SampleClient.get",
-        "SampleClient._decode_batch",
+        "SampleClient._decode_reply",
         "SampleClient.update_priorities",
     ),
     "rainbow_iqn_apex_tpu/parallel/apex.py": (

@@ -18,6 +18,7 @@ from rainbow_iqn_apex_tpu.analysis import (
     hostsync_lint,
     imports,
     locks,
+    wirecheck,
 )
 from rainbow_iqn_apex_tpu.analysis.core import Finding
 
@@ -31,6 +32,7 @@ ANALYZER_IDS = (
     imports.ANALYZER,
     configcheck.ANALYZER,
     configcheck.DOC_ANALYZER,
+    wirecheck.ANALYZER,
 )
 
 
@@ -79,6 +81,8 @@ def run_all(
         findings.extend(configcheck.check_repo(repo_root, modules=modules))
     if configcheck.DOC_ANALYZER in wanted:
         findings.extend(configcheck.check_docs(repo_root))
+    if wirecheck.ANALYZER in wanted:
+        findings.extend(wirecheck.check_repo(repo_root))
 
     if baseline_path is None:
         baseline_path = os.path.join(repo_root, BASELINE_PATH)

@@ -1,0 +1,240 @@
+"""Wire-schema drift checker (id ``wire-drift``).
+
+The replay plane's wire format is defined in FOUR places that must agree
+or peers desync at runtime in ways no unit test of either side catches:
+
+1. **codec ceilings**: ``replay/net/protocol.py WIRE_CODEC_MAX`` (what the
+   server's piggyback advertises and the client caps negotiation at) must
+   equal ``netcore/framing.py CODECS["replay_batch"]`` (the one registry
+   of wire codec versions), and ``CODECS["frame"]`` must equal
+   ``framing.FRAME_VERSION_MAX`` (the envelope version the reader
+   accepts).  A bumped codec that misses the registry ships frames peers
+   were never told to expect.
+2. **encoding table**: ``protocol.V2_ENCODINGS`` (the declared v2 column
+   encodings — the wire contract) must exactly match the keys of
+   ``protocol._V2_DECODERS`` (what decode actually handles).  An encoder
+   without a decoder corrupts every batch that picks it; a decoder without
+   a declaration is dead wire surface.
+3. **op sets**: the request ops `ReplayShardServer._handle` dispatches on
+   must exactly equal ``protocol.OPS`` (the declared request surface), and
+   every ``{"op": ...}`` request the client builds must be declared there
+   too.  A handled-but-undeclared op is protocol drift; a declared-but-
+   unhandled one is a client-visible ``rerr`` waiting to happen.
+4. **shm preamble**: both magics in ``replay/net/shm.py`` must be exactly
+   8 bytes (the ``>8sQ`` preamble struct) — a resized magic would shift
+   the flags word and silently mis-negotiate every same-host dial.
+
+Everything is stdlib-``ast`` extraction (the configcheck pattern — no
+package imports), so drift is caught even when the modules no longer
+import.  No inline pragma: wire drift has no legitimate "on purpose" —
+an emergency lands via an explicit ``baseline.txt`` line instead.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+from typing import Any, Dict, List, Optional, Tuple
+
+from rainbow_iqn_apex_tpu.analysis.core import Finding
+
+ANALYZER = "wire-drift"
+
+FRAMING_PATH = "rainbow_iqn_apex_tpu/netcore/framing.py"
+PROTOCOL_PATH = "rainbow_iqn_apex_tpu/replay/net/protocol.py"
+SERVER_PATH = "rainbow_iqn_apex_tpu/replay/net/server.py"
+CLIENT_PATH = "rainbow_iqn_apex_tpu/replay/net/client.py"
+SHM_PATH = "rainbow_iqn_apex_tpu/replay/net/shm.py"
+
+
+def _module_consts(tree: ast.Module) -> Dict[str, Tuple[Any, int]]:
+    """name -> (value, lineno) for module-level assignments that resolve
+    to literals — including dicts/tuples whose values are earlier
+    module-level names (the ``CODECS = {"frame": FRAME_VERSION_MAX}``
+    shape)."""
+    out: Dict[str, Tuple[Any, int]] = {}
+
+    def resolve(node: ast.AST) -> Any:
+        if isinstance(node, ast.Name) and node.id in out:
+            return out[node.id][0]
+        if isinstance(node, ast.Dict):
+            return {resolve(k): resolve(v)
+                    for k, v in zip(node.keys, node.values)}
+        if isinstance(node, (ast.Tuple, ast.List)):
+            vals = [resolve(e) for e in node.elts]
+            return tuple(vals) if isinstance(node, ast.Tuple) else vals
+        return ast.literal_eval(node)  # constants; raises on the rest
+
+    for stmt in tree.body:
+        targets = []
+        if isinstance(stmt, ast.Assign):
+            targets = [t for t in stmt.targets if isinstance(t, ast.Name)]
+        elif isinstance(stmt, ast.AnnAssign) and stmt.value is not None:
+            if isinstance(stmt.target, ast.Name):
+                targets = [stmt.target]
+        if not targets:
+            continue
+        try:
+            value = resolve(stmt.value)
+        except (ValueError, TypeError, SyntaxError, KeyError):
+            continue
+        for t in targets:
+            out[t.id] = (value, stmt.lineno)
+    return out
+
+
+def _dict_keys_lineno(tree: ast.Module, name: str
+                      ) -> Tuple[Optional[Tuple[str, ...]], int]:
+    """Keys of a module-level ``name = {...}`` dict whose VALUES need not
+    be literals (the ``_V2_DECODERS`` shape: values are function names)."""
+    for stmt in tree.body:
+        if not isinstance(stmt, ast.Assign):
+            continue
+        if not any(isinstance(t, ast.Name) and t.id == name
+                   for t in stmt.targets):
+            continue
+        if isinstance(stmt.value, ast.Dict):
+            try:
+                return (tuple(ast.literal_eval(k) for k in stmt.value.keys),
+                        stmt.lineno)
+            except (ValueError, TypeError):
+                return None, stmt.lineno
+    return None, 1
+
+
+def _compared_ops(tree: ast.Module, func: str = "_handle"
+                  ) -> Dict[str, int]:
+    """op literal -> first lineno, from every ``op == "x"`` /
+    ``op in ("x", ...)`` comparison against a name called ``op`` INSIDE
+    the function named ``func`` — the server's wire dispatch.  (The
+    memory-worker loop dispatches internal ops like ``refill`` too;
+    those never ride a frame and are deliberately out of scope.)"""
+    scope: ast.AST = tree
+    for node in ast.walk(tree):
+        if isinstance(node, ast.FunctionDef) and node.name == func:
+            scope = node
+            break
+    out: Dict[str, int] = {}
+    for node in ast.walk(scope):
+        if not (isinstance(node, ast.Compare)
+                and isinstance(node.left, ast.Name)
+                and node.left.id == "op" and len(node.ops) == 1):
+            continue
+        cmp = node.comparators[0]
+        lits: List[Any] = []
+        if isinstance(node.ops[0], ast.Eq):
+            lits = [cmp]
+        elif isinstance(node.ops[0], ast.In) and isinstance(
+                cmp, (ast.Tuple, ast.List)):
+            lits = list(cmp.elts)
+        for lit in lits:
+            if isinstance(lit, ast.Constant) and isinstance(lit.value, str):
+                out.setdefault(lit.value, node.lineno)
+    return out
+
+
+def _request_ops(tree: ast.Module) -> Dict[str, int]:
+    """op literal -> first lineno, from every ``{"op": "<x>", ...}`` dict
+    the client builds (its request headers)."""
+    out: Dict[str, int] = {}
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Dict):
+            continue
+        for k, v in zip(node.keys, node.values):
+            if (isinstance(k, ast.Constant) and k.value == "op"
+                    and isinstance(v, ast.Constant)
+                    and isinstance(v.value, str)):
+                out.setdefault(v.value, node.lineno)
+    return out
+
+
+def collect(repo_root: str) -> Dict[str, Any]:
+    """Parse the four wire-defining modules into one comparable surface
+    (split from `verify` so tests can inject drift without editing
+    source files)."""
+    trees = {}
+    for path in (FRAMING_PATH, PROTOCOL_PATH, SERVER_PATH, CLIENT_PATH,
+                 SHM_PATH):
+        with open(os.path.join(repo_root, path), encoding="utf-8") as fh:
+            trees[path] = ast.parse(fh.read(), filename=path)
+    framing_c = _module_consts(trees[FRAMING_PATH])
+    protocol_c = _module_consts(trees[PROTOCOL_PATH])
+    shm_c = _module_consts(trees[SHM_PATH])
+    decoder_keys, decoder_line = _dict_keys_lineno(trees[PROTOCOL_PATH],
+                                                   "_V2_DECODERS")
+    return {
+        "framing_consts": framing_c,
+        "protocol_consts": protocol_c,
+        "shm_consts": shm_c,
+        "decoder_keys": decoder_keys,
+        "decoder_line": decoder_line,
+        "server_ops": _compared_ops(trees[SERVER_PATH]),
+        "client_ops": _request_ops(trees[CLIENT_PATH]),
+    }
+
+
+def verify(surface: Dict[str, Any]) -> List[Finding]:
+    findings: List[Finding] = []
+
+    def fail(path: str, line: int, key: str, msg: str) -> None:
+        findings.append(Finding(ANALYZER, path, line,
+                                f"wire-drift:{key}", msg))
+
+    fr, pr = surface["framing_consts"], surface["protocol_consts"]
+    codecs, codecs_line = fr.get("CODECS", ({}, 1))
+    # 1a. replay batch codec ceiling vs the registry
+    wire_max, wire_line = pr.get("WIRE_CODEC_MAX", (None, 1))
+    if codecs.get("replay_batch") != wire_max:
+        fail(FRAMING_PATH, codecs_line, "codecs-replay-batch",
+             f"CODECS['replay_batch'] = {codecs.get('replay_batch')!r} but "
+             f"protocol.WIRE_CODEC_MAX = {wire_max!r} — the codec registry "
+             "and the negotiation ceiling disagree")
+    # 1b. frame envelope version vs the registry
+    fmax, _ = fr.get("FRAME_VERSION_MAX", (None, 1))
+    if codecs.get("frame") != fmax:
+        fail(FRAMING_PATH, codecs_line, "codecs-frame",
+             f"CODECS['frame'] = {codecs.get('frame')!r} but "
+             f"FRAME_VERSION_MAX = {fmax!r}")
+    # 2. encoder declarations vs decoder table
+    encs, encs_line = pr.get("V2_ENCODINGS", (None, 1))
+    decs = surface["decoder_keys"]
+    if encs is not None and decs is not None and set(encs) != set(decs):
+        only_enc = sorted(set(encs) - set(decs))
+        only_dec = sorted(set(decs) - set(encs))
+        fail(PROTOCOL_PATH, encs_line, "v2-encodings",
+             f"V2_ENCODINGS vs _V2_DECODERS drift: declared without a "
+             f"decoder {only_enc}, decoded without a declaration "
+             f"{only_dec}")
+    # 3. op surfaces
+    ops, ops_line = pr.get("OPS", ((), 1))
+    ops_set = set(ops)
+    server_ops = surface["server_ops"]
+    for op, line in sorted(server_ops.items()):
+        if op not in ops_set:
+            fail(SERVER_PATH, line, f"server-op-{op}",
+                 f"server dispatches request op {op!r} not declared in "
+                 "protocol.OPS")
+    for op in sorted(ops_set - set(server_ops)):
+        fail(PROTOCOL_PATH, ops_line, f"unhandled-op-{op}",
+             f"protocol.OPS declares {op!r} but the server's _handle "
+             "never dispatches it")
+    for op, line in sorted(surface["client_ops"].items()):
+        if op not in ops_set:
+            fail(CLIENT_PATH, line, f"client-op-{op}",
+                 f"client sends request op {op!r} not declared in "
+                 "protocol.OPS")
+    # 4. shm preamble shape
+    sc = surface["shm_consts"]
+    for name in ("MAGIC_REQ", "MAGIC_HELLO"):
+        magic, line = sc.get(name, (None, 1))
+        if not isinstance(magic, bytes) or len(magic) != 8:
+            fail(SHM_PATH, line, f"shm-{name.lower()}",
+                 f"shm.{name} must be exactly 8 bytes (the >8sQ preamble "
+                 f"struct); got {magic!r}")
+    return findings
+
+
+def check_repo(repo_root: str, modules=None) -> List[Finding]:
+    """The runner entry point (``modules`` accepted for signature parity
+    with configcheck; the checker parses its own fixed file set)."""
+    return verify(collect(repo_root))

@@ -407,6 +407,30 @@ class Config:
     # composition
     replay_net_shard_count: int = 0  # shards this server owns; 0 = all
     # `replay_shards` (the single-server topology)
+    replay_net_ring_depth: int = 2  # server-side sample-ahead: pre-assembled,
+    # pre-ENCODED batches kept per connected sampler so `sample` answers
+    # from the event loop instead of queueing behind appends; 0 disables
+    # (every sample assembles on demand).  Staleness bound: a ring entry's
+    # priorities are at most ring_depth samples old.
+    replay_net_sample_many: int = 4  # batches per sample RPC once codec v2 is
+    # negotiated (one frame carries N pre-assembled batches, amortizing
+    # header/syscall/queue-wait costs); clamped to [1, 16] server-side
+    replay_net_depth_min: int = 1  # floor of the SampleClient's ADAPTIVE
+    # pipeline depth (in batches)
+    replay_net_depth_max: int = 8  # ceiling of the adaptive pipeline depth:
+    # the depth tracks ceil(rtt / consume-gap)+1 between these bounds, so a
+    # fast loopback link stops parking depth_max batches of staleness while
+    # a slow WAN link pipelines deep enough to never starve the learner
+    replay_net_shm_mb: int = 64  # per-sampler-connection shared-memory arena
+    # (replay/net/shm.py): colocated samplers receive batches as zero-copy
+    # views over a memfd the server writes once, skipping both socket
+    # kernel copies.  0 disables arenas (AF_UNIX byte path still applies);
+    # only consulted when `replay_net_local_fastpath` is on.
+    replay_net_local_fastpath: bool = True  # same-host fast path: the server
+    # listens on an abstract AF_UNIX socket beside its TCP port and local
+    # clients (host in {127.0.0.1, ::1, localhost}) dial it first, falling
+    # back to TCP on any miss.  Off = every connection uses TCP (bitwise
+    # the cross-host wire path, useful for debugging)
 
     # ---- league / population-based training (league/; docs/LEAGUE.md) -------------
     league_dir: str = ""  # shared league state directory (genomes, per-member

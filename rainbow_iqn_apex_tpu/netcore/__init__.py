@@ -30,6 +30,12 @@ _EXPORTS = {
     "encode_frame": "rainbow_iqn_apex_tpu.netcore.framing",
     "recv_frame": "rainbow_iqn_apex_tpu.netcore.framing",
     "send_frame": "rainbow_iqn_apex_tpu.netcore.framing",
+    "encode_frame_views": "rainbow_iqn_apex_tpu.netcore.framing",
+    "send_frame_views": "rainbow_iqn_apex_tpu.netcore.framing",
+    "recv_frame_view": "rainbow_iqn_apex_tpu.netcore.framing",
+    "ndarray_view": "rainbow_iqn_apex_tpu.netcore.framing",
+    "word_sum64": "rainbow_iqn_apex_tpu.netcore.framing",
+    "CODECS": "rainbow_iqn_apex_tpu.netcore.framing",
     "encode_ndarray": "rainbow_iqn_apex_tpu.netcore.framing",
     "decode_ndarray": "rainbow_iqn_apex_tpu.netcore.framing",
     "pack_blobs": "rainbow_iqn_apex_tpu.netcore.framing",
@@ -62,6 +68,7 @@ if TYPE_CHECKING:  # static analyzers see the eager imports
         NetChaosSpecError,
     )
     from rainbow_iqn_apex_tpu.netcore.framing import (  # noqa: F401
+        CODECS,
         DEFAULT_MAX_FRAME,
         FrameCorrupt,
         FrameError,
@@ -71,9 +78,14 @@ if TYPE_CHECKING:  # static analyzers see the eager imports
         FrameTruncated,
         decode_ndarray,
         encode_frame,
+        encode_frame_views,
         encode_ndarray,
+        ndarray_view,
         pack_blobs,
         recv_frame,
+        recv_frame_view,
         send_frame,
+        send_frame_views,
         unpack_blobs,
+        word_sum64,
     )

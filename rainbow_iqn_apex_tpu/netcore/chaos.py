@@ -415,6 +415,26 @@ class ChaosSocket:
             return len(data)
         return self._sock.sendto(self._tx_transform(data), *args)
 
+    def sendmsg(self, buffers, *args):
+        """Vectored send under chaos: the iovec chain is judged as ONE
+        frame (joined, transformed, flushed) so drops stay frame-atomic
+        like `sendall`, corrupt_frame flips a byte anywhere in the chain,
+        and torn_write's seeded prefix can end INSIDE any iovec entry —
+        the mid-iovec tear `framing.sendmsg_all` must survive.
+
+        A call carrying ancillary data (the replay plane's SCM_RIGHTS
+        arena-fd handoff) bypasses the fault model entirely: byte
+        transforms cannot be applied to kernel-level fd passing, and the
+        handoff is connection setup, not wire traffic."""
+        if args and args[0]:
+            return self._sock.sendmsg(buffers, *args)
+        data = b"".join(buffers)
+        if self._tx_dropped():
+            return len(data)
+        buf = self._tx_transform(data)
+        self._sock.sendall(buf)
+        return len(buf)
+
     # ------------------------------------------------------------ RX path
     def _rx_partitioned(self) -> bool:
         chaos = self._chaos

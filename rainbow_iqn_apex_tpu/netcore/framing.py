@@ -15,7 +15,9 @@ fields) and ``blob`` is an optional opaque binary payload (an observation
 frame, a Q-vector, a `WeightPacket` npz, a batch of replay transitions).
 The CRC32 trailer covers header+blob, so a frame that survived TCP but was
 corrupted by a buggy middlebox or a torn writer is rejected instead of
-decoded into garbage.
+decoded into garbage.  Envelope v2 (``VERSION_DELEGATED``) narrows the
+trailer to the header only, for blobs whose payload codec carries its own
+per-column word-sums (`word_sum64`) — negotiated, never the default.
 
 Hardening contract (tests/test_net.py, tests/test_replay_net.py):
 
@@ -42,19 +44,50 @@ from __future__ import annotations
 import json
 import struct
 import zlib
-from typing import Any, Dict, List, Optional, Tuple
+from typing import Any, Dict, List, Optional, Sequence, Tuple, Union
 
 import numpy as np
 
 MAGIC = b"RN"
 VERSION = 1
+# Frame envelope v2 ("delegated-integrity"): identical layout, but the
+# trailer CRC covers the HEADER only — the payload codec riding the blob
+# carries its own per-column integrity (the replay batch codec's ``sum64``
+# word-sums).  Motivation: crc32 runs ~1 GB/s, which for multi-MB batch
+# blobs costs more CPU than the socket itself; numpy word-sums verify the
+# same single-flip corruption class at memory bandwidth.  v2 frames are
+# only ever SENT to peers that negotiated a self-checking payload codec
+# (replay piggyback ``wire`` >= 2); every receiver accepts both versions.
+VERSION_DELEGATED = 2
+FRAME_VERSION_MAX = 2
 _PREFIX = struct.Struct(">2sBII")  # magic, version, header_len, blob_len
-_TRAILER = struct.Struct(">I")  # crc32(header + blob)
+_TRAILER = struct.Struct(">I")  # crc32(header + blob)  [v2: header only]
 PREFIX_BYTES = _PREFIX.size
 TRAILER_BYTES = _TRAILER.size
 # 64 MiB; per-plane knob: Config.serve_net_max_frame_mb /
 # Config.replay_net_max_frame_mb
 DEFAULT_MAX_FRAME = 64 << 20
+
+# Registered wire-codec versions per payload family.  The frame envelope
+# ("frame") is the struct above; payload codecs layered on top of the blob
+# (the replay plane's batch codec) register here so the wire-drift analyzer
+# (analysis/wirecheck.py) can hold every plane's protocol table to the ONE
+# version the framing layer ships.  Bumping a payload codec means bumping
+# it here AND in the owning protocol module — the analyzer fails the build
+# when they drift apart.
+CODECS: Dict[str, int] = {
+    "frame": FRAME_VERSION_MAX,
+    "replay_batch": 2,  # replay/net/protocol.py WIRE_CODEC_MAX
+}
+
+# one sendmsg accepts at most this many iovec entries (Linux UIO_MAXIOV is
+# 1024; staying under it keeps the vectored path single-syscall per chunk
+# without probing sysconf on every send)
+_IOV_MAX = 1024
+
+# buffers acceptable on the zero-copy send path: anything exposing the
+# buffer protocol contiguously (bytes, bytearray, memoryview, numpy .data)
+Buffer = Union[bytes, bytearray, memoryview]
 
 
 class FrameError(RuntimeError):
@@ -90,15 +123,17 @@ def encode_frame(header: Dict[str, Any], blob: bytes = b"") -> bytes:
     ))
 
 
-def _check_prefix(prefix: bytes, max_frame_bytes: int) -> Tuple[int, int]:
+def _check_prefix(prefix: bytes,
+                  max_frame_bytes: int) -> Tuple[int, int, int]:
     magic, version, header_len, blob_len = _PREFIX.unpack(prefix)
     if magic != MAGIC:
         raise FrameProtocol(
             f"bad frame magic {magic!r} (expected {MAGIC!r}): peer is not "
             "speaking the netcore frame protocol")
-    if version != VERSION:
+    if not VERSION <= version <= FRAME_VERSION_MAX:
         raise FrameProtocol(
-            f"frame protocol version {version} != supported {VERSION}")
+            f"frame protocol version {version} not in supported range "
+            f"[{VERSION}, {FRAME_VERSION_MAX}]")
     total = header_len + blob_len
     if total > max_frame_bytes:
         raise FrameTooLarge(
@@ -107,12 +142,13 @@ def _check_prefix(prefix: bytes, max_frame_bytes: int) -> Tuple[int, int]:
             "before allocation; raise this transport's max-frame knob "
             "(serve_net_max_frame_mb / replay_net_max_frame_mb) if this "
             "peer's payloads are legitimately this large")
-    return header_len, blob_len
+    return version, header_len, blob_len
 
 
-def _decode_body(body: bytes, header_len: int,
-                 crc: int) -> Tuple[Dict[str, Any], bytes]:
-    if (zlib.crc32(body) & 0xFFFFFFFF) != crc:
+def _decode_body(body: bytes, header_len: int, crc: int,
+                 version: int = VERSION) -> Tuple[Dict[str, Any], bytes]:
+    covered = body if version < VERSION_DELEGATED else body[:header_len]
+    if (zlib.crc32(covered) & 0xFFFFFFFF) != crc:
         raise FrameCorrupt(
             "frame checksum mismatch: header/blob bytes were damaged in "
             "flight (dropping the connection — stream state is unrecoverable)")
@@ -142,7 +178,7 @@ class FrameReader:
         while True:
             if len(self._buf) < PREFIX_BYTES:
                 return out
-            header_len, blob_len = _check_prefix(
+            version, header_len, blob_len = _check_prefix(
                 bytes(self._buf[:PREFIX_BYTES]), self.max_frame_bytes)
             need = PREFIX_BYTES + header_len + blob_len + TRAILER_BYTES
             if len(self._buf) < need:
@@ -150,7 +186,7 @@ class FrameReader:
             body = self._buf[PREFIX_BYTES:need - TRAILER_BYTES]
             (crc,) = _TRAILER.unpack(
                 bytes(self._buf[need - TRAILER_BYTES:need]))
-            out.append(_decode_body(bytes(body), header_len, crc))
+            out.append(_decode_body(bytes(body), header_len, crc, version))
             del self._buf[:need]
 
     def pending_bytes(self) -> int:
@@ -181,12 +217,12 @@ def recv_frame(sock, max_frame_bytes: int = DEFAULT_MAX_FRAME
     prefix = recv_exact(sock, PREFIX_BYTES)
     if prefix is None:
         return None
-    header_len, blob_len = _check_prefix(prefix, max_frame_bytes)
+    version, header_len, blob_len = _check_prefix(prefix, max_frame_bytes)
     body = recv_exact(sock, header_len + blob_len + TRAILER_BYTES)
     if body is None:
         raise FrameTruncated("stream ended after the frame prefix")
     (crc,) = _TRAILER.unpack(body[-TRAILER_BYTES:])
-    return _decode_body(body[:-TRAILER_BYTES], header_len, crc)
+    return _decode_body(body[:-TRAILER_BYTES], header_len, crc, version)
 
 
 def send_frame(sock, header: Dict[str, Any], blob: bytes = b"") -> int:
@@ -195,6 +231,197 @@ def send_frame(sock, header: Dict[str, Any], blob: bytes = b"") -> int:
     data = encode_frame(header, blob)
     sock.sendall(data)
     return len(data)
+
+
+# ------------------------------------------------- zero-copy vectored frames
+def ndarray_view(arr: np.ndarray) -> memoryview:
+    """A flat byte view of ``arr`` WITHOUT copying (the `arr.tobytes()` in
+    `encode_ndarray` is one of the copies the vectored path exists to kill).
+    Non-contiguous input is materialised once — the only copy this path
+    ever makes, and replay columns are contiguous ring slices already."""
+    if not arr.flags["C_CONTIGUOUS"]:
+        arr = np.ascontiguousarray(arr)
+    return memoryview(arr).cast("B")
+
+
+def encode_frame_views(header: Dict[str, Any],
+                       blobs: Sequence[Buffer] = (),
+                       crc_blob: bool = True) -> Tuple[List[Buffer], int]:
+    """The iovec form of `encode_frame`: returns ``(buffers, total_bytes)``
+    where ``buffers`` is the ordered chain
+
+        prefix | header-json | *blobs | crc-trailer
+
+    with the caller's blob buffers referenced, NOT copied — the CRC is
+    accumulated incrementally over each view.  Feed the chain to
+    `send_frame_views` or join it for transports without scatter-gather.
+
+    ``crc_blob=False`` emits a VERSION_DELEGATED (v2) frame whose trailer
+    CRC covers the header only: use it ONLY when the blob's payload codec
+    carries its own integrity (the replay batch codec's per-column
+    ``sum64``), and only to peers that negotiated it — crc32 at ~1 GB/s
+    over a multi-MB batch otherwise costs more than the socket itself."""
+    hdr = json.dumps(header, allow_nan=False,
+                     separators=(",", ":")).encode("utf-8")
+    blob_len = 0
+    crc = zlib.crc32(hdr)
+    views: List[Buffer] = []
+    for b in blobs:
+        if isinstance(b, bytes):
+            v: Buffer = b
+            n = len(b)
+        else:
+            mv = b if isinstance(b, memoryview) else memoryview(b)
+            # flat byte view so downstream byte-offset slicing (partial
+            # sendmsg resume) is exact regardless of the source itemsize
+            v = mv if mv.format == "B" and mv.ndim == 1 else mv.cast("B")
+            n = v.nbytes
+        if n == 0:
+            continue
+        blob_len += n
+        if crc_blob:
+            crc = zlib.crc32(v, crc)
+        views.append(v)
+    version = VERSION if crc_blob else VERSION_DELEGATED
+    chain: List[Buffer] = [_PREFIX.pack(MAGIC, version, len(hdr), blob_len),
+                           hdr]
+    chain.extend(views)
+    chain.append(_TRAILER.pack(crc & 0xFFFFFFFF))
+    return chain, PREFIX_BYTES + len(hdr) + blob_len + TRAILER_BYTES
+
+
+def word_sum64(buf: Buffer) -> int:
+    """Order-sensitive-enough payload checksum at memory bandwidth: the
+    u64 little-endian word sum (mod 2**64) of ``buf``, tail bytes folded
+    in as one final little-endian word.  Any single-byte flip perturbs
+    exactly one term, so it is ALWAYS detected; numpy sums ~20x faster
+    than crc32, which is what lets v2 frames skip the blob CRC without
+    giving up the chaos-plane corruption guarantees."""
+    mv = buf if isinstance(buf, memoryview) else memoryview(buf)
+    if mv.format != "B" or mv.ndim != 1:
+        mv = mv.cast("B")
+    n = mv.nbytes
+    words = n >> 3
+    total = 0
+    if words:
+        total = int(np.frombuffer(mv[:words << 3], dtype="<u8")
+                    .sum(dtype=np.uint64))
+    tail = n - (words << 3)
+    if tail:
+        total += int.from_bytes(mv[n - tail:], "little")
+    return total & 0xFFFFFFFFFFFFFFFF
+
+
+def sendmsg_all(sock, buffers: Sequence[Buffer], total: int) -> int:
+    """Flush an iovec chain with ``sock.sendmsg``, resuming after partial
+    sends mid-iovec (the kernel may accept any byte count; we re-slice the
+    chain from the first unsent byte and go again).  Falls back to one
+    join + sendall when the socket lacks sendmsg (test doubles, wrapped
+    transports)."""
+    sendmsg = getattr(sock, "sendmsg", None)
+    if sendmsg is None:
+        sock.sendall(b"".join(bytes(b) if isinstance(b, memoryview) else b
+                              for b in buffers))
+        return total
+    pending: List[Buffer] = [b for b in buffers if len(b) > 0]
+    sent = 0
+    while pending:
+        n = sendmsg(pending[:_IOV_MAX])
+        if n <= 0:
+            raise FrameTruncated(
+                "sendmsg wrote 0 bytes mid-frame (peer closed the stream "
+                "with a frame half-sent)")
+        sent += n
+        # drop fully-sent buffers; re-slice the first partial one
+        while pending and n > 0:
+            head = pending[0]
+            size = len(head) if isinstance(head, bytes) else head.nbytes
+            if n >= size:
+                n -= size
+                pending.pop(0)
+            else:
+                pending[0] = memoryview(head)[n:]
+                n = 0
+    if sent != total:
+        raise FrameTruncated(
+            f"vectored send wrote {sent} bytes, frame is {total}")
+    return sent
+
+
+def send_frame_views(sock, header: Dict[str, Any],
+                     blobs: Sequence[Buffer] = (),
+                     crc_blob: bool = True) -> int:
+    """Zero-copy `send_frame`: scatter-gather the header + blob views out
+    in-place via sendmsg.  Same caller-locks-the-writer contract as
+    `send_frame`; returns bytes written.  ``crc_blob=False`` emits a v2
+    delegated-integrity frame (see `encode_frame_views`)."""
+    chain, total = encode_frame_views(header, blobs, crc_blob=crc_blob)
+    return sendmsg_all(sock, chain, total)
+
+
+def recv_exact_into(sock, view: memoryview) -> int:
+    """Fill ``view`` completely from a blocking socket via ``recv_into``
+    (no chunk list, no join — the single-allocation receive path).  Returns
+    0 on clean EOF with ZERO bytes read, the view's length when filled;
+    raises `FrameTruncated` on EOF mid-read."""
+    need = view.nbytes
+    got = 0
+    recv_into = getattr(sock, "recv_into", None)
+    while got < need:
+        if recv_into is not None:
+            n = recv_into(view[got:], need - got)
+            if not n:
+                chunk = b""
+            else:
+                got += n
+                continue
+        else:  # pragma: no cover - exercised via test doubles
+            chunk = sock.recv(min(need - got, 1 << 16))
+            if chunk:
+                view[got:got + len(chunk)] = chunk
+                got += len(chunk)
+                continue
+        if got == 0:
+            return 0
+        raise FrameTruncated(
+            f"stream ended {need - got} bytes short mid-frame (peer died "
+            "with a frame half-sent)")
+    return got
+
+
+def recv_frame_view(sock, max_frame_bytes: int = DEFAULT_MAX_FRAME
+                    ) -> Optional[Tuple[Dict[str, Any], memoryview]]:
+    """Blocking read of one frame into ONE fresh buffer; the returned blob
+    is a read-only memoryview of that buffer (decode arrays from it with
+    `decode_ndarray` / the batch codec without further copies).  None on
+    clean EOF at a frame boundary.  Unlike `FrameReader`, the backing
+    buffer is per-frame and owned by the returned view, so holding the
+    view never pins a shared receive buffer."""
+    prefix = bytearray(PREFIX_BYTES)
+    if recv_exact_into(sock, memoryview(prefix)) == 0:
+        return None
+    version, header_len, blob_len = _check_prefix(
+        bytes(prefix), max_frame_bytes)
+    body = bytearray(header_len + blob_len + TRAILER_BYTES)
+    mv = memoryview(body)
+    if recv_exact_into(sock, mv) == 0:
+        raise FrameTruncated("stream ended after the frame prefix")
+    (crc,) = _TRAILER.unpack(mv[-TRAILER_BYTES:])
+    payload = mv[:-TRAILER_BYTES]
+    covered = payload if version < VERSION_DELEGATED \
+        else payload[:header_len]
+    if (zlib.crc32(covered) & 0xFFFFFFFF) != crc:
+        raise FrameCorrupt(
+            "frame checksum mismatch: header/blob bytes were damaged in "
+            "flight (dropping the connection — stream state is unrecoverable)")
+    try:
+        header = json.loads(bytes(payload[:header_len]).decode("utf-8"))
+    except (UnicodeDecodeError, ValueError) as e:
+        raise FrameCorrupt(f"frame header is not strict JSON: {e}")
+    if not isinstance(header, dict):
+        raise FrameCorrupt(
+            f"frame header is {type(header).__name__}, expected object")
+    return header, payload[header_len:].toreadonly()
 
 
 # ------------------------------------------------------------ ndarray codec

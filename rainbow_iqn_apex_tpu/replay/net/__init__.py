@@ -18,6 +18,7 @@ _EXPORTS = {
     "client": "rainbow_iqn_apex_tpu.replay.net",
     "server": "rainbow_iqn_apex_tpu.replay.net",
     "plane": "rainbow_iqn_apex_tpu.replay.net",
+    "shm": "rainbow_iqn_apex_tpu.replay.net",
     "ReplayNetError": "rainbow_iqn_apex_tpu.replay.net.protocol",
     "PeerDead": "rainbow_iqn_apex_tpu.replay.net.protocol",
     "ReplayShardServer": "rainbow_iqn_apex_tpu.replay.net.server",
@@ -29,7 +30,7 @@ _EXPORTS = {
 
 __all__ = sorted(_EXPORTS)
 
-_SUBMODULES = ("protocol", "client", "server", "plane")
+_SUBMODULES = ("protocol", "client", "server", "plane", "shm")
 
 
 def __getattr__(name: str):
@@ -53,6 +54,7 @@ if TYPE_CHECKING:  # static analyzers see the eager imports
         plane,
         protocol,
         server,
+        shm,
     )
     from rainbow_iqn_apex_tpu.replay.net.client import (  # noqa: F401
         AppendClient,

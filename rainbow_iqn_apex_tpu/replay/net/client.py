@@ -33,6 +33,7 @@ learner-side gathers are plain numpy under ``hostsync`` discipline
 from __future__ import annotations
 
 import collections
+import os
 import socket
 import threading
 import time
@@ -42,7 +43,7 @@ import numpy as np
 
 from rainbow_iqn_apex_tpu.netcore import chaos, framing
 from rainbow_iqn_apex_tpu.replay.buffer import SampledBatch
-from rainbow_iqn_apex_tpu.replay.net import protocol
+from rainbow_iqn_apex_tpu.replay.net import protocol, shm
 from rainbow_iqn_apex_tpu.replay.net.protocol import PeerDead
 from rainbow_iqn_apex_tpu.utils import hostsync
 from rainbow_iqn_apex_tpu.utils.faults import RetryPolicy
@@ -50,14 +51,16 @@ from rainbow_iqn_apex_tpu.utils.faults import RetryPolicy
 
 class _Pending:
     """One in-flight request: settled by the reader thread with the reply
-    (header, blob) or an error."""
+    (header, blob) or an error.  ``blob`` is a read-only memoryview over
+    the reply frame's own receive buffer (`recv_frame_view`) — decode
+    paths view it zero-copy; nothing retains it past decode."""
 
     __slots__ = ("event", "header", "blob", "error")
 
     def __init__(self):
         self.event = threading.Event()
         self.header: Optional[Dict[str, Any]] = None
-        self.blob: bytes = b""
+        self.blob: Any = b""
         self.error: Optional[BaseException] = None
 
 
@@ -75,6 +78,7 @@ class ReplayPeer:
                  probe_timeout_s: float = 0.5,
                  ack_timeout_s: float = 10.0,
                  max_frame_bytes: int = framing.DEFAULT_MAX_FRAME,
+                 local_fastpath: bool = True,
                  logger=None, obs_registry=None, connect: bool = True):
         self.host = str(host)
         self.port = int(port)
@@ -95,6 +99,17 @@ class ReplayPeer:
         self.shard_base = 0
         self.shards = 0
         self.capacity = 0
+        # negotiated batch wire codec: 1 until the server's piggyback
+        # advertises better — an old server never sees v2 fields
+        self.wire_codec = 1
+        # same-host fast path (replay/net/shm.py): when the server is
+        # colocated, the dial goes over AF_UNIX and sample batches arrive
+        # in a shared-memory arena instead of through the socket.  The
+        # arena is PER-CONNECTION — a reconnect drops it (and any offsets
+        # queued for return) and negotiates a fresh one.
+        self.local_fastpath = bool(local_fastpath)
+        self.arena: Optional[shm.ClientArena] = None
+        self._shm_free: List[int] = []  # consumed slots to return
         # counters (the plane's periodic `replay_net` stats row)
         self.bytes_sent = 0
         self.bytes_recv = 0
@@ -131,6 +146,47 @@ class ReplayPeer:
         if self.obs_registry is not None:
             self.obs_registry.counter(name, "replay_net").inc(n)
 
+    def _dial_unix(self, timeout: float
+                   ) -> Tuple[socket.socket, Optional[shm.ClientArena]]:
+        """Dial the server's abstract AF_UNIX socket and run the shm
+        preamble: request an arena, map the memfd the hello carries (via
+        SCM_RIGHTS).  Raises OSError on ANY miss — the caller falls back
+        to the TCP dial, which is always correct, just slower."""
+        sock = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+        fds: List[int] = []
+        try:
+            sock.settimeout(timeout)
+            sock.connect(shm.unix_path(self.port))
+            sock.sendall(shm.pack_request(True))
+            buf = b""
+            while len(buf) < shm.PREAMBLE_BYTES:
+                data, newfds, _flags, _addr = socket.recv_fds(
+                    sock, shm.PREAMBLE_BYTES - len(buf), 4)
+                fds.extend(newfds)
+                if not data:
+                    raise OSError("peer closed during shm hello")
+                buf += data
+            nbytes = shm.parse_hello(buf)
+            if nbytes is None:
+                raise OSError("unrecognized shm hello")
+            arena = None
+            if nbytes > 0 and fds:
+                arena = shm.ClientArena.from_fd(fds.pop(0), nbytes)
+            sock.settimeout(None)
+            return sock, arena
+        except (OSError, ValueError):
+            try:
+                sock.close()
+            except OSError:
+                pass
+            raise
+        finally:
+            for fd in fds:  # any extras a confused peer attached
+                try:
+                    os.close(fd)
+                except OSError:
+                    pass
+
     def connect(self, timeout_s: Optional[float] = None) -> bool:
         """One bounded dial attempt; True when a connection is live."""
         with self._lock:
@@ -138,13 +194,21 @@ class ReplayPeer:
                 return False
             if self._sock is not None:
                 return True
+        timeout = (self.probe_timeout_s if timeout_s is None
+                   else timeout_s)
+        sock = arena = None
+        if (self.local_fastpath and shm.available()
+                and self.host in shm.LOCAL_HOSTS):
+            try:
+                sock, arena = self._dial_unix(timeout)
+            except (OSError, ValueError):
+                sock = arena = None  # no unix listener / old server: TCP
         try:
-            sock = socket.create_connection(
-                (self.host, self.port),
-                timeout=self.probe_timeout_s if timeout_s is None
-                else timeout_s)
-            sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
-            sock.settimeout(None)  # reader blocks; writes are sendall
+            if sock is None:
+                sock = socket.create_connection((self.host, self.port),
+                                                timeout=timeout)
+                sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+                sock.settimeout(None)  # reader blocks; writes are sendall
             sock = chaos.maybe_wrap(sock, peer=f"replay{self.peer_id}",
                                     logger=self.logger)
         except OSError:
@@ -159,6 +223,8 @@ class ReplayPeer:
                 sock.close()
                 return False
             self._sock = sock
+            self.arena = arena
+            self._shm_free = []
             self._gen += 1
             gen = self._gen
             self._fail_streak = 0
@@ -198,6 +264,11 @@ class ReplayPeer:
             if gen != self._gen or self._sock is not sock:
                 return  # an older generation already replaced
             self._sock = None
+            # the arena died with the connection server-side; outstanding
+            # zero-copy views keep the client mapping alive until GC, and
+            # queued frees are moot (the next conn gets a FRESH arena)
+            self.arena = None
+            self._shm_free = []
             pending, self._pending = self._pending, {}
             self._next_dial = time.monotonic()  # first re-dial is immediate
         try:
@@ -235,13 +306,35 @@ class ReplayPeer:
         if sock is not None:
             self._drop(sock, gen, why)
 
+    # ------------------------------------------------------------ shm slots
+    def shm_release(self, off: int, arena: shm.ClientArena) -> None:
+        """Queue one consumed arena offset for return to the server on the
+        next sample request.  ``arena`` is the mapping the offset belongs
+        to — a stale release (the connection reconnected underneath) is
+        silently dropped rather than poisoning the NEW arena's free list."""
+        with self._lock:
+            if self.arena is arena:
+                self._shm_free.append(off)
+
+    def take_shm_frees(self) -> List[int]:
+        with self._lock:
+            if not self._shm_free:
+                return []
+            out, self._shm_free = self._shm_free, []
+            return out
+
     # ---------------------------------------------------------- frame I/O
     def _send(self, sock: socket.socket, gen: int,
-              header: Dict[str, Any], blob: bytes = b"") -> None:
+              header: Dict[str, Any], blob: Any = b"") -> None:
+        """``blob`` is bytes or a LIST of buffers — the latter ships
+        zero-copy through the vectored sendmsg path."""
+        buffers = blob if isinstance(blob, list) else ([blob] if blob
+                                                       else [])
         try:
             with self._wlock:
-                self.bytes_sent += framing.send_frame(sock, header, blob)
-        except OSError as e:
+                self.bytes_sent += framing.send_frame_views(sock, header,
+                                                            buffers)
+        except (OSError, framing.FrameError) as e:
             self._drop(sock, gen, f"send failed: {e}")
             raise PeerDead(
                 f"replay server {self.peer} unreachable mid-send: "
@@ -250,7 +343,9 @@ class ReplayPeer:
     def _read_loop(self, sock: socket.socket, gen: int) -> None:
         while True:
             try:
-                frame = framing.recv_frame(sock, self.max_frame_bytes)
+                # one allocation per frame; the blob memoryview hands
+                # zero-copy array views to the batch decoder
+                frame = framing.recv_frame_view(sock, self.max_frame_bytes)
             except (OSError, framing.FrameError) as e:
                 self._drop(sock, gen, f"{type(e).__name__}: {e}")
                 return
@@ -281,6 +376,9 @@ class ReplayPeer:
             self.shards = int(header["shards"])
         if "capacity" in header:
             self.capacity = int(header["capacity"])
+        if "wire" in header:
+            self.wire_codec = min(int(header["wire"]),
+                                  protocol.WIRE_CODEC_MAX)
 
     def slot_range(self) -> Tuple[int, int]:
         """The GLOBAL slot-id interval this peer's shard block owns (for
@@ -303,7 +401,7 @@ class ReplayPeer:
 
     # ------------------------------------------------------------- requests
     def start_request(self, header: Dict[str, Any],
-                      blob: bytes = b"") -> _Pending:
+                      blob: Any = b"") -> _Pending:
         """Send one request; the returned pending settles with the reply (or
         `PeerDead` the moment the connection dies)."""
         if not self._ensure_connected():
@@ -361,6 +459,7 @@ class ReplayPeer:
     def stats(self) -> Dict[str, Any]:
         return {"peer": self.peer, "server": self.peer_id,
                 "connected": self.connected(), "rtt_ms": self.rtt_ms,
+                "shm": self.arena is not None,
                 "reconnects": self.reconnects,
                 "probe_timeouts": self.probe_timeouts,
                 "bytes_sent": self.bytes_sent,
@@ -482,7 +581,7 @@ class AppendClient:
                 self._spool.appendleft(tick)
 
     def _encode_block(self, block: List[tuple]
-                      ) -> Tuple[Dict[str, Any], bytes]:
+                      ) -> Tuple[Dict[str, Any], List[Any]]:
         arrays = {
             "frames": np.stack([t[0] for t in block]),
             "actions": np.stack([t[1] for t in block]),
@@ -493,7 +592,9 @@ class AppendClient:
             arrays["priorities"] = np.stack([t[4] for t in block])
         if block[0][5] is not None:
             arrays["truncations"] = np.stack([t[5] for t in block])
-        metas, blob = protocol.encode_arrays(arrays)
+        # views over the freshly stacked arrays: start_request sends
+        # synchronously, so their lifetime outlives the write
+        metas, blob = protocol.encode_arrays_views(arrays)
         header: Dict[str, Any] = {"op": "append", "ticks": len(block),
                                   "arrays": metas}
         if self.peer.epoch is not None:
@@ -599,11 +700,29 @@ class SampleClient:
     def __init__(self, peers: Dict[int, ReplayPeer], batch_size: int,
                  beta_fn: Callable[[], float], depth: int = 2,
                  wb_inflight: int = 4, seed: int = 0,
+                 depth_min: int = 1, depth_max: int = 8,
+                 sample_many: int = 4, shm_hold: int = 2,
                  logger=None, obs_registry=None):
         self.peers = dict(peers)
         self.batch_size = int(batch_size)
         self.beta_fn = beta_fn
-        self.depth = max(int(depth), 1)
+        # adaptive pipeline: ``depth`` (in BATCHES, in-flight + decoded
+        # unconsumed) starts at the configured value and then tracks
+        # measured RTT vs the consumer's drain interval — roughly
+        # ceil(rtt/gap)+1 batches keep the learner fed without parking
+        # depth_max batches of staleness when the link is fast
+        self.depth_min = max(int(depth_min), 1)
+        self.depth_max = max(int(depth_max), self.depth_min)
+        self.depth = min(max(int(depth), self.depth_min), self.depth_max)
+        # batches per sample RPC once the peer negotiates codec v2
+        # ("sample_many"): amortizes header + syscall + queue-wait costs
+        self.sample_many = max(int(sample_many), 1)
+        # shm slot hold window: a zero-copy arena batch's slot is returned
+        # to the server ``shm_hold`` get() calls AFTER the learner took it
+        # — by then the learner's device transfer is long done, so the
+        # server can never overwrite pages a live view still reads
+        self.shm_hold = max(int(shm_hold), 1)
+        self._hold: "collections.deque" = collections.deque()
         self.wb_inflight = max(int(wb_inflight), 1)
         self.logger = logger
         self.obs_registry = obs_registry
@@ -617,7 +736,15 @@ class SampleClient:
         self._stop = threading.Event()
         self._ready: "collections.deque" = collections.deque()
         self._ready_sem = threading.Semaphore(0)
-        self._space = threading.Semaphore(self.depth)
+        # permits count BATCHES; sized at the adaptive CEILING — the live
+        # bound is self.depth, enforced by the top-up loop, the semaphore
+        # is the hard backstop get() releases into
+        self._space = threading.Semaphore(self.depth_max)
+        # EWMAs feeding the adaptive depth (under _lock: written by the
+        # run thread / the learner's get(), read by both + stats)
+        self._rtt_s: Optional[float] = None
+        self._gap_s: Optional[float] = None
+        self._last_get: Optional[float] = None
         self._probe_unknown_at = 0.0  # next not-yet-sampleable peer probe
         # write-back channel state (learner thread only)
         self._wb_pending: List[Tuple[ReplayPeer, _Pending]] = []
@@ -694,37 +821,69 @@ class SampleClient:
         return peers[int(self.rng.choice(len(peers),
                                          p=masses / masses.sum()))]
 
+    def _update_depth(self) -> None:
+        """Re-target the pipeline depth from the RTT and consumption-gap
+        EWMAs: just enough batches in flight to cover one round trip plus
+        one being consumed, clamped to [depth_min, depth_max]."""
+        with self._lock:
+            rtt, gap = self._rtt_s, self._gap_s
+            if rtt is None or gap is None:
+                return
+            want = int(np.ceil(rtt / max(gap, 1e-4))) + 1
+            self.depth = min(max(want, self.depth_min), self.depth_max)
+
     def _run(self) -> None:
-        inflight: List[Tuple[ReplayPeer, _Pending]] = []
+        # (peer, pending, batches requested, send stamp)
+        inflight: List[Tuple[ReplayPeer, _Pending, int, float]] = []
         while not self._stop.is_set():
-            # top up the pipeline to depth (each slot gated by _space so
-            # decoded-but-unconsumed batches bound the in-flight window)
-            while len(inflight) < self.depth and self._space.acquire(
-                    blocking=False):
+            # top up the pipeline to the (adaptive) depth in BATCHES; each
+            # batch holds one _space permit so decoded-but-unconsumed
+            # batches bound the window too
+            while sum(e[2] for e in inflight) < self.depth:
                 peer = self._pick_peer()
                 if peer is None:
-                    self._space.release()
                     time.sleep(0.05)
                     break
+                want = self.depth - sum(e[2] for e in inflight)
+                n = (min(self.sample_many, max(want, 1))
+                     if peer.wire_codec >= 2 else 1)
+                got = 0
+                while got < n and self._space.acquire(blocking=False):
+                    got += 1
+                if got == 0:
+                    break  # window full of unconsumed batches
+                req: Dict[str, Any] = {"op": "sample",
+                                       "batch": self.batch_size,
+                                       "beta": float(self.beta_fn())}
+                if peer.wire_codec >= 2:
+                    # negotiated: the server pre-assembles `got` batches
+                    # into ONE compact-codec reply (sample_many)
+                    req["codec"] = 2
+                    req["n"] = got
+                freed = peer.take_shm_frees()
+                if freed:
+                    # consumed arena slots ride back on the request the
+                    # peer was getting anyway (shm.py's deferred-free leg)
+                    req["free"] = freed
                 try:
-                    p = peer.start_request(
-                        {"op": "sample", "batch": self.batch_size,
-                         "beta": float(self.beta_fn())})
+                    p = peer.start_request(req)
                 except PeerDead:
-                    self._space.release()
+                    for _ in range(got):
+                        self._space.release()
                     continue
-                inflight.append((peer, p))
+                inflight.append((peer, p, got, time.monotonic()))
             if not inflight:
                 time.sleep(0.01)
                 continue
-            peer, p = inflight.pop(0)
+            peer, p, n, t0 = inflight.pop(0)
             try:
                 header, blob = peer.wait(p)
             except (protocol.ReplayNetError, ValueError, TimeoutError) as e:
-                # dead peer / empty server / wedge: release the slot and
+                # dead peer / empty server / wedge: release the slots and
                 # re-route the next request to the survivors
                 self.rerouted += 1
-                self._space.release()
+                for _ in range(n):
+                    self._space.release()
                 if isinstance(e, TimeoutError):
                     # a TIMED-OUT request means the link is wedged (one-way
                     # partition, hung server) — typed errors settle fast,
@@ -734,35 +893,87 @@ class SampleClient:
                     # request re-dials a fresh socket.
                     peer.kick()
                 continue
-            try:
-                batch = self._decode_batch(header, blob)
-            except framing.FrameError:
-                self._space.release()
-                continue
+            rtt = time.monotonic() - t0
             with self._lock:
-                self._ready.append(batch)
-            self._ready_sem.release()
+                self._rtt_s = (rtt if self._rtt_s is None
+                               else 0.8 * self._rtt_s + 0.2 * rtt)
+            try:
+                batches = self._decode_reply(peer, header, blob)
+            except framing.FrameError:
+                for _ in range(n):
+                    self._space.release()
+                continue
+            # a still-warming server may answer with fewer batches than
+            # asked — hand their permits back
+            for _ in range(max(n - len(batches), 0)):
+                self._space.release()
+            with self._lock:
+                self._ready.extend(batches)
+            for _ in range(len(batches)):
+                self._ready_sem.release()
+            self._update_depth()
         # drain: settle nothing further, slots die with the thread
 
-    def _decode_batch(self, header: Dict[str, Any],
-                      blob: bytes) -> SampledBatch:
+    def _decode_reply(self, peer: ReplayPeer, header: Dict[str, Any],
+                      blob: Any) -> List[Tuple[SampledBatch, Any]]:
+        """Decode one batch reply — v1 single batch, v2 sample_many, or
+        the shm form (batches in the peer's arena, the blob only carrying
+        any that fell back).  Returns ``(batch, hold)`` tuples: hold is
+        None for socket batches, else the ``(peer, arena, slot_off)``
+        ``get()`` must eventually hand to ``peer.shm_release``.
+        LEAN: columns stay read-only views over the reply frame's buffer
+        — or the arena mapping — (device staging only reads them); the ONE
+        retained column, ``idx`` (held by `WritebackRing` across its whole
+        ring depth), is decoded to an owned array so a pending write-back
+        never pins a multi-MB frame blob.  v2's transformed columns (u32
+        idx, fp16 weight/prob, palette discounts) decode owned by
+        construction."""
         with hostsync.sanctioned():  # wire gather: the frontier's contract
-            arrays = protocol.decode_arrays(header.get("arrays", ()), blob)
-            # copy out of the frame blob view: downstream (device staging,
-            # writeback) expects owned, writable host arrays
-            batch = SampledBatch(
-                idx=np.array(arrays["idx"], np.int64),
-                obs=np.array(arrays["obs"]),
-                action=np.array(arrays["action"]),
-                reward=np.array(arrays["reward"]),
-                next_obs=np.array(arrays["next_obs"]),
-                discount=np.array(arrays["discount"]),
-                weight=np.array(arrays["weight"], np.float32),
-                prob=(np.array(arrays["prob"])
-                      if "prob" in arrays else None))
-            self.batches_received += 1
-            self.rows_sampled += int(batch.idx.shape[0])
-        return batch
+            slot_of: List[Any] = []
+            arena = peer.arena
+            if int(header.get("codec", 1)) >= 2:
+                metas_list = header.get("batches", ())
+                slots = header.get("slots")
+                if slots and arena is not None:
+                    raws = []
+                    off = 0  # walk of the blob's fallback batches
+                    for metas, slot in zip(metas_list, slots):
+                        if slot is None:
+                            raws.append(protocol.decode_batch_v2(
+                                metas, blob, off))
+                            off += sum(int(m["nbytes"]) for m in metas)
+                            slot_of.append(None)
+                        else:
+                            # zero-copy: columns view the shared mapping
+                            raws.append(protocol.decode_batch_v2(
+                                metas, arena.view, int(slot)))
+                            slot_of.append(int(slot))
+                else:
+                    raws = protocol.decode_batches_v2(metas_list, blob)
+            else:
+                raws = [protocol.decode_arrays(header.get("arrays", ()),
+                                               blob)]
+            slot_of.extend([None] * (len(raws) - len(slot_of)))
+            out: List[Tuple[SampledBatch, Any]] = []
+            for arrays, slot in zip(raws, slot_of):
+                idx = np.asarray(arrays["idx"], np.int64)
+                if not idx.flags.owndata:
+                    idx = idx.copy()  # v1 view -> owned (see above)
+                batch = SampledBatch(
+                    idx=idx,
+                    obs=np.asarray(arrays["obs"]),
+                    action=np.asarray(arrays["action"]),
+                    reward=np.asarray(arrays["reward"]),
+                    next_obs=np.asarray(arrays["next_obs"]),
+                    discount=np.asarray(arrays["discount"]),
+                    weight=np.asarray(arrays["weight"], np.float32),
+                    prob=(np.asarray(arrays["prob"])
+                          if "prob" in arrays else None))
+                self.batches_received += 1
+                self.rows_sampled += int(batch.idx.shape[0])
+                out.append((batch, None if slot is None
+                            else (peer, arena, slot)))
+        return out
 
     def get(self, timeout: float = 60.0) -> SampledBatch:
         """Next pipelined batch (host arrays, GLOBAL indices).  Raises
@@ -773,8 +984,26 @@ class SampleClient:
                 f"no replay batch arrived for {timeout}s (all shard "
                 "servers dead, empty, or unreachable — see the "
                 "`replaynet:` section of obs_report)")
+        now = time.monotonic()
         with self._lock:
-            batch = self._ready.popleft()
+            batch, hold = self._ready.popleft()
+            # consumption-gap EWMA: the drain rate the adaptive depth
+            # paces against
+            if self._last_get is not None:
+                gap = now - self._last_get
+                self._gap_s = (gap if self._gap_s is None
+                               else 0.8 * self._gap_s + 0.2 * gap)
+            self._last_get = now
+            # shm: park this batch's arena slot in the hold window; slots
+            # older than ``shm_hold`` gets are queued for return (their
+            # views are long consumed by the time the server reuses them)
+            released = []
+            if hold is not None:
+                self._hold.append(hold)
+            while len(self._hold) > self.shm_hold:
+                released.append(self._hold.popleft())
+        for peer, arena, off in released:
+            peer.shm_release(off, arena)
         self._space.release()
         return batch
 
@@ -802,7 +1031,7 @@ class SampleClient:
                 if not m.any():
                     continue
                 routed |= m
-                metas, blob = protocol.encode_arrays(
+                metas, blob = protocol.encode_arrays_views(
                     {"idx": idx[m], "td": td[m]})
                 header: Dict[str, Any] = {"op": "update", "arrays": metas}
                 if peer.epoch is not None:
@@ -838,11 +1067,22 @@ class SampleClient:
 
     # ---------------------------------------------------------------- stats
     def stats(self) -> Dict[str, Any]:
+        with self._lock:
+            rtt, gap = self._rtt_s, self._gap_s
+            depth = self.depth
         return {"batches_received": self.batches_received,
                 "rows_sampled": self.rows_sampled,
                 "updates_sent": self.updates_sent,
                 "updates_dropped": self.updates_dropped,
                 "rerouted": self.rerouted,
+                "depth": depth,
+                "sample_many": self.sample_many,
+                "shm_peers": sum(1 for p in self._alive_peers()
+                                 if p.arena is not None),
+                "sample_rtt_ms": None if rtt is None else round(rtt * 1e3,
+                                                                3),
+                "consume_gap_ms": None if gap is None else round(gap * 1e3,
+                                                                 3),
                 "dead_peers": list(self.dead_peers()),
                 "peers": [p.stats() for p in self._alive_peers()]}
 

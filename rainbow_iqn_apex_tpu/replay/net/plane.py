@@ -109,6 +109,8 @@ class RemoteReplayPlane:
                 getattr(cfg, "replay_net_probe_timeout_s", 0.5)),
             max_frame_bytes=int(
                 getattr(cfg, "replay_net_max_frame_mb", 64)) << 20,
+            local_fastpath=bool(
+                getattr(cfg, "replay_net_local_fastpath", True)),
             logger=self.metrics, obs_registry=self.obs_registry)
 
     def discover(self) -> int:
@@ -182,6 +184,9 @@ class RemoteReplayPlane:
             depth=max(int(getattr(cfg, "sample_ahead_depth", 2)), 1),
             wb_inflight=max(int(getattr(cfg, "writeback_depth", 2)), 1),
             seed=int(getattr(cfg, "seed", 0)),
+            depth_min=int(getattr(cfg, "replay_net_depth_min", 1)),
+            depth_max=int(getattr(cfg, "replay_net_depth_max", 8)),
+            sample_many=int(getattr(cfg, "replay_net_sample_many", 4)),
             logger=self.metrics, obs_registry=self.obs_registry)
         if self.learner_epoch is not None:
             self.sampler.learner_epoch = self.learner_epoch
@@ -314,11 +319,23 @@ class RemoteReplayPlane:
             "shed_lanes": self.shed_lanes,
         }
         if self.sampler is not None:
+            ss = self.sampler.stats()
             row.update(batches=self.sampler.batches_received,
                        rows_sampled=self.sampler.rows_sampled,
                        updates_sent=self.sampler.updates_sent,
                        updates_dropped=self.sampler.updates_dropped,
-                       rerouted=self.sampler.rerouted)
+                       rerouted=self.sampler.rerouted,
+                       # wire-transport attribution (critical-path
+                       # analyzer): adaptive pipeline depth, negotiated
+                       # batches-per-RPC, and measured RPC latencies
+                       pipeline_depth=ss.get("depth"),
+                       sample_many=ss.get("sample_many"),
+                       sample_rtt_ms=ss.get("sample_rtt_ms"),
+                       consume_gap_ms=ss.get("consume_gap_ms"),
+                       wire_bytes_sent=sum(
+                           p.bytes_sent for p in self.peers.values()),
+                       wire_bytes_recv=sum(
+                           p.bytes_recv for p in self.peers.values()))
         if self._appenders:
             row.update(
                 spool_depth=sum(a.spool_depth()

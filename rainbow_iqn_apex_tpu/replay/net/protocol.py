@@ -40,11 +40,30 @@ this without the device runtime.
 
 from __future__ import annotations
 
-from typing import Any, Dict, List, Tuple
+import struct
+from typing import Any, Dict, List, Sequence, Tuple
 
 import numpy as np
 
 from rainbow_iqn_apex_tpu.netcore import framing
+
+# Request ops a replay shard server accepts (the reply vocabulary is
+# pong/ack/batch/stats_reply/rerr).  analysis/wirecheck.py holds the
+# server's dispatch table to exactly this tuple — adding an op here
+# without handling it (or vice versa) fails the build.
+OPS = ("ping", "append", "sample", "update", "snapshot", "stats")
+
+# Highest batch wire-codec this build speaks.  v1 is the PR-16 format
+# (encode_arrays: fp32/int64 columns, u32-length-prefixed blob chain);
+# v2 is the compact codec below (u32 indices, fp16 IS weights/probs,
+# palette-packed discounts, tight offset-addressed blob) plus the
+# ``n``-batches-per-RPC ``sample`` form ("sample_many").  Negotiated via
+# the piggyback ``wire`` field: a client never sends ``codec``/``n``
+# until the server advertises ``wire >= 2``, and a server answers with
+# the min of what was asked and what it speaks — old peers interop.
+# Registered in netcore.framing.CODECS["replay_batch"]; wirecheck
+# fails the build if the two constants drift.
+WIRE_CODEC_MAX = 2
 
 
 class ReplayNetError(RuntimeError):
@@ -99,7 +118,9 @@ def encode_arrays(arrays: Dict[str, np.ndarray]
 def decode_arrays(metas: List[Dict[str, Any]],
                   blob: bytes) -> Dict[str, np.ndarray]:
     """Inverse of `encode_arrays`.  Arrays VIEW the blob (read-only);
-    callers that mutate must copy."""
+    callers that mutate must copy.  Accepts a memoryview as ``blob`` —
+    the `recv_frame_view` path — in which case the views are zero-copy
+    all the way down to the socket's receive buffer."""
     raws = framing.unpack_blobs(blob)
     if len(raws) != len(metas):
         raise framing.FrameCorrupt(
@@ -107,3 +128,208 @@ def decode_arrays(metas: List[Dict[str, Any]],
             f"{len(raws)}")
     return {str(m["name"]): framing.decode_ndarray(m, raw)
             for m, raw in zip(metas, raws)}
+
+
+def encode_arrays_views(arrays: Dict[str, np.ndarray]
+                        ) -> Tuple[List[Dict[str, Any]], List[Any]]:
+    """Zero-copy twin of `encode_arrays`: same v1 wire bytes (u32-prefixed
+    chain, decodable by `decode_arrays` on any peer), but the arrays ride
+    as memoryviews for `framing.send_frame_views` instead of being copied
+    through ``tobytes`` + ``pack_blobs``."""
+    metas: List[Dict[str, Any]] = []
+    blobs: List[Any] = []
+    for name, arr in arrays.items():
+        arr = np.asarray(arr)
+        view = framing.ndarray_view(arr)
+        metas.append({"dtype": str(arr.dtype), "shape": list(arr.shape),
+                      "name": str(name)})
+        blobs.append(struct.pack(">I", view.nbytes))
+        blobs.append(view)
+    return metas, blobs
+
+
+# ------------------------------------------------------- compact codec (v2)
+#
+# One v2 column meta is {name, dtype, shape, enc, nbytes, [scale|palette]}:
+# ``dtype``/``shape`` describe the DECODED array, ``enc`` how its bytes are
+# packed on the wire, ``nbytes`` how many wire bytes it occupies — columns
+# are tightly concatenated in meta order (no per-column length prefixes;
+# offsets are implied), so the whole batch decodes by walking one buffer.
+#
+# Encodings (V2_ENCODINGS is the closed set; wirecheck holds the decoder
+# table to it):
+#   raw   verbatim bytes (uint8 obs/next_obs, actions, rewards — already
+#         minimal, and bit-faithfulness is the contract)
+#   u32   int64 slot indices as uint32 — EXACT (falls back to raw if any
+#         index overflows 32 bits; capacity*shards past 4Gi slots)
+#   f16   float as IEEE fp16 (values known to sit in fp16's sweet range)
+#   f16s  max-scaled fp16: wire carries value/scale at fp16 plus one f64
+#         ``scale`` in the meta — IS weights and probs keep < ~5e-4
+#         relative error regardless of their absolute magnitude
+#   pal1  <=2 distinct values, 1 bit per element + exact-value palette
+#         (discount columns are {0, gamma^n} almost always) — LOSSLESS
+#   pal8  <=256 distinct values, u8 index + palette — LOSSLESS
+V2_ENCODINGS = ("raw", "u32", "f16", "f16s", "pal1", "pal8")
+
+# columns eligible for lossy fp16 packing; everything else must survive
+# bit-faithfully (obs pixels, actions, rewards feed the loss directly)
+_F16_COLS = frozenset({"weight", "prob"})
+_PALETTE_COLS = frozenset({"discount"})
+_U32_COLS = frozenset({"idx"})
+
+
+def _enc_col(name: str, arr: np.ndarray) -> Tuple[Dict[str, Any], Any]:
+    arr = np.asarray(arr)
+    meta: Dict[str, Any] = {"name": str(name), "dtype": str(arr.dtype),
+                            "shape": list(arr.shape)}
+    if name in _U32_COLS and arr.dtype.kind in "iu" and arr.size:
+        lo, hi = int(arr.min()), int(arr.max())
+        if 0 <= lo and hi < (1 << 32):
+            wire = np.ascontiguousarray(arr, dtype=np.int64
+                                        ).astype(np.uint32)
+            meta.update(enc="u32", nbytes=wire.nbytes)
+            return meta, framing.ndarray_view(wire)
+    elif name in _PALETTE_COLS and arr.dtype.kind == "f" and arr.size:
+        palette = np.unique(arr)
+        if palette.size <= 2:
+            lut = np.searchsorted(palette, arr.ravel()).astype(np.uint8)
+            wire = np.packbits(lut)
+            meta.update(enc="pal1", nbytes=wire.nbytes,
+                        palette=[float(v) for v in palette])
+            return meta, framing.ndarray_view(wire)
+        if palette.size <= 256:
+            wire = np.searchsorted(palette, arr.ravel()).astype(np.uint8)
+            meta.update(enc="pal8", nbytes=wire.nbytes,
+                        palette=[float(v) for v in palette])
+            return meta, framing.ndarray_view(wire)
+    elif name in _F16_COLS and arr.dtype.kind == "f" and arr.size:
+        scale = float(np.max(np.abs(arr)))
+        if scale <= 0.0 or not np.isfinite(scale):
+            scale = 1.0
+        wire = (arr / scale).astype(np.float16)
+        meta.update(enc="f16s", nbytes=wire.nbytes, scale=scale)
+        return meta, framing.ndarray_view(wire)
+    view = framing.ndarray_view(np.ascontiguousarray(arr))
+    meta.update(enc="raw", nbytes=view.nbytes)
+    return meta, view
+
+
+def _dec_raw(meta, buf, dtype, shape):
+    return np.frombuffer(buf, dtype=dtype).reshape(shape)
+
+
+def _dec_u32(meta, buf, dtype, shape):
+    return np.frombuffer(buf, dtype=np.uint32).astype(dtype).reshape(shape)
+
+
+def _dec_f16(meta, buf, dtype, shape):
+    return np.frombuffer(buf, dtype=np.float16).astype(dtype).reshape(shape)
+
+
+def _dec_f16s(meta, buf, dtype, shape):
+    vals = np.frombuffer(buf, dtype=np.float16).astype(dtype)
+    return (vals * dtype.type(meta["scale"])).reshape(shape)
+
+
+def _dec_pal1(meta, buf, dtype, shape):
+    n = int(np.prod(shape, dtype=np.int64))
+    palette = np.asarray(meta["palette"], dtype=dtype)
+    if palette.size == 0:
+        return np.zeros(shape, dtype=dtype)
+    bits = np.unpackbits(np.frombuffer(buf, dtype=np.uint8), count=n)
+    return palette[np.minimum(bits, palette.size - 1)].reshape(shape)
+
+
+def _dec_pal8(meta, buf, dtype, shape):
+    palette = np.asarray(meta["palette"], dtype=dtype)
+    lut = np.frombuffer(buf, dtype=np.uint8)
+    if lut.size and palette.size and int(lut.max()) >= palette.size:
+        raise framing.FrameCorrupt(
+            f"pal8 column {meta.get('name')!r} indexes past its "
+            f"{palette.size}-entry palette")
+    return palette[lut].reshape(shape)
+
+
+_V2_DECODERS = {
+    "raw": _dec_raw,
+    "u32": _dec_u32,
+    "f16": _dec_f16,
+    "f16s": _dec_f16s,
+    "pal1": _dec_pal1,
+    "pal8": _dec_pal8,
+}
+
+
+def encode_batch_v2(arrays: Dict[str, np.ndarray], sums: bool = True
+                    ) -> Tuple[List[Dict[str, Any]], List[Any]]:
+    """(metas, wire buffers) for one sampled batch under codec v2.  The
+    buffers concatenate into the frame blob with no interleaved framing;
+    feed them straight to `framing.send_frame_views` with
+    ``crc_blob=False``: every meta carries the column's `word_sum64`, so
+    the batch checks its own integrity (verified at decode) and the frame
+    envelope skips the ~1 GB/s blob CRC that would otherwise dominate the
+    wire path's CPU.  ``sums=False`` omits the stamps — for batches that
+    never traverse a wire (the same-host shared-memory arena, shm.py)."""
+    metas: List[Dict[str, Any]] = []
+    buffers: List[Any] = []
+    for name, arr in arrays.items():
+        meta, buf = _enc_col(name, arr)
+        if sums:
+            meta["sum64"] = framing.word_sum64(buf)
+        metas.append(meta)
+        buffers.append(buf)
+    return metas, buffers
+
+
+def decode_batch_v2(metas: Sequence[Dict[str, Any]], blob,
+                    offset: int = 0) -> Dict[str, np.ndarray]:
+    """Inverse of `encode_batch_v2` over ``blob[offset:]``.  ``raw``
+    columns VIEW the blob (read-only — pass a memoryview to stay
+    zero-copy); transformed columns (u32/f16*/pal*) decode into small
+    OWNED arrays, so holding e.g. ``idx`` never pins the frame buffer."""
+    out: Dict[str, np.ndarray] = {}
+    off = int(offset)
+    total = len(blob)
+    for meta in metas:
+        enc = str(meta.get("enc", "raw"))
+        dec = _V2_DECODERS.get(enc)
+        if dec is None:
+            raise framing.FrameCorrupt(
+                f"batch column {meta.get('name')!r} uses unknown encoding "
+                f"{enc!r} (peer speaks a newer codec than it negotiated)")
+        nbytes = int(meta["nbytes"])
+        if off + nbytes > total:
+            raise framing.FrameCorrupt(
+                f"batch blob truncated in column {meta.get('name')!r}: "
+                f"needs {nbytes} bytes at offset {off}, {total - off} remain")
+        dtype = np.dtype(str(meta["dtype"]))
+        shape = tuple(int(d) for d in meta["shape"])
+        buf = blob[off:off + nbytes]
+        want = meta.get("sum64")
+        if want is not None and framing.word_sum64(buf) != int(want):
+            raise framing.FrameCorrupt(
+                f"batch column {meta.get('name')!r} word-sum mismatch: "
+                "wire bytes were damaged in flight (v2 frames delegate "
+                "blob integrity to this per-column check)")
+        out[str(meta["name"])] = dec(meta, buf, dtype, shape)
+        off += nbytes
+    return out
+
+
+def batches_nbytes(metas_list: Sequence[Sequence[Dict[str, Any]]]) -> int:
+    """Total wire bytes a v2 multi-batch blob occupies (for offset walks
+    and telemetry)."""
+    return sum(int(m["nbytes"]) for metas in metas_list for m in metas)
+
+
+def decode_batches_v2(metas_list: Sequence[Sequence[Dict[str, Any]]],
+                      blob) -> List[Dict[str, np.ndarray]]:
+    """Decode the ``sample_many`` reply form: N batches' metas under the
+    header's ``batches`` key, their wire bytes tightly concatenated in
+    the one frame blob."""
+    out: List[Dict[str, np.ndarray]] = []
+    off = 0
+    for metas in metas_list:
+        out.append(decode_batch_v2(metas, blob, off))
+        off += sum(int(m["nbytes"]) for m in metas)
+    return out

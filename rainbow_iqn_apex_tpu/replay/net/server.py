@@ -39,15 +39,19 @@ DRAM process, never a device one.
 
 from __future__ import annotations
 
+import collections
 import os
 import queue
 import selectors
 import socket
 import threading
-from typing import Any, Dict, Optional
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
 
 from rainbow_iqn_apex_tpu.netcore import chaos, framing
-from rainbow_iqn_apex_tpu.replay.net import protocol
+from rainbow_iqn_apex_tpu.replay.net import protocol, shm
 
 # bound on one reply write: a peer that stalls reading for this long is
 # dropped (its requests settle as PeerDead client-side) instead of wedging
@@ -57,24 +61,63 @@ _SEND_TIMEOUT_S = 5.0
 # drain rate is backpressured by its own acks, so a full queue means a
 # runaway peer — shed the op with a reasoned rerr instead of growing
 _WORK_QUEUE_DEPTH = 256
+# cap on batches per sample_many RPC: bounds one reply frame (16 Atari
+# batches ~ 29 MB, still under the default 64 MiB frame bound) and bounds
+# how stale a pre-assembled batch's beta can run
+_SAMPLE_MANY_MAX = 16
+# a ring entry built at beta b still answers a request at beta b' when
+# |b-b'| is under this: beta anneals over millions of steps, so the drift
+# across one ring's lifetime is orders of magnitude smaller
+_BETA_SLACK = 0.05
+# telemetry cadence of the per-op wire-bytes / ring-depth row
+_STATS_ROW_PERIOD_S = 10.0
 
 
 class _Conn:
     """One accepted client connection: socket, incremental frame reader,
     and a bounded outbound queue drained by this connection's OWN writer
     thread (neither the selector loop nor the memory worker ever blocks on
-    a peer's full send buffer)."""
+    a peer's full send buffer).
 
-    __slots__ = ("sock", "reader", "peer", "outq")
+    ``ring`` is this connection's sample-ahead buffer: pre-assembled,
+    pre-ENCODED batches (codec, beta, metas, wire buffers) built by the
+    memory worker after each sample, so the NEXT ``sample`` request is
+    answered straight from the event loop — no memory access, no encode,
+    no queue wait behind appends.  ``ring_want`` is the last request shape
+    (batch, beta, codec) the refill targets; entries that no longer match
+    are discarded on pop.  All ring state is guarded by the server lock.
 
-    def __init__(self, sock: socket.socket, max_frame_bytes: int):
+    ``pre`` accumulates the 16-byte shm preamble on AF_UNIX connections
+    (None once consumed, and always None on TCP); ``arena`` is this
+    connection's shared-memory slot arena when the preamble negotiated one
+    (replay/net/shm.py) — it lives and dies with the connection."""
+
+    __slots__ = ("sock", "reader", "peer", "outq", "ring", "ring_want",
+                 "pre", "arena")
+
+    def __init__(self, sock: socket.socket, max_frame_bytes: int,
+                 unix: bool = False):
         self.sock = sock
         self.reader = framing.FrameReader(max_frame_bytes)
         self.outq: "queue.Queue" = queue.Queue(maxsize=4096)
+        self.ring: "collections.deque" = collections.deque()
+        self.ring_want: Optional[Tuple[int, float, int]] = None
+        self.pre: Optional[bytearray] = bytearray() if unix else None
+        self.arena: Optional[shm.ServerArena] = None
+        if unix:
+            self.peer = f"unix:{sock.fileno()}"
+            return
         try:
             self.peer = "%s:%s" % sock.getpeername()[:2]
         except OSError:
             self.peer = "?"
+
+
+def _fd(conn: _Conn) -> int:
+    try:
+        return conn.sock.fileno()
+    except OSError:
+        return -1
 
 
 class ReplayShardServer:
@@ -93,8 +136,11 @@ class ReplayShardServer:
                  advertise: Optional[str] = None,
                  max_frame_bytes: int = framing.DEFAULT_MAX_FRAME,
                  epoch: int = 0, snapshot_prefix: Optional[str] = None,
-                 logger=None):
+                 ring_depth: int = 2, shm_mb: int = 64,
+                 local_fastpath: bool = True, logger=None):
         self.memory = memory
+        self.ring_depth = max(int(ring_depth), 0)  # 0 disables sample-ahead
+        self.shm_mb = max(int(shm_mb), 0)  # 0 disables arenas (unix-only)
         self.shard_base = int(shard_base)
         self.slot_base = self.shard_base * memory.shard_capacity
         self.epoch = int(epoch)
@@ -111,6 +157,21 @@ class ReplayShardServer:
             "127.0.0.1" if host in ("", "0.0.0.0") else host)
         self._selector = selectors.DefaultSelector()
         self._selector.register(self._listener, selectors.EVENT_READ, None)
+        # same-host fast path (replay/net/shm.py): an abstract AF_UNIX
+        # listener beside the TCP port.  Colocated clients dial it for the
+        # kernel-copy-free arena path; everything else keeps TCP.  Best
+        # effort — any failure leaves the TCP-only server intact.
+        self._ulistener: Optional[socket.socket] = None
+        if local_fastpath and shm.available():
+            try:
+                ul = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+                ul.bind(shm.unix_path(self.port))
+                ul.listen(64)
+                ul.setblocking(False)
+                self._ulistener = ul
+                self._selector.register(ul, selectors.EVENT_READ, None)
+            except OSError:
+                self._ulistener = None
         self._conns: Dict[int, _Conn] = {}  # fd -> conn
         self._lock = threading.Lock()
         self._stop = threading.Event()
@@ -125,6 +186,15 @@ class ReplayShardServer:
         self.fenced_updates = 0
         self.samples_served = 0
         self.updates_applied = 0
+        # sample-ahead + wire accounting (satellite: per-op wire bytes and
+        # ring depth flow to obs/net so learner stalls attribute to replay
+        # transport); ring_hits counts sample requests answered from a
+        # connection's pre-assembled ring, bytes_by_op the reply bytes per
+        # reply op — both under self._lock (written from the event loop,
+        # worker, AND writer threads)
+        self.ring_hits = 0
+        self._bytes_by_op: Dict[str, int] = {}
+        self._last_stats_row = time.monotonic()
         self.snapshot_step = -1
         # learner-role epoch latch (parallel/failover.py): priority
         # write-backs and snapshot requests stamped by a SUPERSEDED learner
@@ -160,7 +230,12 @@ class ReplayShardServer:
             host=cfg.replay_net_host, port=cfg.replay_net_port,
             advertise=cfg.replay_net_advertise or None,
             max_frame_bytes=int(cfg.replay_net_max_frame_mb) << 20,
-            epoch=epoch, snapshot_prefix=snapshot_prefix, logger=logger)
+            epoch=epoch, snapshot_prefix=snapshot_prefix,
+            ring_depth=int(getattr(cfg, "replay_net_ring_depth", 2)),
+            shm_mb=int(getattr(cfg, "replay_net_shm_mb", 64)),
+            local_fastpath=bool(
+                getattr(cfg, "replay_net_local_fastpath", True)),
+            logger=logger)
         if logger is not None and getattr(cfg, "obs_net", False):
             from rainbow_iqn_apex_tpu.obs.net.relay import ObsRelay
 
@@ -217,6 +292,11 @@ class ReplayShardServer:
             self._listener.close()
         except OSError:
             pass
+        if self._ulistener is not None:
+            try:
+                self._ulistener.close()
+            except OSError:
+                pass
         if self.obs_relay is not None:
             self.obs_relay.close()
             self.obs_relay = None
@@ -229,27 +309,56 @@ class ReplayShardServer:
             except OSError:
                 return
             for key, _mask in events:
-                if key.fileobj is self._listener:
-                    self._accept()
+                if key.data is None:  # one of the two listeners
+                    self._accept(key.fileobj)
                 else:
                     self._read(key.data)
+            self._maybe_stats_row()
 
-    def _accept(self) -> None:
+    def _maybe_stats_row(self) -> None:
+        """Rate-limited wire-telemetry row: per-op reply bytes + ring
+        depth, the numbers the critical-path analyzer needs to attribute a
+        learner stall to replay transport.  Event-loop only."""
+        if self.logger is None:
+            return
+        now = time.monotonic()
+        if now - self._last_stats_row < _STATS_ROW_PERIOD_S:
+            return
+        self._last_stats_row = now
+        with self._lock:
+            by_op = dict(self._bytes_by_op)
+            ring = sum(len(c.ring) for c in self._conns.values())
+            conns = len(self._conns)
+            shm_conns = sum(1 for c in self._conns.values()
+                            if c.arena is not None)
+            shm_free = sum(len(c.arena.free) for c in self._conns.values()
+                           if c.arena is not None)
+        self._log("wire", bytes_out=self.bytes_out, bytes_by_op=by_op,
+                  ring_depth=ring, ring_hits=self.ring_hits,
+                  samples_served=self.samples_served,
+                  connections=conns, shm_conns=shm_conns,
+                  shm_slots_free=shm_free, shard_base=self.shard_base)
+
+    def _accept(self, listener) -> None:
+        unix = listener is self._ulistener
         try:
-            sock, _addr = self._listener.accept()
+            sock, _addr = listener.accept()
         except OSError:
             return
         # blocking with a bound (see TransportServer._accept): sendall
         # loops through partial writes; only a peer stalled past the bound
         # is dropped.  Reads stay selector-driven.
         sock.settimeout(_SEND_TIMEOUT_S)
-        try:
-            sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
-        except OSError:
-            pass
-        sock = chaos.maybe_wrap(sock, peer=f"{_addr[0]}:{_addr[1]}",
-                                logger=self.logger)
-        conn = _Conn(sock, self.max_frame_bytes)
+        if unix:
+            peer_label = f"unix:{sock.fileno()}"
+        else:
+            peer_label = f"{_addr[0]}:{_addr[1]}"
+            try:
+                sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            except OSError:
+                pass
+        sock = chaos.maybe_wrap(sock, peer=peer_label, logger=self.logger)
+        conn = _Conn(sock, self.max_frame_bytes, unix=unix)
         with self._lock:
             self._conns[sock.fileno()] = conn
         threading.Thread(target=self._write_loop, args=(conn,),
@@ -273,6 +382,48 @@ class ReplayShardServer:
             conn.sock.close()
         except OSError:
             pass
+        if conn.arena is not None:
+            with self._lock:
+                conn.arena.close()
+                conn.arena = None
+
+    def _shm_handshake(self, conn: _Conn) -> bool:
+        """Consume the 16-byte preamble an AF_UNIX client leads with and
+        answer the hello (+ memfd via SCM_RIGHTS when an arena was both
+        requested and enabled).  True on success; False closes the conn.
+        The reply is sent inline from the event loop — it is 16 bytes into
+        an empty socket buffer, and no frame traffic exists yet."""
+        pre = conn.pre
+        assert pre is not None
+        flags = shm.parse_request(bytes(pre[:shm.PREAMBLE_BYTES]))
+        if flags is None:
+            self._log("bad_preamble", peer=conn.peer)
+            return False
+        if flags & shm.FLAG_WANT_ARENA and self.shm_mb > 0:
+            arena, fd = shm.ServerArena.create(self.shm_mb << 20)
+            try:
+                # ChaosSocket passes ancdata sends through untouched
+                socket.send_fds(conn.sock, [shm.pack_hello(arena.nbytes)],
+                                [fd])
+            except OSError:
+                arena.close()
+                return False
+            finally:
+                os.close(fd)
+            with self._lock:
+                conn.arena = arena
+        else:
+            try:
+                conn.sock.sendall(shm.pack_hello(0))
+            except OSError:
+                return False
+        rest = bytes(pre[shm.PREAMBLE_BYTES:])
+        conn.pre = None
+        if rest:
+            for header, blob in conn.reader.feed(rest):
+                self.frames_in += 1
+                self._handle(conn, header, blob)
+        return True
 
     def _read(self, conn: _Conn) -> None:
         try:
@@ -284,6 +435,17 @@ class ReplayShardServer:
             return
         if not data:
             self._close_conn(conn)
+            return
+        if conn.pre is not None:  # AF_UNIX conn still mid-preamble
+            conn.pre += data
+            if len(conn.pre) < shm.PREAMBLE_BYTES:
+                return
+            try:
+                ok = self._shm_handshake(conn)
+            except (OSError, framing.FrameError, ValueError):
+                ok = False
+            if not ok:
+                self._close_conn(conn)
             return
         try:
             frames = conn.reader.feed(data)
@@ -329,6 +491,9 @@ class ReplayShardServer:
             "shard_base": self.shard_base,
             "shards": len(mem.shards),
             "capacity": int(mem.shard_capacity),
+            # codec negotiation: clients never send ``codec``/``n`` until
+            # they have seen this (old servers simply lack the key -> v1)
+            "wire": protocol.WIRE_CODEC_MAX,
         }
         with self._lock:
             self._adv = adv
@@ -338,13 +503,16 @@ class ReplayShardServer:
             return dict(self._adv)
 
     def _reply(self, conn: _Conn, header: Dict[str, Any],
-               blob: bytes = b"") -> None:
+               blob: Any = b"", crc_blob: bool = True) -> None:
         """Enqueue one reply for the connection's writer thread (the event
-        loop and the memory worker never touch the socket).  A full queue
-        means the peer is long stalled — drop it instead of growing."""
+        loop and the memory worker never touch the socket).  ``blob`` is
+        either bytes or a LIST of buffers for the zero-copy vectored send;
+        ``crc_blob=False`` sends a v2 delegated-integrity frame (codec-v2
+        batches only — their columns carry word-sums).  A full queue means
+        the peer is long stalled — drop it instead of growing."""
         header = {**header, **self._state()}
         try:
-            conn.outq.put_nowait((header, blob))
+            conn.outq.put_nowait((header, blob, crc_blob))
         except queue.Full:
             self._close_conn(conn)
 
@@ -353,12 +521,18 @@ class ReplayShardServer:
             item = conn.outq.get()
             if item is None:  # close sentinel
                 return
-            header, blob = item
+            header, blob, crc_blob = item
+            buffers = blob if isinstance(blob, list) else [blob]
             try:
-                self.bytes_out += framing.send_frame(conn.sock, header, blob)
-            except (OSError, ValueError):
+                n = framing.send_frame_views(conn.sock, header, buffers,
+                                             crc_blob=crc_blob)
+            except (OSError, ValueError, framing.FrameError):
                 self._close_conn(conn)
                 return
+            self.bytes_out += n
+            op = str(header.get("op"))
+            with self._lock:
+                self._bytes_by_op[op] = self._bytes_by_op.get(op, 0) + n
 
     # ---------------------------------------------------------------- handlers
     def _handle(self, conn: _Conn, header: Dict[str, Any],
@@ -371,6 +545,17 @@ class ReplayShardServer:
             self._reply(conn, {"op": "stats_reply", "rid": rid,
                                **self.stats()})
         elif op in ("append", "sample", "update", "snapshot"):
+            if op == "sample":
+                # the client returns consumed arena slots on its NEXT
+                # sample request (deferred by its hold window, so the
+                # learner's zero-copy views are never overwritten mid-read)
+                freed = header.get("free")
+                if freed and conn.arena is not None:
+                    with self._lock:
+                        for off in freed:
+                            conn.arena.release(off)
+                if self._ring_serve(conn, rid, header):
+                    return  # answered from the sample-ahead ring
             # memory ops run on the ONE worker thread; the bounded queue
             # sheds a runaway pipeliner with a reasoned rerr instead of
             # buffering without bound
@@ -399,6 +584,11 @@ class ReplayShardServer:
                     self._do_sample(conn, rid, header)
                 elif op == "update":
                     self._do_update(conn, rid, header, blob)
+                elif op == "refill":
+                    # opportunistic sample-ahead top-up after a ring hit;
+                    # no reply, no advisory change
+                    self._refill(conn)
+                    continue
                 else:
                     self._do_snapshot(conn, rid, header)
                 self._refresh_advisory()
@@ -466,16 +656,32 @@ class ReplayShardServer:
         self._reply(conn, {"op": "ack", "rid": rid, "ok": True,
                            "rows": rows})
 
-    def _do_sample(self, conn: _Conn, rid: Any,
-                   header: Dict[str, Any]) -> None:
-        try:
-            s = self.memory.sample(int(header["batch"]),
-                                   float(header["beta"]))
-        except ValueError as e:  # all surviving shards empty: not yet warm
-            self._reply(conn, {"op": "rerr", "rid": rid, "etype": "empty",
-                               "msg": str(e)})
-            return
-        self.samples_served += 1
+    @staticmethod
+    def _negotiate(header: Dict[str, Any]) -> Tuple[int, int, int, float]:
+        """(codec, n, batch, beta) for one sample request: codec capped at
+        what this build speaks (a newer client degrades gracefully), the
+        batches-per-RPC count forced to 1 under v1 and bounded under v2."""
+        codec = min(int(header.get("codec", 1)), protocol.WIRE_CODEC_MAX)
+        n = int(header.get("n", 1)) if codec >= 2 else 1
+        n = max(1, min(n, _SAMPLE_MANY_MAX))
+        return codec, n, int(header["batch"]), float(header["beta"])
+
+    @staticmethod
+    def _entry_matches(entry, codec: int, batch: int, beta: float) -> bool:
+        return (entry[0] == codec and entry[1] == batch
+                and abs(entry[2] - beta) <= _BETA_SLACK)
+
+    def _assemble(self, conn: _Conn, codec: int, batch: int, beta: float):
+        """Sample + encode ONE batch (worker thread only — the memory is
+        not thread-safe).  Raises ValueError while the memory is not yet
+        sampleable.  Returns ``(codec, batch, beta, metas, buffers,
+        nbytes, slot_off)``: when the connection carries a shm arena and a
+        slot is free, the wire buffers are written ONCE into the slot at
+        ``slot_off`` and ``buffers`` comes back empty (the control frame
+        carries only metas); ``slot_off`` None means the bytes ride the
+        frame blob as usual.  Either way the entry stays bit-stable
+        however long it waits in the ring."""
+        s = self.memory.sample(batch, beta)
         arrays = {
             "idx": s.idx + self.slot_base,  # wire ids are GLOBAL
             "obs": s.obs, "action": s.action, "reward": s.reward,
@@ -484,9 +690,141 @@ class ReplayShardServer:
         }
         if s.prob is not None:
             arrays["prob"] = s.prob
-        metas, payload = protocol.encode_arrays(arrays)
-        self._reply(conn, {"op": "batch", "rid": rid, "arrays": metas},
-                    payload)
+        if codec >= 2 and conn.arena is not None:
+            # arena bytes never cross a network: skip the per-column
+            # word-sums too (the control frame itself stays CRC-checked)
+            metas, buffers = protocol.encode_batch_v2(arrays, sums=False)
+            nbytes = sum(int(m["nbytes"]) for m in metas)
+            with self._lock:
+                arena = conn.arena
+                if arena is not None:
+                    if not arena.slot_bytes:
+                        raw = sum(v.nbytes for v in
+                                  map(np.asarray, arrays.values()))
+                        arena.ensure_sized(raw)
+                    off = arena.alloc(nbytes)
+                else:
+                    off = None
+            if off is not None:
+                arena.write(off, buffers)
+                return (codec, batch, beta, metas, [], nbytes, off)
+            # arena exhausted (client holding slots): blob fallback needs
+            # the word-sums back on — these bytes DO cross the socket
+            metas, buffers = protocol.encode_batch_v2(arrays)
+            return (codec, batch, beta, metas, buffers, nbytes, None)
+        if codec >= 2:
+            metas, buffers = protocol.encode_batch_v2(arrays)
+            nbytes = sum(int(m["nbytes"]) for m in metas)
+        else:
+            metas, buffers = protocol.encode_arrays_views(arrays)
+            nbytes = sum(len(b) if isinstance(b, bytes) else b.nbytes
+                         for b in buffers)
+        return (codec, batch, beta, metas, buffers, nbytes, None)
+
+    def _send_batches(self, conn: _Conn, rid: Any, codec: int,
+                      entries: List[Any]) -> None:
+        """Reply with pre-encoded batches: one frame, blob = the entries'
+        wire buffers concatenated by the vectored writer (zero copies
+        between the replay ring and the socket)."""
+        if codec >= 2:
+            # v2 columns carry their own word-sums, so the frame envelope
+            # skips the blob CRC (the single largest CPU cost on the path)
+            header = {"op": "batch", "rid": rid, "codec": 2,
+                      "batches": [e[3] for e in entries]}
+            if conn.arena is not None:
+                # shm path: per-batch arena byte-offsets, null = that
+                # batch's bytes ride the blob (arena was full)
+                header["slots"] = [e[6] for e in entries]
+            self._reply(conn, header,
+                        [b for e in entries for b in e[4]],
+                        crc_blob=False)
+        else:
+            self._reply(conn, {"op": "batch", "rid": rid,
+                               "arrays": entries[0][3]},
+                        list(entries[0][4]))
+
+    def _ring_serve(self, conn: _Conn, rid: Any,
+                    header: Dict[str, Any]) -> bool:
+        """EVENT-LOOP fast path: answer a sample request entirely from the
+        connection's pre-assembled ring — no work-queue wait behind
+        appends, no memory access, no encode.  False (fall through to the
+        worker) when the ring cannot cover the request."""
+        if self.ring_depth <= 0:
+            return False
+        try:
+            codec, n, batch, beta = self._negotiate(header)
+        except (KeyError, TypeError, ValueError):
+            return False  # malformed; let the worker path raise the rerr
+        with self._lock:
+            ring = conn.ring
+            while ring and not self._entry_matches(ring[0], codec, batch,
+                                                   beta):
+                e = ring.popleft()  # stale shape/beta: worker rebuilds
+                if e[6] is not None and conn.arena is not None:
+                    conn.arena.release(e[6])
+            if len(ring) < n:
+                return False
+            entries = [ring.popleft() for _ in range(n)]
+            self.ring_hits += n
+            self.samples_served += n
+        self._send_batches(conn, rid, codec, entries)
+        try:  # opportunistic top-up; a full work queue just skips it
+            self._work.put_nowait((conn, "refill", None, None, None))
+        except queue.Full:
+            pass
+        return True
+
+    def _refill(self, conn: _Conn) -> None:
+        """Top the connection's sample-ahead ring back up to
+        ``ring_depth`` pre-encoded batches of its last request shape.
+        Worker thread only.  Quietly stops while the memory is not
+        sampleable or the connection is gone."""
+        if self.ring_depth <= 0:
+            return
+        with self._lock:
+            want = conn.ring_want
+            need = self.ring_depth - len(conn.ring)
+            gone = self._conns.get(_fd(conn)) is not conn
+        if want is None or need <= 0 or gone:
+            return
+        codec, batch, beta = want
+        entries = []
+        for _ in range(need):
+            try:
+                entries.append(self._assemble(conn, codec, batch, beta))
+            except ValueError:
+                break  # not sampleable (yet): the next sample will retry
+        if entries:
+            with self._lock:
+                conn.ring.extend(entries)
+
+    def _do_sample(self, conn: _Conn, rid: Any,
+                   header: Dict[str, Any]) -> None:
+        codec, n, batch, beta = self._negotiate(header)
+        with self._lock:
+            conn.ring_want = (codec, batch, beta)
+            ring = conn.ring
+            while ring and not self._entry_matches(ring[0], codec, batch,
+                                                   beta):
+                e = ring.popleft()
+                if e[6] is not None and conn.arena is not None:
+                    conn.arena.release(e[6])
+            entries = [ring.popleft()
+                       for _ in range(min(n, len(ring)))]
+            self.ring_hits += len(entries)
+        try:
+            while len(entries) < n:
+                entries.append(self._assemble(conn, codec, batch, beta))
+        except ValueError as e:  # all surviving shards empty: not yet warm
+            if not entries:
+                self._reply(conn, {"op": "rerr", "rid": rid,
+                                   "etype": "empty", "msg": str(e)})
+                return
+        with self._lock:
+            self.samples_served += len(entries)
+        self._send_batches(conn, rid, codec, entries)
+        # refill AFTER replying: the client decodes while we pre-assemble
+        self._refill(conn)
 
     def _do_update(self, conn: _Conn, rid: Any, header: Dict[str, Any],
                    blob: bytes) -> None:
@@ -594,12 +932,27 @@ class ReplayShardServer:
     def stats(self) -> Dict[str, Any]:
         with self._lock:
             n = len(self._conns)
+            by_op = dict(self._bytes_by_op)
+            ring = sum(len(c.ring) for c in self._conns.values())
+            shm_conns = sum(1 for c in self._conns.values()
+                            if c.arena is not None)
+            shm_free = sum(len(c.arena.free) for c in self._conns.values()
+                           if c.arena is not None)
+            shm_total = sum(c.arena.total_slots
+                            for c in self._conns.values()
+                            if c.arena is not None)
         return {"port": self.port, "connections": n,
+                "shm_conns": shm_conns, "shm_slots_free": shm_free,
+                "shm_slots_total": shm_total,
                 "frames_in": self.frames_in, "bytes_out": self.bytes_out,
+                "bytes_by_op": by_op,
                 "rows_appended": self.rows_appended,
                 "fenced_appends": self.fenced_appends,
                 "fenced_updates": self.fenced_updates,
                 "samples_served": self.samples_served,
+                "ring_hits": self.ring_hits,
+                "ring_depth": ring,
+                "wire": protocol.WIRE_CODEC_MAX,
                 "updates_applied": self.updates_applied,
                 "snapshot_step": self.snapshot_step,
                 "learner_epoch": self.learner_epoch,

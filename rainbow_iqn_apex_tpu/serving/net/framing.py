@@ -22,11 +22,15 @@ from rainbow_iqn_apex_tpu.netcore.framing import (  # noqa: F401
     FrameTruncated,
     decode_ndarray,
     encode_frame,
+    encode_frame_views,
     encode_ndarray,
+    ndarray_view,
     pack_blobs,
     recv_exact,
     recv_frame,
+    recv_frame_view,
     send_frame,
+    send_frame_views,
     unpack_blobs,
 )
 
@@ -44,10 +48,14 @@ __all__ = [
     "FrameTruncated",
     "decode_ndarray",
     "encode_frame",
+    "encode_frame_views",
     "encode_ndarray",
+    "ndarray_view",
     "pack_blobs",
     "recv_exact",
     "recv_frame",
+    "recv_frame_view",
     "send_frame",
+    "send_frame_views",
     "unpack_blobs",
 ]

@@ -316,8 +316,11 @@ def test_sample_client_drop_then_readmit_peer():
         assert seen == {0, 1}
 
         sc.drop_peer(1)
-        # drain the pipeline of pre-drop batches, then survivors only
-        for _ in range(4):
+        # drain the pipeline of pre-drop batches, then survivors only.
+        # The adaptive pipeline can hold up to depth_max batches (ready +
+        # in-flight, one _space permit each) and replies settle in request
+        # order, so depth_max gets cover every batch requested pre-drop.
+        for _ in range(sc.depth_max):
             sc.get(timeout=30.0)
         for _ in range(10):
             b = sc.get(timeout=30.0)

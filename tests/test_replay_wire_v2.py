@@ -1,0 +1,477 @@
+"""Wire-speed replay path (ISSUE 20): codec v2 + shm arena fault suite.
+
+Loopback tests over real sockets, no jax.  What tests/test_replay_net.py
+proves for the ISSUE-16 plane (round trips, sampling parity, fencing),
+this file proves for the ISSUE-20 fast path — and then tries to break it:
+
+1. **torn sendmsg mid-iovec**: the kernel may accept ANY byte count from a
+   vectored send; `framing.sendmsg_all` must re-slice the chain from the
+   first unsent byte and the reassembled frame must be bit-identical;
+2. **oversize / corrupted frames**: `FrameTooLarge` on a frame past the
+   cap, `FrameCorrupt` on envelope CRC damage — and for v2 delegated-
+   integrity frames, blob damage that the envelope deliberately no longer
+   covers MUST still die at the per-column ``word_sum64`` check;
+3. **codec negotiation**: an old server (no ``wire`` piggyback) keeps the
+   client on v1; an old client (no ``codec`` in the request) gets a v1
+   ``arrays`` reply from a new server — both directions interoperate;
+4. **shm arena**: loopback negotiation (memfd over SCM_RIGHTS), the
+   explicit fallbacks (fastpath off -> TCP; ``shm_mb=0`` -> unix byte
+   path, no arena), slot exhaustion (arena too small -> null slots, blob
+   fallback decodes), and a garbage preamble closing the connection;
+5. **wire-drift analyzer**: clean on the real tree, and each injected
+   drift class (codec ceiling, decoder table, op surfaces, shm magic)
+   produces its keyed finding.
+"""
+
+import os
+import socket
+import struct
+import threading
+
+import numpy as np
+import pytest
+
+from rainbow_iqn_apex_tpu.netcore import chaos, framing
+from rainbow_iqn_apex_tpu.parallel.sharded_replay import ShardedReplay
+from rainbow_iqn_apex_tpu.replay.net import (
+    ReplayPeer,
+    ReplayShardServer,
+    SampleClient,
+    protocol,
+    shm,
+)
+
+pytestmark = pytest.mark.net
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+FRAME = (12, 12)
+
+
+def _filled_memory(shards=2, cap=512, lanes=4, seed=0, frame=FRAME,
+                   ticks=None):
+    m = ShardedReplay.build(
+        shards, cap, lanes, frame_shape=frame, history=2, n_step=3,
+        gamma=0.9, seed=seed,
+    )
+    rng = np.random.default_rng(seed + 1)
+    for _ in range(ticks if ticks is not None else cap // lanes):
+        m.append_batch(
+            rng.integers(0, 255, (lanes, *frame), dtype=np.uint8),
+            rng.integers(0, 4, lanes),
+            rng.normal(size=lanes).astype(np.float32),
+            rng.random(lanes) < 0.02,
+            priorities=rng.random(lanes) + 0.05,
+        )
+    return m
+
+
+def _serve(memory, **kwargs):
+    srv = ReplayShardServer(memory, **kwargs)
+    srv.start()
+    return srv
+
+
+def _peer(srv, pid=0, **kwargs):
+    return ReplayPeer("127.0.0.1", srv.port, peer_id=pid, **kwargs)
+
+
+def _batch_frame(crc_blob, rows=64):
+    """One codec-v2 batch frame as (reference bytes, metas) — big enough
+    that a seeded random byte flip lands in the blob, not the header."""
+    rng = np.random.default_rng(7)
+    arrays = {
+        "obs": rng.integers(0, 255, (rows, *FRAME, 2), dtype=np.uint8),
+        "idx": np.arange(rows, dtype=np.int64),
+        "weight": np.linspace(0.1, 1.0, rows, dtype=np.float32),
+    }
+    metas, buffers = protocol.encode_batch_v2(arrays, sums=True)
+    chain, total = framing.encode_frame_views(
+        {"op": "batch", "batches": [metas]}, buffers, crc_blob=crc_blob)
+    wire = b"".join(bytes(b) if not isinstance(b, bytes) else b
+                    for b in chain)
+    assert len(wire) == total
+    return wire, metas, arrays
+
+
+class _TrickleSock:
+    """A socket double whose sendmsg accepts a seeded, tiny, arbitrary
+    byte count per call — every tear lands mid-iovec somewhere."""
+
+    def __init__(self, seed=0):
+        self.rng = np.random.default_rng(seed)
+        self.out = bytearray()
+
+    def sendmsg(self, buffers):
+        chain = b"".join(bytes(b) for b in buffers)
+        n = int(self.rng.integers(1, 17))  # 1..16 bytes per "kernel" accept
+        n = min(n, len(chain))
+        self.out += chain[:n]
+        return n
+
+
+# --------------------------------------------------------- torn vectored send
+@pytest.mark.chaos
+def test_torn_sendmsg_mid_iovec_reassembles_bit_identically():
+    wire, metas, arrays = _batch_frame(crc_blob=False)
+    # re-encode through the trickling socket: thousands of partial accepts,
+    # each potentially mid-iovec (16-byte grains vs multi-KB columns)
+    _, _, src = _batch_frame(crc_blob=False)
+    metas2, buffers = protocol.encode_batch_v2(src, sums=True)
+    sock = _TrickleSock(seed=3)
+    chain, total = framing.encode_frame_views(
+        {"op": "batch", "batches": [metas2]}, buffers, crc_blob=False)
+    sent = framing.sendmsg_all(sock, chain, total)
+    assert sent == total
+    assert bytes(sock.out) == wire  # bit-identical despite every tear
+
+    # a reader fed the torn prefixes yields NOTHING until the final byte
+    reader = framing.FrameReader()
+    assert reader.feed(bytes(sock.out[:-1])) == []
+    frames = reader.feed(bytes(sock.out[-1:]))
+    assert len(frames) == 1
+    header, blob = frames[0]
+    out = protocol.decode_batch_v2(header["batches"][0], blob)
+    np.testing.assert_array_equal(out["obs"], arrays["obs"])
+    np.testing.assert_array_equal(out["idx"], arrays["idx"])
+    # fp32 IS-weights ride the wire as scaled fp16 by design (codec v2)
+    np.testing.assert_allclose(out["weight"], arrays["weight"], rtol=1e-3)
+
+
+@pytest.mark.chaos
+def test_sendmsg_all_zero_write_raises_truncated():
+    class _Dead:
+        def sendmsg(self, buffers):
+            return 0  # peer closed with the frame half-sent
+
+    chain, total = framing.encode_frame_views({"op": "x"}, [b"payload"])
+    with pytest.raises(framing.FrameTruncated):
+        framing.sendmsg_all(_Dead(), chain, total)
+
+
+# ----------------------------------------------------- oversize / corruption
+@pytest.mark.chaos
+def test_oversize_frame_rejected_on_both_read_paths():
+    wire, _, _ = _batch_frame(crc_blob=True)
+    cap = len(wire) // 2
+    with pytest.raises(framing.FrameTooLarge):
+        framing.FrameReader(max_frame_bytes=cap).feed(wire)
+    a, b = socket.socketpair()
+    try:
+        a.sendall(wire)
+        with pytest.raises(framing.FrameTooLarge):
+            framing.recv_frame_view(b, max_frame_bytes=cap)
+    finally:
+        a.close()
+        b.close()
+
+
+@pytest.mark.chaos
+def test_v1_envelope_crc_catches_blob_damage():
+    wire, _, _ = _batch_frame(crc_blob=True)
+    hurt = bytearray(wire)
+    hurt[len(hurt) // 2] ^= 0xFF  # deep inside the blob
+    with pytest.raises(framing.FrameCorrupt):
+        framing.FrameReader().feed(bytes(hurt))
+
+
+@pytest.mark.chaos
+def test_v2_header_damage_dies_at_envelope_blob_damage_at_word_sum():
+    wire, _, _ = _batch_frame(crc_blob=False)
+    # header bytes are still CRC-covered in a delegated frame
+    hurt = bytearray(wire)
+    hurt[framing.PREFIX_BYTES + 2] ^= 0x01
+    with pytest.raises(framing.FrameCorrupt):
+        framing.FrameReader().feed(bytes(hurt))
+    # blob bytes are NOT envelope-covered: the frame parses, the column's
+    # word_sum64 is the line of defence
+    hurt = bytearray(wire)
+    hurt[len(hurt) // 2] ^= 0xFF
+    frames = framing.FrameReader().feed(bytes(hurt))
+    assert len(frames) == 1  # envelope deliberately blind to blob bytes
+    header, blob = frames[0]
+    with pytest.raises(framing.FrameCorrupt, match="word-sum"):
+        protocol.decode_batch_v2(header["batches"][0], blob)
+
+
+@pytest.mark.chaos
+def test_chaos_corrupt_frame_never_decodes_silently_on_v2():
+    """A seeded chaos byte flip over a REAL socket: wherever it lands,
+    header (CRC) or blob (word sum), decode raises — never bad data."""
+    wire, _, arrays = _batch_frame(crc_blob=False)
+    for seed in range(8):
+        nc = chaos.NetChaos("corrupt_frame@p=1.0", seed=seed, site="a")
+        a, b = socket.socketpair()
+        try:
+            w = nc.wrap(a, peer="b")
+            w.sendall(wire)
+            got = b.recv(len(wire) + 64, socket.MSG_WAITALL | socket.MSG_PEEK)
+            got = b.recv(len(got), socket.MSG_WAITALL)
+            assert got != wire  # the flip really happened
+            with pytest.raises(framing.FrameError):
+                frames = framing.FrameReader().feed(got)
+                for header, blob in frames:
+                    protocol.decode_batch_v2(header["batches"][0], blob)
+        finally:
+            a.close()
+            b.close()
+
+
+# --------------------------------------------------------- codec negotiation
+def test_old_server_without_wire_key_keeps_client_on_v1():
+    """A peer that never sees the ``wire`` piggyback (an ISSUE-16-era
+    server) must be spoken to in codec v1 — and still sample fine."""
+    srv = _serve(_filled_memory())
+    real_state = srv._state
+    srv._state = lambda: {k: v for k, v in real_state().items()
+                          if k != "wire"}
+    peer = _peer(srv, local_fastpath=False)
+    sc = SampleClient({0: peer}, 32, lambda: 0.5, depth=2, seed=0)
+    try:
+        b = sc.get(timeout=30.0)
+        assert peer.wire_codec == 1  # negotiation never escalated
+        assert b.obs.shape == (32, *FRAME, 2)
+        assert b.obs.dtype == np.uint8
+        assert np.isfinite(b.weight).all() and (b.weight > 0).all()
+    finally:
+        sc.close()
+        srv.stop()
+
+
+def test_old_client_plain_sample_request_gets_v1_arrays_reply():
+    """A raw request without ``codec`` (an old client) must get the v1
+    ``arrays`` reply shape from a new server, decodable by the old path."""
+    srv = _serve(_filled_memory())
+    peer = _peer(srv)
+    try:
+        header, blob = peer.request(
+            {"op": "sample", "batch": 16, "beta": 0.5}, timeout_s=30.0)
+        assert header["op"] == "batch"
+        assert "arrays" in header and "batches" not in header
+        arrays = protocol.decode_arrays(header["arrays"], blob)
+        assert arrays["obs"].shape == (16, *FRAME, 2)
+        assert arrays["idx"].dtype == np.int64
+        # the new server DID advertise v2 — the escalation is client-gated
+        assert peer.wire_codec == protocol.WIRE_CODEC_MAX
+    finally:
+        peer.close()
+        srv.stop()
+
+
+# ------------------------------------------------------------- shm fast path
+needs_shm = pytest.mark.skipif(not shm.available(),
+                               reason="no memfd/AF_UNIX fd-passing here")
+
+
+@needs_shm
+def test_shm_arena_negotiated_on_loopback_and_batches_decode():
+    srv = _serve(_filled_memory())
+    peer = _peer(srv)
+    sc = SampleClient({0: peer}, 32, lambda: 0.5, depth=2, seed=0)
+    try:
+        b = sc.get(timeout=30.0)
+        assert peer.arena is not None  # memfd arrived over SCM_RIGHTS
+        assert peer.stats()["shm"] is True
+        st = srv.stats()
+        assert st["shm_conns"] == 1
+        assert st["shm_slots_total"] > 0
+        assert b.obs.shape == (32, *FRAME, 2) and b.obs.dtype == np.uint8
+        assert np.isfinite(b.weight).all() and (b.weight > 0).all()
+        # slots cycle: the deferred-free leg returns offsets, so the free
+        # list stays bounded away from empty at steady state
+        for _ in range(24):
+            sc.get(timeout=30.0)
+        assert srv.stats()["shm_slots_free"] > 0
+    finally:
+        sc.close()
+        srv.stop()
+
+
+@needs_shm
+def test_local_fastpath_off_is_plain_tcp_no_arena():
+    srv = _serve(_filled_memory())
+    peer = _peer(srv, local_fastpath=False)
+    sc = SampleClient({0: peer}, 32, lambda: 0.5, depth=2, seed=0)
+    try:
+        b = sc.get(timeout=30.0)
+        assert peer.arena is None
+        assert peer._sock is not None
+        assert peer._sock.family == socket.AF_INET  # really TCP
+        assert srv.stats()["shm_conns"] == 0
+        assert b.obs.shape == (32, *FRAME, 2)
+    finally:
+        sc.close()
+        srv.stop()
+
+
+@needs_shm
+def test_shm_mb_zero_serves_unix_byte_path_without_arena():
+    srv = _serve(_filled_memory(), shm_mb=0)
+    peer = _peer(srv)
+    sc = SampleClient({0: peer}, 32, lambda: 0.5, depth=2, seed=0)
+    try:
+        b = sc.get(timeout=30.0)
+        assert peer.arena is None  # hello advertised 0 arena bytes
+        assert peer._sock is not None
+        assert peer._sock.family == socket.AF_UNIX  # byte path kept
+        assert srv.stats()["shm_conns"] == 0
+        assert b.obs.shape == (32, *FRAME, 2)
+    finally:
+        sc.close()
+        srv.stop()
+
+
+@pytest.mark.chaos
+@needs_shm
+def test_server_arena_alloc_release_and_exhaustion():
+    arena, fd = shm.ServerArena.create(1 << 20)
+    try:
+        os.close(fd)
+        arena.ensure_sized((1 << 18) - 4096)  # -> 4096-aligned slots
+        assert arena.slot_bytes >= 1 << 18
+        offs = []
+        off = arena.alloc(arena.slot_bytes)
+        while off is not None:
+            offs.append(off)
+            off = arena.alloc(arena.slot_bytes)
+        assert len(offs) == arena.total_slots > 0
+        assert arena.alloc(16) is None  # exhausted even for a tiny ask
+        # release validates alignment / range / double-free
+        assert arena.release(offs[0]) is True
+        assert arena.release(offs[0]) is False  # double free
+        assert arena.release(offs[1] + 1) is False  # misaligned
+        assert arena.release(arena.nbytes + arena.slot_bytes) is False
+        assert arena.alloc(16) == offs[0]  # the freed slot cycles back
+    finally:
+        arena.close()
+
+
+@pytest.mark.chaos
+@needs_shm
+def test_arena_too_small_for_batch_falls_back_to_blob():
+    """shm_mb=1 with an ~1.8 MB raw batch: the arena sizes to ZERO slots,
+    every reply ships null slots + blob bytes, and the client must decode
+    the fallback correctly (same decode path a mid-run exhaustion hits)."""
+    mem = _filled_memory(shards=1, cap=256, frame=(84, 84))
+    srv = _serve(mem, shm_mb=1)
+    peer = _peer(srv)
+    sc = SampleClient({0: peer}, 64, lambda: 0.5, depth=2, seed=0)
+    try:
+        b = sc.get(timeout=30.0)
+        assert peer.arena is not None  # the arena WAS negotiated...
+        st = srv.stats()
+        assert st["shm_conns"] == 1
+        assert st["shm_slots_total"] == 0  # ...but no batch fits a slot
+        assert b.obs.shape == (64, 84, 84, 2) and b.obs.dtype == np.uint8
+        assert np.isfinite(b.weight).all() and (b.weight > 0).all()
+        sc.get(timeout=30.0)  # fallback sustains, not a one-shot fluke
+    finally:
+        sc.close()
+        srv.stop()
+
+
+@pytest.mark.chaos
+@needs_shm
+def test_garbage_shm_preamble_closes_the_connection():
+    srv = _serve(_filled_memory())
+    sock = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+    try:
+        sock.settimeout(5.0)
+        sock.connect(shm.unix_path(srv.port))
+        sock.sendall(struct.pack(">8sQ", b"NOTMAGIC", 1))
+        assert sock.recv(64) == b""  # server hung up, sent nothing
+    finally:
+        sock.close()
+        srv.stop()
+
+
+@pytest.mark.chaos
+@needs_shm
+def test_chaos_socket_passes_scm_rights_through_a_blackhole():
+    """The arena-fd handoff must survive ANY armed fault spec: ancillary
+    data bypasses the byte-level fault model (you cannot corrupt or drop
+    kernel fd-passing and still call it a byte fault)."""
+    nc = chaos.NetChaos("blackhole@p=1.0", seed=0, site="srv")
+    a, b = socket.socketpair(socket.AF_UNIX, socket.SOCK_STREAM)
+    r, w = os.pipe()
+    try:
+        wrapped = nc.wrap(a, peer="client")
+        # plain traffic is swallowed whole by the blackhole
+        wrapped.sendall(b"dropped")
+        b.setblocking(False)
+        with pytest.raises(BlockingIOError):
+            b.recv(16)
+        b.setblocking(True)
+        # ...but the SCM_RIGHTS handshake goes through untouched
+        socket.send_fds(wrapped, [shm.pack_hello(4096)], [r])
+        data, fds, _flags, _addr = socket.recv_fds(b, shm.PREAMBLE_BYTES, 4)
+        assert shm.parse_hello(data) == 4096
+        assert len(fds) == 1
+        os.write(w, b"x")
+        assert os.read(fds[0], 1) == b"x"  # the fd is real and live
+        os.close(fds[0])
+    finally:
+        os.close(r)
+        os.close(w)
+        a.close()
+        b.close()
+
+
+# -------------------------------------------------------- wire-drift checker
+def test_wirecheck_clean_on_the_real_tree():
+    from rainbow_iqn_apex_tpu.analysis import wirecheck
+    assert wirecheck.check_repo(REPO_ROOT) == []
+
+
+def _mutated(surface, **patches):
+    out = dict(surface)
+    out.update(patches)
+    return out
+
+
+def test_wirecheck_flags_each_injected_drift_class():
+    from rainbow_iqn_apex_tpu.analysis import wirecheck
+    surface = wirecheck.collect(REPO_ROOT)
+    assert wirecheck.verify(surface) == []
+
+    def keys(s):
+        return {f.key for f in wirecheck.verify(s)}
+
+    # 1a. negotiation ceiling drifts from the codec registry
+    pc = dict(surface["protocol_consts"])
+    pc["WIRE_CODEC_MAX"] = (protocol.WIRE_CODEC_MAX + 1, 1)
+    assert "wire-drift:codecs-replay-batch" in keys(
+        _mutated(surface, protocol_consts=pc))
+    # 1b. envelope version drifts from the registry
+    fc = dict(surface["framing_consts"])
+    fc["FRAME_VERSION_MAX"] = (framing.FRAME_VERSION_MAX + 1, 1)
+    assert "wire-drift:codecs-frame" in keys(
+        _mutated(surface, framing_consts=fc))
+    # 2. encoder declared without a decoder
+    assert "wire-drift:v2-encodings" in keys(
+        _mutated(surface, decoder_keys=surface["decoder_keys"][:-1]))
+    # 3a. server dispatches an undeclared op
+    sops = dict(surface["server_ops"])
+    sops["bogus"] = 1
+    assert "wire-drift:server-op-bogus" in keys(
+        _mutated(surface, server_ops=sops))
+    # 3b. a declared op the server never handles
+    assert "wire-drift:unhandled-op-sample" in keys(
+        _mutated(surface, server_ops={
+            k: v for k, v in surface["server_ops"].items()
+            if k != "sample"}))
+    # 3c. client sends an undeclared op
+    cops = dict(surface["client_ops"])
+    cops["bogus"] = 1
+    assert "wire-drift:client-op-bogus" in keys(
+        _mutated(surface, client_ops=cops))
+    # 4. a resized shm magic would shift the preamble flags word
+    sc = dict(surface["shm_consts"])
+    sc["MAGIC_REQ"] = (b"SHORT", 1)
+    assert "wire-drift:shm-magic_req" in keys(
+        _mutated(surface, shm_consts=sc))
+
+
+def test_wirecheck_registered_with_the_runner():
+    from rainbow_iqn_apex_tpu.analysis import runner, wirecheck
+    assert wirecheck.ANALYZER in runner.ANALYZER_IDS
+    assert runner.run_all(REPO_ROOT, analyzers=[wirecheck.ANALYZER]) == []
